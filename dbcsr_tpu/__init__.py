@@ -1,0 +1,55 @@
+"""dbcsr_tpu — a TPU-native distributed block-sparse matrix framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of DBCSR
+(CP2K's Distributed Block Compressed Sparse Row library; reference
+`README.md:13-15`): distributed block-sparse matrix-matrix multiplication
+and supporting operations, a tall-and-skinny (TAS) layer, and an n-rank
+block-sparse tensor-contraction layer.
+
+This is NOT a port.  Design mapping (reference concept -> here):
+
+* Fortran BCSR index + typed data areas  ->  host NumPy block index +
+  per-block-shape device arrays in HBM (`dbcsr_tpu.core.matrix`).
+* libsmm_acc JIT'd CUDA batched small-GEMM kernels
+  (`src/acc/libsmm_acc/libsmm_acc.cpp`)  ->  XLA/Pallas batched SMM over
+  integer parameter stacks (`dbcsr_tpu.acc`).
+* MPI Cannon metronome loop (`src/mm/dbcsr_mm_cannon.F:1345`)  ->
+  `shard_map` over a 2D `jax.sharding.Mesh` with `lax.ppermute` ring
+  shifts (`dbcsr_tpu.parallel`).
+* OpenMP threads / per-thread work matrices  ->  vectorized device work;
+  no host threading needed.
+"""
+
+from dbcsr_tpu.core.kinds import (
+    dbcsr_type_real_4,
+    dbcsr_type_real_8,
+    dbcsr_type_complex_4,
+    dbcsr_type_complex_8,
+    dtype_of,
+)
+from dbcsr_tpu.core.config import get_config, set_config, print_config
+from dbcsr_tpu.core.lib import init_lib, finalize_lib, print_statistics
+from dbcsr_tpu.core.dist import ProcessGrid, Distribution
+from dbcsr_tpu.core.matrix import BlockSparseMatrix, create
+from dbcsr_tpu.mm.multiply import multiply
+from dbcsr_tpu.ops.operations import (
+    add,
+    add_on_diag,
+    copy,
+    dot,
+    filter_matrix,
+    frobenius_norm,
+    function_of_elements,
+    gershgorin_norm,
+    hadamard_product,
+    maxabs_norm,
+    scale,
+    scale_by_vector,
+    set_diag,
+    get_diag,
+    trace,
+)
+from dbcsr_tpu.ops.transformations import new_transposed, desymmetrize, redistribute
+from dbcsr_tpu.ops.test_methods import make_random_matrix, to_dense, from_dense
+
+__version__ = "0.1.0"

@@ -1,0 +1,16 @@
+"""ACC layer: the device-kernel contract.
+
+TPU-native equivalent of the reference accelerator plugin boundary
+(`src/acc/acc.h` + `src/acc/acc_libsmm.h`): batched small-matrix
+multiply over integer parameter stacks, batched block transpose, and
+per-block norms.  CUDA streams/events become XLA async dispatch; device
+memory becomes jax Arrays in HBM; the NVRTC JIT-per-(m,n,k) kernel cache
+becomes the XLA/Pallas jit cache keyed by block shape.
+"""
+
+from dbcsr_tpu.acc.smm import (
+    process_stack,
+    transpose_blocks,
+    block_norms,
+    pad_stack,
+)

@@ -1,0 +1,19 @@
+"""Fused Pallas TPU kernel for stack processing (placeholder).
+
+Will fuse gather -> small-GEMM -> segment-accumulate in VMEM, replacing
+the reference's five CUDA kernel families
+(`src/acc/libsmm_acc/kernels/smm_acc_dnt_*.h`) with one blocked Pallas
+matmul whose tuning space is (entries-per-step, k-concat length, vmem
+budget).  Until implemented, `supports` returns False and the XLA path
+in `dbcsr_tpu.acc.smm` is used.
+"""
+
+from __future__ import annotations
+
+
+def supports(c_data, a_data, b_data) -> bool:
+    return False
+
+
+def process_stack_pallas(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
+    raise NotImplementedError("pallas SMM kernel not yet implemented")

@@ -1,0 +1,156 @@
+"""Batched small-matrix-multiply over parameter stacks (the hot kernel).
+
+TPU-native equivalent of `libsmm_acc_process` / `libsmm_acc_transpose` /
+`c_calculate_norms` (`src/acc/acc_libsmm.h:38-49`).  A parameter stack
+is three int32 arrays of equal length S: for entry s,
+
+    C[c_idx[s]] += alpha * A[a_idx[s]] @ B[b_idx[s]]
+
+where A is a (Na, m, k) device array of same-shape blocks, B is
+(Nb, k, n) and C is (Nc, m, n) — one array per block-shape bin (the
+reference enumerates block sizes the same way, `dbcsr_mm_common.F:309`).
+
+Key differences from the CUDA design, by intent:
+
+* The reference relies on ``atomicAdd`` into C; TPU wants deterministic
+  accumulation, so stacks arrive **sorted by c_idx** and accumulation is
+  a sorted ``segment_sum`` (bit-reproducible for fixed stack order —
+  the "bit-identical checksums" north star).
+* The per-(m,n,k) NVRTC JIT cache (`libsmm_acc.cpp:89-224`) becomes the
+  XLA jit cache: each (m, n, k, dtype, stack-bucket) specializes once.
+* Stack entries are padded up to a size bucket with ``c_idx == Nc``;
+  out-of-range segment ids are dropped by XLA, giving masked no-op
+  entries with static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dbcsr_tpu.core.config import get_config
+from dbcsr_tpu.core.kinds import real_dtype_of
+from dbcsr_tpu.utils.rounding import bucket_size
+
+
+def _accum_dtype(dtype):
+    """Accumulate bf16 in f32; everything else in its own precision."""
+    d = jnp.dtype(dtype)
+    if d == jnp.bfloat16:
+        return jnp.float32
+    return d
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _process_stack_xla(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
+    """One stack chunk: gather -> batched matmul -> sorted segment-add."""
+    a = jnp.take(a_data, a_idx, axis=0)
+    b = jnp.take(b_data, b_idx, axis=0)
+    acc = _accum_dtype(c_data.dtype)
+    # HIGHEST precision: f32 runs as true f32 on the MXU (bf16x3 passes),
+    # matching the reference's numerics contract; bf16 data still uses
+    # fast bf16 inputs with f32 accumulation via preferred_element_type.
+    prod = jax.lax.dot_general(
+        a,
+        b,
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=acc,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    prod = (alpha.astype(acc) * prod).astype(c_data.dtype)
+    contrib = jax.ops.segment_sum(
+        prod, c_idx, num_segments=c_data.shape[0], indices_are_sorted=True
+    )
+    return c_data + contrib
+
+
+def pad_stack(a_idx, b_idx, c_idx, target_len: int, drop_segment: int):
+    """Pad int32 stack arrays to ``target_len`` with masked no-op entries."""
+    s = len(a_idx)
+    if s == target_len:
+        return (
+            np.ascontiguousarray(a_idx, np.int32),
+            np.ascontiguousarray(b_idx, np.int32),
+            np.ascontiguousarray(c_idx, np.int32),
+        )
+    pad = target_len - s
+    return (
+        np.concatenate([a_idx, np.zeros(pad, np.int32)]).astype(np.int32),
+        np.concatenate([b_idx, np.zeros(pad, np.int32)]).astype(np.int32),
+        np.concatenate([c_idx, np.full(pad, drop_segment, np.int32)]).astype(np.int32),
+    )
+
+
+def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0):
+    """Process a full (possibly large) stack, chunked to mm_stack_size.
+
+    ``c_idx`` must be sorted ascending (the stack builder guarantees it);
+    chunk boundaries preserve order, so accumulation into each C block
+    happens in a fixed, reproducible order (ref determinism requirement:
+    stack order is deterministic in `dbcsr_mm_csr.F`).
+
+    Returns the updated ``c_data`` device array.
+    """
+    cfg = get_config()
+    S = len(a_idx)
+    if S == 0:
+        return c_data
+    nseg = c_data.shape[0]
+    alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)
+    chunk = max(cfg.mm_stack_size, 1)
+    use_pallas = _pallas_enabled(cfg, c_data, a_data, b_data)
+    for s0 in range(0, S, chunk):
+        s1 = min(s0 + chunk, S)
+        L = bucket_size(s1 - s0)
+        ai, bi, ci = pad_stack(a_idx[s0:s1], b_idx[s0:s1], c_idx[s0:s1], L, nseg)
+        ai, bi, ci = jnp.asarray(ai), jnp.asarray(bi), jnp.asarray(ci)
+        if use_pallas:
+            from dbcsr_tpu.acc.pallas_smm import process_stack_pallas
+
+            c_data = process_stack_pallas(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
+        else:
+            c_data = _process_stack_xla(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
+    return c_data
+
+
+def _pallas_enabled(cfg, c_data, a_data, b_data) -> bool:
+    if cfg.mm_driver == "xla":
+        return False
+    if not cfg.use_pallas and cfg.mm_driver != "pallas":
+        return False
+    try:
+        from dbcsr_tpu.acc.pallas_smm import supports
+
+        return supports(c_data, a_data, b_data)
+    except Exception:
+        return False
+
+
+@jax.jit
+def transpose_blocks(data):
+    """Batched in-register block transpose: (N, m, n) -> (N, n, m).
+
+    Ref `libsmm_acc_transpose` (`acc_libsmm.h`, kernel
+    `smm_acc_transpose.h`) — used to put A panels in the (m, k)
+    layout the multiply kernel wants.
+    """
+    return jnp.swapaxes(data, 1, 2)
+
+
+@jax.jit
+def _block_norms(data):
+    sq = jnp.real(data * jnp.conj(data)) if jnp.iscomplexobj(data) else data * data
+    return jnp.sqrt(jnp.sum(sq, axis=(1, 2), dtype=_accum_dtype(sq.dtype)))
+
+
+def block_norms(data):
+    """Per-block Frobenius norms, (N, m, n) -> (N,) real.
+
+    Ref `c_calculate_norms` (`src/acc/cuda_hip/calculate_norms.cpp`),
+    used for on-the-fly norm-product filtering in the stack builder.
+    """
+    out = _block_norms(data)
+    return np.asarray(out, dtype=real_dtype_of(data.dtype))

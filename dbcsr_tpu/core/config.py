@@ -1,0 +1,81 @@
+"""Global configuration.
+
+Analog of the reference `dbcsr_cfg` singleton of typed CONF_PAR entries
+(`src/core/dbcsr_config.F:142-172`), with env-var overrides
+(``DBCSR_TPU_<NAME>``) and programmatic `set_config` like
+`dbcsr_set_config` (`src/dbcsr_api.F:174`).
+
+Knobs that only make sense for CUDA streams/OpenMP threads are replaced
+by their TPU-native equivalents (stack-size bucketing for jit-cache
+reuse, pallas kernel toggles, mesh defaults).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass
+class Config:
+    # --- multiply driver selection (ref MM_DRIVER {auto,matmul,blas,smm,xsmm},
+    #     dbcsr_config.F:34-38) -> here {auto, xla, pallas, dense}
+    mm_driver: str = "auto"
+    # max entries pushed to the device per kernel call before flushing
+    # (ref MM_STACK_SIZE: 30000 accel / 1000 CPU, dbcsr_config.F:77-79)
+    mm_stack_size: int = 30000
+    # use the fused pallas SMM kernel when available (ref: libsmm_acc JIT
+    # kernels vs cuBLAS loop)
+    use_pallas: bool = True
+    # validate pallas kernels against the XLA path on first use per
+    # (m,n,k,dtype), like libsmm_acc's JIT-time checksum validation
+    # (libsmm_acc.cpp:216)
+    validate_kernels: bool = True
+    # keep per-(m,n,k) flop statistics (ref STATISTICS block)
+    keep_stats: bool = True
+
+    def validate(self) -> None:
+        if self.mm_driver not in ("auto", "xla", "pallas", "dense"):
+            raise ValueError(f"unknown mm_driver {self.mm_driver!r}")
+        if self.mm_stack_size <= 0:
+            raise ValueError("mm_stack_size must be positive")
+
+
+_cfg = Config()
+
+
+def _apply_env(cfg: Config) -> None:
+    for f in dataclasses.fields(Config):
+        env = os.environ.get(f"DBCSR_TPU_{f.name.upper()}")
+        if env is None:
+            continue
+        if isinstance(getattr(cfg, f.name), bool):
+            setattr(cfg, f.name, env.lower() in ("1", "true", "yes"))
+        elif isinstance(getattr(cfg, f.name), int):
+            setattr(cfg, f.name, int(env))
+        elif isinstance(getattr(cfg, f.name), float):
+            setattr(cfg, f.name, float(env))
+        else:
+            setattr(cfg, f.name, env)
+
+
+_apply_env(_cfg)
+
+
+def get_config() -> Config:
+    return _cfg
+
+
+def set_config(**kwargs) -> None:
+    """Programmatic config update (ref `dbcsr_set_config`)."""
+    for k, v in kwargs.items():
+        if not hasattr(_cfg, k):
+            raise ValueError(f"unknown config key {k!r}")
+        setattr(_cfg, k, v)
+    _cfg.validate()
+
+
+def print_config(out=print) -> None:
+    """Ref `dbcsr_print_config`."""
+    for f in dataclasses.fields(Config):
+        out(f"  dbcsr_tpu.{f.name:<28} {getattr(_cfg, f.name)}")

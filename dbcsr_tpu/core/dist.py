@@ -1,0 +1,88 @@
+"""Process grids and block distributions.
+
+Analog of `dbcsr_mp_type` (2D process grid, `src/core/dbcsr_types.F:110-134`)
+and `dbcsr_distribution_type` (block-row/col -> process-row/col maps,
+`dbcsr_types.F:143-182`, methods in `src/dist/dbcsr_dist_methods.F`).
+
+TPU-native twist: the "process grid" is a 2D `jax.sharding.Mesh` axis
+pair instead of an MPI cartesian communicator; for the single-chip
+engine a trivial 1x1 grid is used and all blocks are local.  OpenMP
+thread distributions have no equivalent (device work is vectorized).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessGrid:
+    """2D grid of workers; optionally backed by a jax Mesh ('prow','pcol')."""
+
+    nprows: int = 1
+    npcols: int = 1
+    mesh: Optional[object] = None  # jax.sharding.Mesh, lazy to keep import light
+
+    @property
+    def nprocs(self) -> int:
+        return self.nprows * self.npcols
+
+    @staticmethod
+    def from_mesh(mesh, row_axis: str = "prow", col_axis: str = "pcol") -> "ProcessGrid":
+        return ProcessGrid(
+            nprows=mesh.shape[row_axis], npcols=mesh.shape[col_axis], mesh=mesh
+        )
+
+
+class Distribution:
+    """Maps each block row/col to a grid row/col.
+
+    Ref `dbcsr_distribution_new` (`src/dist/dbcsr_dist_methods.F:49`).
+    """
+
+    def __init__(self, row_dist, col_dist, grid: Optional[ProcessGrid] = None):
+        self.row_dist = np.ascontiguousarray(row_dist, dtype=np.int32)
+        self.col_dist = np.ascontiguousarray(col_dist, dtype=np.int32)
+        self.grid = grid or ProcessGrid()
+        if self.row_dist.size and self.row_dist.max(initial=0) >= self.grid.nprows:
+            raise ValueError("row_dist entry exceeds grid rows")
+        if self.col_dist.size and self.col_dist.max(initial=0) >= self.grid.npcols:
+            raise ValueError("col_dist entry exceeds grid cols")
+
+    @property
+    def nblkrows(self) -> int:
+        return len(self.row_dist)
+
+    @property
+    def nblkcols(self) -> int:
+        return len(self.col_dist)
+
+    def local_rows(self, prow: int) -> np.ndarray:
+        return np.nonzero(self.row_dist == prow)[0]
+
+    def local_cols(self, pcol: int) -> np.ndarray:
+        return np.nonzero(self.col_dist == pcol)[0]
+
+    def transposed(self) -> "Distribution":
+        """Ref `dbcsr_transpose_distribution` (`dbcsr_dist_operations.F:55`)."""
+        grid = ProcessGrid(self.grid.npcols, self.grid.nprows, self.grid.mesh)
+        return Distribution(self.col_dist, self.row_dist, grid)
+
+    @staticmethod
+    def trivial(nblkrows: int, nblkcols: int) -> "Distribution":
+        return Distribution(
+            np.zeros(nblkrows, np.int32), np.zeros(nblkcols, np.int32), ProcessGrid()
+        )
+
+
+def random_dist(nblks: int, nbins: int, seed: int = 0) -> np.ndarray:
+    """Ref `dbcsr_random_dist` (tests/dbcsr_performance_multiply.F)."""
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, nbins, size=nblks).astype(np.int32)
+
+
+def cyclic_dist(nblks: int, nbins: int) -> np.ndarray:
+    return (np.arange(nblks) % nbins).astype(np.int32)

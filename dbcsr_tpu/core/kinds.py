@@ -1,0 +1,58 @@
+"""Datatype kinds.
+
+Mirrors the reference datatype enum (`src/acc/acc_libsmm.h:31-36`:
+{r4=1, r8=3, c4=5, c8=7}) and the kind constants of
+`src/base/dbcsr_kinds.F`, mapped onto JAX dtypes.  bfloat16 is an extra,
+TPU-native kind with no reference counterpart (the MXU's native input
+type); float64/complex128 are kept for CP2K-equivalent semantics and run
+on TPU via XLA's f64 emulation (or on CPU backends natively).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Reference enum values (acc_libsmm.h:31-36), kept numerically identical
+# so .perf files and the C shim agree with the reference.
+dbcsr_type_real_4 = 1
+dbcsr_type_real_8 = 3
+dbcsr_type_complex_4 = 5
+dbcsr_type_complex_8 = 7
+dbcsr_type_bfloat16 = 9  # TPU-native extension
+
+_ENUM_TO_DTYPE = {
+    dbcsr_type_real_4: np.float32,
+    dbcsr_type_real_8: np.float64,
+    dbcsr_type_complex_4: np.complex64,
+    dbcsr_type_complex_8: np.complex128,
+    dbcsr_type_bfloat16: jnp.bfloat16,
+}
+
+_DTYPE_TO_ENUM = {np.dtype(v): k for k, v in _ENUM_TO_DTYPE.items()}
+
+
+def dtype_of(kind) -> np.dtype:
+    """Resolve a dbcsr kind enum, dtype, or string to a numpy dtype."""
+    if isinstance(kind, int):
+        return np.dtype(_ENUM_TO_DTYPE[kind])
+    return np.dtype(kind)
+
+
+def enum_of(dtype) -> int:
+    """Inverse of :func:`dtype_of`."""
+    return _DTYPE_TO_ENUM[np.dtype(dtype)]
+
+
+def is_complex(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.complexfloating)
+
+
+def real_dtype_of(dtype) -> np.dtype:
+    """The real dtype with matching precision (for norms)."""
+    d = np.dtype(dtype)
+    if d == np.complex64:
+        return np.dtype(np.float32)
+    if d == np.complex128:
+        return np.dtype(np.float64)
+    return d

@@ -1,0 +1,47 @@
+"""Library lifecycle.
+
+Analog of `dbcsr_init_lib` / `dbcsr_finalize_lib`
+(`src/core/dbcsr_lib.F:108-366`).  The reference's per-rank GPU
+round-robin device pick, acc_init, and per-thread pool setup collapse
+into: enable 64-bit dtypes (this is a double-precision library) and
+reset statistics.  Auto-initialization on first use is provided because
+there is no Fortran-style hard ordering requirement in Python.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from dbcsr_tpu.core import stats
+from dbcsr_tpu.core import timings
+
+_initialized = False
+
+
+def init_lib(enable_x64: bool = True) -> None:
+    global _initialized
+    if _initialized:
+        return
+    if enable_x64:
+        jax.config.update("jax_enable_x64", True)
+    _initialized = True
+
+
+def ensure_init() -> None:
+    if not _initialized:
+        init_lib()
+
+
+def finalize_lib(print_stats: bool = False, out=print) -> None:
+    global _initialized
+    if print_stats:
+        print_statistics(out=out)
+    stats.reset()
+    timings.reset()
+    _initialized = False
+
+
+def print_statistics(out=print) -> None:
+    """Ref `dbcsr_print_statistics` (`src/core/dbcsr_lib.F:326`)."""
+    stats.print_statistics(out=out)
+    timings.report(out=out)

@@ -1,0 +1,389 @@
+"""The block-sparse matrix type.
+
+Analog of `dbcsr_type` (`src/core/dbcsr_types.F:363-461`): a CSR index
+over blocks plus block data.  TPU-first data model (SURVEY §7 design
+mapping):
+
+* Host index (NumPy): sorted int64 keys ``row * nblkcols + col`` with a
+  derived ``row_ptr`` — the reference's row_p/col_i/blk_p triplet.
+* Device data (HBM): one jax array per distinct block shape, of shape
+  ``(capacity, bm, bn)`` — "shape bins".  The reference enumerates block
+  sizes the same way (`dbcsr_mm_common.F:309` enumerate_blk_sizes);
+  binning keeps every kernel launch statically shaped for XLA while
+  supporting arbitrary mixed block sizes.  ``capacity >= count`` is
+  bucketed (mempool analog) so repeated multiplies reuse compiled code.
+* Assembly goes through a host-side work buffer then `finalize()`, like
+  the reference's work matrices -> `dbcsr_finalize`
+  (`src/work/dbcsr_work_operations.F:749`).
+
+Symmetric/antisymmetric/hermitian matrices store the canonical upper
+triangle only (row <= col), as the reference does; `put_block` folds
+lower-triangle writes onto the stored transpose.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dbcsr_tpu.core.dist import Distribution
+from dbcsr_tpu.core.kinds import dtype_of, is_complex
+from dbcsr_tpu.core.lib import ensure_init
+from dbcsr_tpu.utils.rounding import bucket_size
+
+# matrix_type flags, ref dbcsr_type_no_symmetry/_symmetric/_antisymmetric/
+# _hermitian in src/core/dbcsr_types.F
+NO_SYMMETRY = "N"
+SYMMETRIC = "S"
+ANTISYMMETRIC = "A"
+HERMITIAN = "H"
+
+
+@dataclasses.dataclass
+class _Bin:
+    """One block-shape bin: device array of same-shape blocks."""
+
+    shape: Tuple[int, int]
+    data: object  # jnp.ndarray (capacity, bm, bn)
+    count: int
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+
+def _fold_block(block: np.ndarray, matrix_type: str) -> np.ndarray:
+    """Transform a lower-triangle block to its stored upper-triangle image."""
+    if matrix_type == SYMMETRIC:
+        return block.T
+    if matrix_type == ANTISYMMETRIC:
+        return -block.T
+    if matrix_type == HERMITIAN:
+        return block.conj().T
+    raise AssertionError(matrix_type)
+
+
+class BlockSparseMatrix:
+    """A distributed block-compressed sparse row matrix."""
+
+    def __init__(
+        self,
+        name: str,
+        row_blk_sizes,
+        col_blk_sizes,
+        dtype=np.float64,
+        dist: Optional[Distribution] = None,
+        matrix_type: str = NO_SYMMETRY,
+    ):
+        ensure_init()
+        self.name = name
+        self.row_blk_sizes = np.ascontiguousarray(row_blk_sizes, np.int32)
+        self.col_blk_sizes = np.ascontiguousarray(col_blk_sizes, np.int32)
+        self.dtype = dtype_of(dtype)
+        self.matrix_type = matrix_type
+        if matrix_type != NO_SYMMETRY:
+            if len(self.row_blk_sizes) != len(self.col_blk_sizes) or not np.array_equal(
+                self.row_blk_sizes, self.col_blk_sizes
+            ):
+                raise ValueError("symmetric matrix needs identical row/col blocking")
+            if matrix_type == HERMITIAN and not is_complex(self.dtype):
+                matrix_type = self.matrix_type = SYMMETRIC
+        self.dist = dist or Distribution.trivial(
+            len(self.row_blk_sizes), len(self.col_blk_sizes)
+        )
+        assert self.dist.nblkrows == self.nblkrows
+        assert self.dist.nblkcols == self.nblkcols
+        # finalized index
+        self.keys = np.empty(0, np.int64)
+        self.row_ptr = np.zeros(self.nblkrows + 1, np.int64)
+        self.ent_bin = np.empty(0, np.int32)
+        self.ent_slot = np.empty(0, np.int32)
+        self.bins: List[_Bin] = []
+        self._shape_to_bin: Dict[Tuple[int, int], int] = {}
+        self.valid = True
+        # pre-finalize work buffer: (row, col) -> host block
+        self._work: Dict[Tuple[int, int], np.ndarray] = {}
+
+    # ---------------------------------------------------------------- shape
+    @property
+    def nblkrows(self) -> int:
+        return len(self.row_blk_sizes)
+
+    @property
+    def nblkcols(self) -> int:
+        return len(self.col_blk_sizes)
+
+    @property
+    def nfullrows(self) -> int:
+        return int(self.row_blk_sizes.sum())
+
+    @property
+    def nfullcols(self) -> int:
+        return int(self.col_blk_sizes.sum())
+
+    @property
+    def row_blk_offsets(self) -> np.ndarray:
+        return np.concatenate([[0], np.cumsum(self.row_blk_sizes)]).astype(np.int64)
+
+    @property
+    def col_blk_offsets(self) -> np.ndarray:
+        return np.concatenate([[0], np.cumsum(self.col_blk_sizes)]).astype(np.int64)
+
+    @property
+    def nblks(self) -> int:
+        return len(self.keys)
+
+    @property
+    def nnz(self) -> int:
+        rows, cols = self.entry_coords()
+        return int(
+            (self.row_blk_sizes[rows].astype(np.int64) * self.col_blk_sizes[cols]).sum()
+        )
+
+    def occupation(self) -> float:
+        """Fraction of nonzero elements (ref dbcsr_get_occupation)."""
+        full = self.nfullrows * self.nfullcols
+        return self.nnz / full if full else 0.0
+
+    def block_shape(self, row: int, col: int) -> Tuple[int, int]:
+        return int(self.row_blk_sizes[row]), int(self.col_blk_sizes[col])
+
+    def entry_coords(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(rows, cols) arrays for all finalized entries, key-ordered."""
+        return (
+            (self.keys // self.nblkcols).astype(np.int64),
+            (self.keys % self.nblkcols).astype(np.int64),
+        )
+
+    # ------------------------------------------------------------- assembly
+    def put_block(self, row: int, col: int, block, summation: bool = False) -> None:
+        """Stage a block for the next `finalize` (ref `dbcsr_put_block`,
+        `src/block/dbcsr_block_access.F:73-76`)."""
+        row, col, block = self._canonicalize(row, col, np.asarray(block))
+        bm, bn = self.block_shape(row, col)
+        if block.shape != (bm, bn):
+            raise ValueError(
+                f"block ({row},{col}) has shape {block.shape}, expected {(bm, bn)}"
+            )
+        block = block.astype(self.dtype, copy=True)
+        key = (row, col)
+        if summation and key in self._work:
+            self._work[key] = self._work[key] + block
+        elif summation and self._find_entry(row, col) >= 0:
+            existing = self.get_block(row, col)
+            self._work[key] = existing + block
+        else:
+            self._work[key] = block
+        self.valid = False
+
+    def reserve_block(self, row: int, col: int) -> None:
+        """Ref `dbcsr_reserve_block2d`: allocate a zero block."""
+        row, col, _ = self._canonicalize(row, col, None)
+        if (row, col) not in self._work and self._find_entry(row, col) < 0:
+            self._work[(row, col)] = np.zeros(self.block_shape(row, col), self.dtype)
+            self.valid = False
+
+    def _canonicalize(self, row, col, block):
+        if not (0 <= row < self.nblkrows and 0 <= col < self.nblkcols):
+            raise IndexError(f"block ({row},{col}) out of range")
+        if self.matrix_type != NO_SYMMETRY and row > col:
+            if block is not None:
+                block = _fold_block(block, self.matrix_type)
+            row, col = col, row
+        return row, col, block
+
+    def finalize(self) -> "BlockSparseMatrix":
+        """Merge staged blocks into the CSR index (ref `dbcsr_finalize` ->
+        `dbcsr_merge_all`, `dbcsr_work_operations.F:749,1393`)."""
+        if not self._work:
+            self.valid = True
+            return self
+        new_keys = np.array(
+            [r * self.nblkcols + c for (r, c) in self._work], dtype=np.int64
+        )
+        merged = np.union1d(self.keys, new_keys)
+        # host copies of surviving old blocks
+        old_blocks = self._fetch_entry_blocks()
+        blocks: Dict[int, np.ndarray] = dict(zip(self.keys.tolist(), old_blocks))
+        for (r, c), blk in self._work.items():
+            blocks[r * self.nblkcols + c] = blk
+        self._work.clear()
+        self._set_structure(merged, [blocks[k] for k in merged.tolist()])
+        self.valid = True
+        return self
+
+    def _set_structure(self, keys: np.ndarray, host_blocks) -> None:
+        """Rebuild index + device bins from sorted keys and host blocks."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        n = len(keys)
+        rows = (keys // self.nblkcols).astype(np.int64)
+        cols = (keys % self.nblkcols).astype(np.int64)
+        self.keys = keys
+        self.row_ptr = np.zeros(self.nblkrows + 1, np.int64)
+        np.add.at(self.row_ptr, rows + 1, 1)
+        np.cumsum(self.row_ptr, out=self.row_ptr)
+        bin_ids, slots, shapes = _bin_entries(
+            self.row_blk_sizes, self.col_blk_sizes, rows, cols
+        )
+        self.ent_bin = bin_ids
+        self.ent_slot = slots
+        self.bins = []
+        self._shape_to_bin = {}
+        for b, (bm, bn) in enumerate(shapes):
+            mask = bin_ids == b
+            count = int(mask.sum())
+            cap = bucket_size(count)
+            host = np.zeros((cap, bm, bn), self.dtype)
+            if host_blocks is not None:
+                idx = np.nonzero(mask)[0]
+                for e in idx:
+                    host[slots[e]] = host_blocks[e]
+            self.bins.append(_Bin((int(bm), int(bn)), jnp.asarray(host), count))
+            self._shape_to_bin[(int(bm), int(bn))] = b
+
+    def set_structure_from_device(self, keys: np.ndarray, bins: List[_Bin]) -> None:
+        """Adopt a prebuilt index + device bins (used by the multiply
+        engine, which assembles C on device)."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        rows = (keys // self.nblkcols).astype(np.int64)
+        cols = (keys % self.nblkcols).astype(np.int64)
+        bin_ids, slots, shapes = _bin_entries(
+            self.row_blk_sizes, self.col_blk_sizes, rows, cols
+        )
+        self.keys = keys
+        self.row_ptr = np.zeros(self.nblkrows + 1, np.int64)
+        np.add.at(self.row_ptr, rows + 1, 1)
+        np.cumsum(self.row_ptr, out=self.row_ptr)
+        self.ent_bin = bin_ids
+        self.ent_slot = slots
+        by_shape = {b.shape: b for b in bins}
+        self.bins = [by_shape[(int(bm), int(bn))] for (bm, bn) in shapes]
+        self._shape_to_bin = {b.shape: i for i, b in enumerate(self.bins)}
+        self._work.clear()
+        self.valid = True
+
+    # --------------------------------------------------------------- access
+    def _find_entry(self, row: int, col: int) -> int:
+        key = row * self.nblkcols + col
+        i = np.searchsorted(self.keys, key)
+        if i < len(self.keys) and self.keys[i] == key:
+            return int(i)
+        return -1
+
+    def get_block(self, row: int, col: int, unfold: bool = True):
+        """Fetch one block to host; None if absent (ref `dbcsr_get_block_p`)."""
+        srow, scol = row, col
+        folded = False
+        if self.matrix_type != NO_SYMMETRY and row > col:
+            srow, scol, folded = col, row, True
+        if (srow, scol) in self._work:
+            blk = self._work[(srow, scol)].copy()
+        else:
+            e = self._find_entry(srow, scol)
+            if e < 0:
+                return None
+            b = self.bins[self.ent_bin[e]]
+            blk = np.asarray(b.data[self.ent_slot[e]])
+        if folded and unfold:
+            blk = _fold_block(blk, self.matrix_type)
+        return blk
+
+    def iterate_blocks(self) -> Iterator[Tuple[int, int, np.ndarray]]:
+        """Iterate stored blocks in index order (ref `dbcsr_iterator_*`,
+        `src/block/dbcsr_iterator_operations.F:91`).  Fetches each bin
+        from device once."""
+        if not self.valid:
+            raise RuntimeError("finalize() before iterating")
+        host_bins = [np.asarray(b.data[: b.count]) for b in self.bins]
+        rows, cols = self.entry_coords()
+        for e in range(self.nblks):
+            yield int(rows[e]), int(cols[e]), host_bins[self.ent_bin[e]][
+                self.ent_slot[e]
+            ]
+
+    def _fetch_entry_blocks(self) -> List[np.ndarray]:
+        """Host copies of all finalized blocks, key-ordered."""
+        host_bins = [np.asarray(b.data[: b.count]) if b.count else None for b in self.bins]
+        return [
+            host_bins[self.ent_bin[e]][self.ent_slot[e]] for e in range(self.nblks)
+        ]
+
+    def block_norms(self) -> np.ndarray:
+        """Frobenius norm per finalized entry, key-ordered (device compute)."""
+        from dbcsr_tpu.acc.smm import block_norms as _bn
+
+        out = np.zeros(self.nblks, np.float64)
+        for b_id, b in enumerate(self.bins):
+            if b.count == 0:
+                continue
+            norms = _bn(b.data)
+            mask = self.ent_bin == b_id
+            out[mask] = np.asarray(norms)[self.ent_slot[mask]]
+        return out
+
+    # ------------------------------------------------------------ structure
+    def copy(self, name: Optional[str] = None) -> "BlockSparseMatrix":
+        m = BlockSparseMatrix(
+            name or self.name,
+            self.row_blk_sizes,
+            self.col_blk_sizes,
+            self.dtype,
+            self.dist,
+            self.matrix_type,
+        )
+        m.keys = self.keys.copy()
+        m.row_ptr = self.row_ptr.copy()
+        m.ent_bin = self.ent_bin.copy()
+        m.ent_slot = self.ent_slot.copy()
+        m.bins = [_Bin(b.shape, b.data, b.count) for b in self.bins]
+        m._shape_to_bin = dict(self._shape_to_bin)
+        m._work = {k: v.copy() for k, v in self._work.items()}
+        m.valid = self.valid
+        return m
+
+    def map_bin_data(self, fn) -> None:
+        """Apply a jax fn to every bin's device data in place."""
+        for b in self.bins:
+            if b.count:
+                b.data = fn(b.data)
+
+    def zero_data(self) -> None:
+        self.map_bin_data(lambda d: jnp.zeros_like(d))
+
+    def __repr__(self) -> str:
+        return (
+            f"BlockSparseMatrix({self.name!r}, {self.nblkrows}x{self.nblkcols} blocks,"
+            f" {self.nblks} stored, dtype={np.dtype(self.dtype).name},"
+            f" type={self.matrix_type})"
+        )
+
+
+def _bin_entries(row_blk_sizes, col_blk_sizes, rows, cols):
+    """Assign each entry a shape-bin id and an in-bin slot (key order)."""
+    n = len(rows)
+    if n == 0:
+        return np.empty(0, np.int32), np.empty(0, np.int32), []
+    shapes = np.stack([row_blk_sizes[rows], col_blk_sizes[cols]], axis=1)
+    uniq, inv = np.unique(shapes, axis=0, return_inverse=True)
+    inv = inv.astype(np.int32)
+    counts = np.bincount(inv, minlength=len(uniq))
+    starts = np.concatenate([[0], np.cumsum(counts[:-1])])
+    order = np.argsort(inv, kind="stable")
+    slots = np.empty(n, np.int32)
+    slots[order] = (np.arange(n) - np.repeat(starts, counts)).astype(np.int32)
+    return inv, slots, [(int(s[0]), int(s[1])) for s in uniq]
+
+
+def create(
+    name: str,
+    row_blk_sizes,
+    col_blk_sizes,
+    dtype=np.float64,
+    dist: Optional[Distribution] = None,
+    matrix_type: str = NO_SYMMETRY,
+) -> BlockSparseMatrix:
+    """Ref `dbcsr_create` (`src/work/dbcsr_work_operations.F:106`)."""
+    return BlockSparseMatrix(name, row_blk_sizes, col_blk_sizes, dtype, dist, matrix_type)

@@ -1,0 +1,68 @@
+"""Multiplication statistics registry.
+
+Analog of the reference STATISTICS block: per-(m,n,k) flop counters with
+driver breakdown, stack counts and sizes (`src/mm/dbcsr_mm_sched.F:390-546`
+stats_add/collect/print), marketing-vs-true flops (`dbcsr_mm.F:664-667`).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+
+
+@dataclasses.dataclass
+class _MnkStat:
+    nstacks: int = 0
+    nentries: int = 0
+    flops: int = 0
+
+
+_by_mnk: dict = collections.defaultdict(_MnkStat)
+_totals = {"multiplies": 0, "flops": 0, "marketing_flops": 0}
+
+
+def record_stack(m: int, n: int, k: int, nentries: int) -> None:
+    from dbcsr_tpu.core.config import get_config
+
+    if not get_config().keep_stats:
+        return
+    st = _by_mnk[(m, n, k)]
+    st.nstacks += 1
+    st.nentries += nentries
+    st.flops += 2 * m * n * k * nentries
+
+
+def record_multiply(marketing_flops: int) -> None:
+    _totals["multiplies"] += 1
+    _totals["marketing_flops"] += marketing_flops
+
+
+def total_flops() -> int:
+    return sum(s.flops for s in _by_mnk.values())
+
+
+def reset() -> None:
+    _by_mnk.clear()
+    for k in _totals:
+        _totals[k] = 0
+
+
+def print_statistics(out=print) -> None:
+    """Format mirrors the reference's DBCSR STATISTICS table
+    (documented in `docs/guide/3-developer-guide/4-performance/1-insights.md`)."""
+    out(" " + "-" * 70)
+    out(" -" + "DBCSR-TPU STATISTICS".center(68) + "-")
+    out(" " + "-" * 70)
+    out(f" {'COUNT':>24} {'m x n x k':>14} {'entries':>12} {'GFLOP':>12}")
+    tot = 0
+    for (m, n, k), st in sorted(_by_mnk.items()):
+        tot += st.flops
+        out(
+            f" {st.nstacks:>24} {f'{m}x{n}x{k}':>14} {st.nentries:>12}"
+            f" {st.flops / 1e9:>12.3f}"
+        )
+    out(f" {'total (TPU stacks)':>24} {'':>14} {'':>12} {tot / 1e9:>12.3f}")
+    out(f" multiplications:       {_totals['multiplies']}")
+    out(f" marketing flops:       {_totals['marketing_flops'] / 1e9:.3f} GFLOP")
+    out(" " + "-" * 70)

@@ -1,0 +1,285 @@
+"""The multiply engine: C := alpha * op(A) * op(B) + beta * C.
+
+Analog of `dbcsr_multiply_generic` (`src/mm/dbcsr_mm.F:336-1030`),
+re-designed TPU-first:
+
+* The reference discovers C's pattern inside per-thread recursive
+  multiplies with hash-based block lookup (`dbcsr_mm_csr.F:178`);
+  here the full symbolic product is computed up front with vectorized
+  NumPy (the reference also keeps index work on CPU — SURVEY §7), so
+  device work is purely static-shaped batched compute.
+* Per-thread work matrices + stack flushing (`dbcsr_mm_multrec.F`,
+  `dbcsr_mm_sched.F`) collapse into: one parameter stack per
+  (m, n, k) shape-bin triple, sorted by C block then A entry, processed
+  by `dbcsr_tpu.acc.process_stack` in mm_stack_size chunks.
+* Accumulation order is fixed by the sort, giving bit-reproducible
+  results per run configuration (north-star checksum requirement).
+
+Filtering semantics follow the reference exactly (`dbcsr_mm.F:360-369`):
+on-the-fly skip when ||A_ik||²·||B_kj||² < (eps/max(1, row_count_A(i)))²
+with single-precision squared norms (`dbcsr_mm_cannon.F:1098-1105`,
+`dbcsr_mm_csr.F:276`, `calc_norms` at `dbcsr_mm_common.F:728`), and a
+final pass keeping blocks with ||C||² >= eps²
+(`dbcsr_mm_multrec.F:694-748`), skipped when retain_sparsity.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dbcsr_tpu.acc.smm import process_stack
+from dbcsr_tpu.core import stats
+from dbcsr_tpu.core.kinds import is_complex
+from dbcsr_tpu.core.matrix import (
+    NO_SYMMETRY,
+    BlockSparseMatrix,
+    _Bin,
+    _bin_entries,
+)
+from dbcsr_tpu.core.timings import timed
+from dbcsr_tpu.ops.operations import compress
+from dbcsr_tpu.ops.transformations import desymmetrize, new_transposed
+from dbcsr_tpu.utils.rounding import bucket_size
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _scatter_scaled(dst, src, src_slots, dst_slots, beta):
+    return dst.at[dst_slots].set(beta * jnp.take(src, src_slots, axis=0), mode="drop")
+
+
+def _effective(matrix: BlockSparseMatrix, trans: str) -> BlockSparseMatrix:
+    """Resolve op(X): desymmetrize + transpose/conjugate as needed
+    (ref transpose wrappers at `dbcsr_mm.F:521-582`)."""
+    trans = trans.upper()
+    m = desymmetrize(matrix) if matrix.matrix_type != NO_SYMMETRY else matrix
+    if trans == "N":
+        return m
+    if trans == "T":
+        return new_transposed(m)
+    if trans == "C":
+        return new_transposed(m, conjugate=is_complex(m.dtype))
+    raise ValueError(f"bad trans flag {trans!r}")
+
+
+def multiply(
+    transa: str,
+    transb: str,
+    alpha,
+    matrix_a: BlockSparseMatrix,
+    matrix_b: BlockSparseMatrix,
+    beta,
+    matrix_c: BlockSparseMatrix,
+    retain_sparsity: bool = False,
+    filter_eps: Optional[float] = None,
+    first_row: Optional[int] = None,
+    last_row: Optional[int] = None,
+    first_col: Optional[int] = None,
+    last_col: Optional[int] = None,
+    first_k: Optional[int] = None,
+    last_k: Optional[int] = None,
+) -> int:
+    """Multiply two block-sparse matrices; returns the true flop count.
+
+    The optional first/last row/col/k limits restrict the product to a
+    block-index submatrix (0-based, inclusive), mirroring the
+    `dbcsr_multiply` limit arguments.
+    """
+    with timed("multiply"):
+        for m in (matrix_a, matrix_b, matrix_c):
+            if not m.valid:
+                m.finalize()
+        # C may alias A or B (in-place squaring etc.): snapshot the input's
+        # index before C is restructured; device arrays are immutable and
+        # donation only touches C's freshly-built buffers, so a shallow
+        # copy suffices.
+        if matrix_a is matrix_c:
+            matrix_a = matrix_a.copy()
+        if matrix_b is matrix_c:
+            matrix_b = matrix_b.copy()
+        a = _effective(matrix_a, transa)
+        b = _effective(matrix_b, transb)
+        c = matrix_c
+        if not np.array_equal(a.col_blk_sizes, b.row_blk_sizes):
+            raise ValueError("inner blockings of op(A), op(B) differ")
+        if not np.array_equal(c.row_blk_sizes, a.row_blk_sizes):
+            raise ValueError("C row blocking != op(A) row blocking")
+        if not np.array_equal(c.col_blk_sizes, b.col_blk_sizes):
+            raise ValueError("C col blocking != op(B) col blocking")
+
+        with timed("multiply_index"):
+            cand = _candidates(
+                a, b, c, filter_eps,
+                first_row, last_row, first_col, last_col, first_k, last_k,
+            )
+            i, j, a_ent, b_ent = cand
+            # new C pattern
+            old_keys = c.keys
+            cand_keys = i * c.nblkcols + j
+            if retain_sparsity:
+                if len(old_keys) == 0:
+                    ok = np.zeros(len(cand_keys), bool)
+                else:
+                    pos = np.searchsorted(old_keys, cand_keys)
+                    ok = (pos < len(old_keys)) & (
+                        old_keys[np.minimum(pos, len(old_keys) - 1)] == cand_keys
+                    )
+                i, j, a_ent, b_ent = i[ok], j[ok], a_ent[ok], b_ent[ok]
+                cand_keys = cand_keys[ok]
+                new_keys = old_keys
+            else:
+                new_keys = np.union1d(old_keys, np.unique(cand_keys))
+
+        with timed("multiply_c_assemble"):
+            _rebuild_c(c, new_keys, beta)
+
+        with timed("multiply_stacks"):
+            flops = _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha)
+
+        if filter_eps is not None and not retain_sparsity:
+            with timed("multiply_filter"):
+                norms = c.block_norms()
+                compress(c, norms.astype(np.float64) ** 2 >= float(filter_eps) ** 2)
+
+        mflops = 2 * c.nfullrows * c.nfullcols * a.nfullcols
+        stats.record_multiply(mflops)
+        return int(flops)
+
+
+def _candidates(a, b, c, filter_eps, fr, lr, fc, lc, fk, lk):
+    """Vectorized symbolic product: all (i, k, j) triples as parallel
+    arrays (a_ent indexes op(A) entries, b_ent op(B) entries)."""
+    rows_a = np.repeat(
+        np.arange(a.nblkrows, dtype=np.int64), np.diff(a.row_ptr)
+    )
+    cols_a = (a.keys % a.nblkcols).astype(np.int64)  # k per A entry
+    cols_b = (b.keys % b.nblkcols).astype(np.int64)  # j per B entry
+
+    a_sel = np.ones(len(a.keys), bool)
+    if fr is not None:
+        a_sel &= rows_a >= fr
+    if lr is not None:
+        a_sel &= rows_a <= lr
+    if fk is not None:
+        a_sel &= cols_a >= fk
+    if lk is not None:
+        a_sel &= cols_a <= lk
+    a_entries = np.nonzero(a_sel)[0]
+
+    counts = (b.row_ptr[cols_a[a_entries] + 1] - b.row_ptr[cols_a[a_entries]]).astype(
+        np.int64
+    )
+    tot = int(counts.sum())
+    a_ent = np.repeat(a_entries, counts)
+    if tot == 0:
+        z = np.empty(0, np.int64)
+        return z, z, z, z
+    ends = np.cumsum(counts)
+    starts = ends - counts
+    b_ent = (
+        np.arange(tot, dtype=np.int64)
+        - np.repeat(starts, counts)
+        + np.repeat(b.row_ptr[cols_a[a_entries]], counts)
+    )
+    i = rows_a[a_ent]
+    j = cols_b[b_ent]
+
+    keep = np.ones(tot, bool)
+    if fc is not None:
+        keep &= j >= fc
+    if lc is not None:
+        keep &= j <= lc
+    if c.matrix_type != NO_SYMMETRY:
+        # don't compute the redundant triangle (ref symmetric skip,
+        # dbcsr_mm_csr.F:281)
+        keep &= i <= j
+    if filter_eps is not None:
+        # squared f32 norms, per-A-row eps (ref dbcsr_mm_cannon.F:1098-1105)
+        na2 = a.block_norms().astype(np.float32) ** 2
+        nb2 = b.block_norms().astype(np.float32) ** 2
+        row_counts = np.diff(a.row_ptr)
+        with np.errstate(over="ignore"):  # huge eps -> inf is a valid threshold
+            row_eps = (
+                np.float32(filter_eps) / np.maximum(1, row_counts).astype(np.float32)
+            ) ** 2
+        keep &= na2[a_ent] * nb2[b_ent] >= row_eps[i]
+    if not keep.all():
+        i, j, a_ent, b_ent = i[keep], j[keep], a_ent[keep], b_ent[keep]
+    return i, j, a_ent, b_ent
+
+
+def _rebuild_c(c: BlockSparseMatrix, new_keys: np.ndarray, beta) -> None:
+    """Re-structure C on the (possibly grown) pattern with data beta-scaled."""
+    old_keys = c.keys
+    old_bins = c.bins
+    old_ent_bin = c.ent_bin
+    old_ent_slot = c.ent_slot
+    rows = (new_keys // c.nblkcols).astype(np.int64)
+    cols = (new_keys % c.nblkcols).astype(np.int64)
+    nb, nsl, shapes = _bin_entries(c.row_blk_sizes, c.col_blk_sizes, rows, cols)
+    beta_dev = jnp.asarray(beta, dtype=c.dtype)
+    pos_old = np.searchsorted(new_keys, old_keys)  # old keys ⊆ new keys
+    bins = []
+    for b_id, (bm, bn) in enumerate(shapes):
+        count = int((nb == b_id).sum())
+        cap = bucket_size(count)
+        data = jnp.zeros((cap, bm, bn), c.dtype)
+        sel = np.nonzero((nb[pos_old] == b_id) if len(old_keys) else [])[0]
+        if len(sel) and beta != 0:
+            src_bin = old_bins[old_ent_bin[sel[0]]]
+            data = _scatter_scaled(
+                data,
+                src_bin.data,
+                jnp.asarray(old_ent_slot[sel]),
+                jnp.asarray(nsl[pos_old[sel]]),
+                beta_dev,
+            )
+        bins.append(_Bin((bm, bn), data, count))
+    c.set_structure_from_device(new_keys, bins)
+
+
+def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha) -> int:
+    """Group candidate triples by (m,n,k) shape-bin, sort by C block, run
+    the SMM kernel per group; returns true flops."""
+    if len(cand_keys) == 0:
+        return 0
+    c_ent = np.searchsorted(c.keys, cand_keys)
+    cb = c.ent_bin[c_ent]
+    ab = a.ent_bin[a_ent]
+    bb = b.ent_bin[b_ent]
+    c_slot = c.ent_slot[c_ent]
+    a_slot = a.ent_slot[a_ent]
+    b_slot = b.ent_slot[b_ent]
+    g = (cb.astype(np.int64) * len(a.bins) + ab) * len(b.bins) + bb
+    order = np.lexsort((a_ent, c_slot, g))
+    g = g[order]
+    c_slot = c_slot[order]
+    a_slot = a_slot[order]
+    b_slot = b_slot[order]
+    cb = cb[order]
+    ab = ab[order]
+    bb = bb[order]
+    uniq, first = np.unique(g, return_index=True)
+    bounds = np.append(first, len(g))
+    flops = 0
+    for gi in range(len(uniq)):
+        s0, s1 = int(bounds[gi]), int(bounds[gi + 1])
+        cbin, abin, bbin = int(cb[s0]), int(ab[s0]), int(bb[s0])
+        m, k = a.bins[abin].shape
+        _, n = b.bins[bbin].shape
+        c.bins[cbin].data = process_stack(
+            c.bins[cbin].data,
+            a.bins[abin].data,
+            b.bins[bbin].data,
+            a_slot[s0:s1],
+            b_slot[s0:s1],
+            c_slot[s0:s1],
+            alpha,
+        )
+        stats.record_stack(m, n, k, s1 - s0)
+        flops += 2 * m * n * k * (s1 - s0)
+    return flops

@@ -1,0 +1,26 @@
+"""Size bucketing.
+
+TPU-native replacement for the reference mempool + data-area resize
+machinery (`src/data/dbcsr_data_types.F:62-81`, resize factor 1.2):
+device array extents are rounded up to a coarse bucket so repeated
+multiplies with slightly different sparsity hit the XLA jit cache
+instead of recompiling.
+"""
+
+from __future__ import annotations
+
+
+def bucket_size(n: int, minimum: int = 16) -> int:
+    """Round ``n`` up to {1,2,4,...}×2^k with ~25% max slack."""
+    if n <= 0:
+        return 0
+    if n <= minimum:
+        return minimum
+    # next value of form {4,5,6,7} * 2^k  (<=25% over-allocation)
+    k = max((n - 1).bit_length() - 3, 0)
+    step = 1 << k
+    return ((n + step - 1) // step) * step
+
+
+def ceil_div(a: int, b: int) -> int:
+    return -(-a // b)

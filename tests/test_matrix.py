@@ -1,0 +1,118 @@
+"""BCSR matrix type tests: assembly, access, iteration, conversion."""
+
+import numpy as np
+import pytest
+
+from dbcsr_tpu import BlockSparseMatrix, create, from_dense, make_random_matrix, to_dense
+from dbcsr_tpu.core.matrix import ANTISYMMETRIC, SYMMETRIC
+
+
+def test_create_put_finalize_get():
+    m = create("m", [2, 3, 4], [3, 2], np.float64)
+    b01 = np.arange(4.0).reshape(2, 2)
+    b20 = np.ones((4, 3))
+    m.put_block(0, 1, b01)
+    m.put_block(2, 0, b20)
+    m.finalize()
+    assert m.nblks == 2
+    assert m.nnz == 4 + 12
+    np.testing.assert_array_equal(m.get_block(0, 1), b01)
+    np.testing.assert_array_equal(m.get_block(2, 0), b20)
+    assert m.get_block(1, 1) is None
+
+
+def test_put_block_summation():
+    m = create("m", [2], [2])
+    m.put_block(0, 0, np.eye(2))
+    m.finalize()
+    m.put_block(0, 0, np.eye(2), summation=True)
+    m.finalize()
+    np.testing.assert_array_equal(m.get_block(0, 0), 2 * np.eye(2))
+    m.put_block(0, 0, np.eye(2))  # replace, not sum
+    m.finalize()
+    np.testing.assert_array_equal(m.get_block(0, 0), np.eye(2))
+
+
+def test_wrong_shape_rejected():
+    m = create("m", [2, 3], [3])
+    with pytest.raises(ValueError):
+        m.put_block(0, 0, np.zeros((3, 3)))
+    with pytest.raises(IndexError):
+        m.put_block(5, 0, np.zeros((2, 3)))
+
+
+def test_iterator_order_and_content():
+    rng = np.random.default_rng(0)
+    m = make_random_matrix("r", [3, 5, 2], [4, 3], occupation=1.0, rng=rng)
+    seen = [(r, c) for r, c, _ in m.iterate_blocks()]
+    assert seen == sorted(seen)  # row-major order
+    assert len(seen) == 6
+
+
+def test_dense_roundtrip():
+    rng = np.random.default_rng(1)
+    m = make_random_matrix("r", [3, 5, 2], [4, 3, 1], occupation=0.6, rng=rng)
+    d = to_dense(m)
+    m2 = from_dense("r2", d, [3, 5, 2], [4, 3, 1])
+    np.testing.assert_array_equal(to_dense(m2), d)
+
+
+def test_mixed_block_sizes_binning():
+    rng = np.random.default_rng(2)
+    sizes = [5, 13, 23, 5, 13]
+    m = make_random_matrix("mix", sizes, sizes, occupation=1.0, rng=rng)
+    # 3 distinct sizes -> up to 9 shape bins
+    assert len(m.bins) == 9
+    assert sum(b.count for b in m.bins) == 25
+    d = to_dense(m)
+    assert d.shape == (59, 59)
+
+
+def test_symmetric_storage_and_unfold():
+    rng = np.random.default_rng(3)
+    m = make_random_matrix("s", [2, 3], [2, 3], occupation=1.0,
+                           matrix_type=SYMMETRIC, rng=rng)
+    d = to_dense(m)
+    np.testing.assert_allclose(d, d.T)
+    # lower-triangle access unfolds the stored transpose
+    np.testing.assert_allclose(m.get_block(1, 0), m.get_block(0, 1).T)
+
+
+def test_symmetric_put_lower_folds():
+    m = create("s", [2, 2], [2, 2], matrix_type=SYMMETRIC)
+    blk = np.arange(4.0).reshape(2, 2)
+    m.put_block(1, 0, blk)
+    m.finalize()
+    np.testing.assert_array_equal(m.get_block(0, 1), blk.T)
+    np.testing.assert_array_equal(m.get_block(1, 0), blk)
+
+
+def test_antisymmetric_dense():
+    rng = np.random.default_rng(4)
+    m = make_random_matrix("a", [3, 2], [3, 2], occupation=1.0,
+                           matrix_type=ANTISYMMETRIC, rng=rng)
+    d = to_dense(m)
+    np.testing.assert_allclose(d, -d.T)
+
+
+def test_occupation():
+    m = create("m", [2, 2], [2, 2])
+    m.put_block(0, 0, np.ones((2, 2)))
+    m.finalize()
+    assert m.occupation() == pytest.approx(0.25)
+
+
+def test_complex_dtype():
+    rng = np.random.default_rng(5)
+    m = make_random_matrix("c", [3, 4], [2, 5], dtype=np.complex128,
+                           occupation=1.0, rng=rng)
+    d = to_dense(m)
+    assert d.dtype == np.complex128
+    assert np.abs(d.imag).sum() > 0
+
+
+def test_reserve_block():
+    m = create("m", [2, 3], [2, 3])
+    m.reserve_block(1, 1)
+    m.finalize()
+    np.testing.assert_array_equal(m.get_block(1, 1), np.zeros((3, 3)))

@@ -1,0 +1,221 @@
+"""Multiply engine tests: the dense-oracle pattern of
+`tests/dbcsr_test_multiply.F` (densify, BLAS product, compare within eps),
+sweeping alpha/beta, transposes, limits, symmetry, dtypes — modeled on the
+named cases of `dbcsr_unittest1.F:79-293`."""
+
+import numpy as np
+import pytest
+
+from dbcsr_tpu import create, make_random_matrix, multiply, to_dense
+from dbcsr_tpu.core.matrix import SYMMETRIC
+from dbcsr_tpu.ops.test_methods import checksum, impose_sparsity
+
+RBS = [2, 3, 5, 4]
+CBS = [3, 4, 2]
+KBS = [4, 2, 3, 5]
+
+
+def _rand(name, rbs, cbs, occ, dtype=np.float64, seed=0, mtype="N"):
+    return make_random_matrix(
+        name, rbs, cbs, dtype=dtype, occupation=occ,
+        matrix_type=mtype, rng=np.random.default_rng(seed),
+    )
+
+
+def _dense_op(m, trans):
+    d = to_dense(m)
+    if trans == "N":
+        return d
+    if trans == "T":
+        return d.T
+    return d.conj().T
+
+
+@pytest.mark.parametrize("transa", ["N", "T"])
+@pytest.mark.parametrize("transb", ["N", "T"])
+@pytest.mark.parametrize("occ", [0.3, 1.0])
+def test_multiply_transposes(transa, transb, occ):
+    a = _rand("a", RBS if transa == "N" else KBS, KBS if transa == "N" else RBS, occ, seed=1)
+    b = _rand("b", KBS if transb == "N" else CBS, CBS if transb == "N" else KBS, occ, seed=2)
+    c = create("c", RBS, CBS)
+    multiply(transa, transb, 1.0, a, b, 0.0, c)
+    want = _dense_op(a, transa) @ _dense_op(b, transb)
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("alpha,beta", [(1.0, 0.0), (2.5, 1.0), (-1.0, 0.5), (0.0, 2.0)])
+def test_multiply_alpha_beta(alpha, beta):
+    a = _rand("a", RBS, KBS, 0.5, seed=3)
+    b = _rand("b", KBS, CBS, 0.5, seed=4)
+    c = _rand("c", RBS, CBS, 0.5, seed=5)
+    c0 = to_dense(c)
+    multiply("N", "N", alpha, a, b, beta, c)
+    want = alpha * (to_dense(a) @ to_dense(b)) + beta * c0
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("dtype,trans", [
+    (np.float32, "N"),
+    (np.complex128, "N"),
+    (np.complex128, "C"),
+    (np.complex64, "T"),
+])
+def test_multiply_dtypes(dtype, trans):
+    a = _rand("a", RBS if trans == "N" else KBS, KBS if trans == "N" else RBS,
+              0.6, dtype=dtype, seed=6)
+    b = _rand("b", KBS, CBS, 0.6, dtype=dtype, seed=7)
+    c = create("c", RBS, CBS, dtype=dtype)
+    multiply(trans, "N", 1.0, a, b, 0.0, c)
+    want = _dense_op(a, trans) @ to_dense(b)
+    rtol = 2e-5 if np.dtype(dtype).itemsize <= 8 else 1e-12  # f32 + c64 loose
+    np.testing.assert_allclose(to_dense(c), want, rtol=rtol, atol=rtol)
+
+
+def test_multiply_accumulates_pattern_union():
+    """C keeps its old blocks (beta) and gains product blocks."""
+    a = _rand("a", RBS, KBS, 0.2, seed=8)
+    b = _rand("b", KBS, CBS, 0.2, seed=9)
+    c = _rand("c", RBS, CBS, 0.2, seed=10)
+    c0 = to_dense(c)
+    multiply("N", "N", 1.0, a, b, 1.0, c)
+    np.testing.assert_allclose(to_dense(c), to_dense(a) @ to_dense(b) + c0,
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_retain_sparsity():
+    """ref retain_sparsity: C's pattern is frozen (dbcsr_test_multiply.F:633)."""
+    a = _rand("a", RBS, KBS, 0.8, seed=11)
+    b = _rand("b", KBS, CBS, 0.8, seed=12)
+    c = _rand("c", RBS, CBS, 0.3, seed=13)
+    pattern_before = set(map(tuple, zip(*c.entry_coords())))
+    c0 = to_dense(c)
+    multiply("N", "N", 1.0, a, b, 1.0, c, retain_sparsity=True)
+    pattern_after = set(map(tuple, zip(*c.entry_coords())))
+    assert pattern_after == pattern_before
+    want = impose_sparsity(to_dense(a) @ to_dense(b) + c0, c)
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("limits", [
+    dict(first_row=1, last_row=2),
+    dict(first_col=0, last_col=1),
+    dict(first_k=1, last_k=2),
+    dict(first_row=1, last_row=3, first_col=1, last_col=2, first_k=0, last_k=1),
+])
+def test_multiply_limits(limits):
+    """ref multiply_LIMITS cases (dbcsr_unittest1.F): block-index submatrix."""
+    a = _rand("a", RBS, KBS, 1.0, seed=14)
+    b = _rand("b", KBS, CBS, 1.0, seed=15)
+    c = create("c", RBS, CBS)
+    multiply("N", "N", 1.0, a, b, 0.0, c, **limits)
+    da, db = to_dense(a), to_dense(b)
+    roff = np.concatenate([[0], np.cumsum(RBS)])
+    coff = np.concatenate([[0], np.cumsum(CBS)])
+    koff = np.concatenate([[0], np.cumsum(KBS)])
+    r0 = roff[limits.get("first_row", 0)]
+    r1 = roff[limits.get("last_row", len(RBS) - 1) + 1]
+    c0_ = coff[limits.get("first_col", 0)]
+    c1 = coff[limits.get("last_col", len(CBS) - 1) + 1]
+    k0 = koff[limits.get("first_k", 0)]
+    k1 = koff[limits.get("last_k", len(KBS) - 1) + 1]
+    want = np.zeros((sum(RBS), sum(CBS)))
+    want[r0:r1, c0_:c1] = da[r0:r1, k0:k1] @ db[k0:k1, c0_:c1]
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-12, atol=1e-12)
+
+
+def test_multiply_symmetric_inputs():
+    """Symmetric A stored triangular must multiply as its full self."""
+    n = [2, 3, 4]
+    a = _rand("a", n, n, 1.0, seed=16, mtype=SYMMETRIC)
+    b = _rand("b", n, CBS, 0.7, seed=17)
+    c = create("c", n, CBS)
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    np.testing.assert_allclose(to_dense(c), to_dense(a) @ to_dense(b),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_multiply_symmetric_product():
+    """C declared symmetric stores only the canonical triangle."""
+    n = [2, 3]
+    a = _rand("a", n, n, 1.0, seed=18)
+    at = to_dense(a)
+    # build B = A^T so product A@A^T is symmetric
+    c = create("c", n, n, matrix_type=SYMMETRIC)
+    multiply("N", "T", 1.0, a, a, 0.0, c)
+    rows, cols = c.entry_coords()
+    assert (rows <= cols).all()
+    np.testing.assert_allclose(to_dense(c), at @ at.T, rtol=1e-12, atol=1e-12)
+
+
+def test_filter_eps_final_pass():
+    a = _rand("a", RBS, KBS, 0.6, seed=19)
+    b = _rand("b", KBS, CBS, 0.6, seed=20)
+    c = create("c", RBS, CBS)
+    eps = 1e30  # absurdly large: every block filtered
+    multiply("N", "N", 1.0, a, b, 0.0, c, filter_eps=eps)
+    assert c.nblks == 0
+    # tiny eps: nothing filtered
+    c2 = create("c2", RBS, CBS)
+    multiply("N", "N", 1.0, a, b, 0.0, c2, filter_eps=1e-30)
+    np.testing.assert_allclose(to_dense(c2), to_dense(a) @ to_dense(b),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_multiply_deterministic_checksum():
+    """Bit-identical checksums across repeats (north-star requirement)."""
+    a = _rand("a", [5, 13, 23], [5, 13, 23], 0.5, seed=21)
+    b = _rand("b", [5, 13, 23], [5, 13, 23], 0.5, seed=22)
+    sums = []
+    for _ in range(3):
+        c = create("c", [5, 13, 23], [5, 13, 23])
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+        sums.append(checksum(c))
+    assert sums[0] == sums[1] == sums[2]
+
+
+def test_multiply_flop_count():
+    a = _rand("a", [2, 2], [2, 2], 1.0, seed=23)
+    b = _rand("b", [2, 2], [2, 2], 1.0, seed=24)
+    c = create("c", [2, 2], [2, 2])
+    flops = multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert flops == 2 * 4 * 4 * 4  # dense 4x4x4 in 2x2 blocks
+
+
+def test_multiply_empty_matrices():
+    a = create("a", RBS, KBS).finalize()
+    b = _rand("b", KBS, CBS, 0.5, seed=25)
+    c = create("c", RBS, CBS)
+    flops = multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert flops == 0
+    assert c.nblks == 0
+
+
+def test_multiply_mixed_block_sizes_stress():
+    """ref dbcsr_unittest3 flavor: block-size triplets incl. odd sizes."""
+    rbs = [1, 3, 4, 23]
+    kbs = [7, 1, 45, 2]
+    cbs = [13, 23, 1]
+    a = _rand("a", rbs, kbs, 0.9, seed=26)
+    b = _rand("b", kbs, cbs, 0.9, seed=27)
+    c = create("c", rbs, cbs)
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    np.testing.assert_allclose(to_dense(c), to_dense(a) @ to_dense(b),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_multiply_aliased_c_is_a():
+    """In-place squaring: C aliasing A must not corrupt the engine."""
+    n = [2, 3]
+    a = _rand("a", n, n, 1.0, seed=30)
+    d = to_dense(a)
+    multiply("N", "N", 1.0, a, a, 0.0, a)
+    np.testing.assert_allclose(to_dense(a), d @ d, rtol=1e-12, atol=1e-12)
+
+
+def test_multiply_aliased_c_is_b_with_beta():
+    n = [2, 3]
+    a = _rand("a", n, n, 1.0, seed=31)
+    b = _rand("b", n, n, 1.0, seed=32)
+    da, db = to_dense(a), to_dense(b)
+    multiply("N", "N", 1.0, a, b, 0.5, b)
+    np.testing.assert_allclose(to_dense(b), da @ db + 0.5 * db, rtol=1e-12, atol=1e-12)

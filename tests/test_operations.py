@@ -1,0 +1,208 @@
+"""Ops layer tests vs dense NumPy oracle (ref `dbcsr_test_add.F`,
+`dbcsr_test_scale_by_vector.F`, norm/trace/dot routines in
+`src/ops/dbcsr_operations.F`)."""
+
+import numpy as np
+import pytest
+
+import dbcsr_tpu as dt
+from dbcsr_tpu.core.matrix import SYMMETRIC
+from dbcsr_tpu.ops.operations import column_norms, compress
+
+RBS = [2, 3, 5]
+CBS = [3, 4]
+
+
+def _rand(name, rbs=RBS, cbs=CBS, occ=0.7, dtype=np.float64, seed=0, mtype="N"):
+    return dt.make_random_matrix(name, rbs, cbs, dtype=dtype, occupation=occ,
+                                 matrix_type=mtype, rng=np.random.default_rng(seed))
+
+
+def test_add_pattern_union():
+    a = _rand("a", occ=0.4, seed=1)
+    b = _rand("b", occ=0.4, seed=2)
+    da, db = dt.to_dense(a), dt.to_dense(b)
+    dt.add(a, b, 2.0, -0.5)
+    np.testing.assert_allclose(dt.to_dense(a), 2.0 * da - 0.5 * db, rtol=1e-12)
+
+
+def test_add_disjoint_patterns():
+    a = dt.create("a", [2, 2], [2, 2])
+    a.put_block(0, 0, np.ones((2, 2)))
+    a.finalize()
+    b = dt.create("b", [2, 2], [2, 2])
+    b.put_block(1, 1, 2 * np.ones((2, 2)))
+    b.finalize()
+    dt.add(a, b)
+    assert a.nblks == 2
+    np.testing.assert_array_equal(a.get_block(1, 1), 2 * np.ones((2, 2)))
+
+
+def test_scale():
+    a = _rand("a", seed=3)
+    d = dt.to_dense(a)
+    dt.scale(a, -3.0)
+    np.testing.assert_allclose(dt.to_dense(a), -3.0 * d, rtol=1e-12)
+
+
+@pytest.mark.parametrize("side", ["right", "left"])
+def test_scale_by_vector(side):
+    a = _rand("a", seed=4)
+    d = dt.to_dense(a)
+    n = a.nfullcols if side == "right" else a.nfullrows
+    v = np.random.default_rng(5).standard_normal(n)
+    dt.scale_by_vector(a, v, side=side)
+    want = d * v[None, :] if side == "right" else d * v[:, None]
+    np.testing.assert_allclose(dt.to_dense(a), want, rtol=1e-12)
+
+
+def test_trace():
+    n = [2, 3, 4]
+    a = _rand("a", n, n, occ=1.0, seed=6)
+    assert dt.trace(a) == pytest.approx(np.trace(dt.to_dense(a)))
+
+
+def test_dot():
+    a = _rand("a", occ=0.6, seed=7)
+    b = _rand("b", occ=0.6, seed=8)
+    want = float((dt.to_dense(a) * dt.to_dense(b)).sum())
+    assert dt.dot(a, b) == pytest.approx(want)
+
+
+def test_dot_symmetric():
+    n = [2, 3]
+    a = _rand("a", n, n, occ=1.0, seed=9, mtype=SYMMETRIC)
+    b = _rand("b", n, n, occ=1.0, seed=10, mtype=SYMMETRIC)
+    want = float((dt.to_dense(a) * dt.to_dense(b)).sum())
+    assert dt.dot(a, b) == pytest.approx(want)
+
+
+def test_norms():
+    a = _rand("a", occ=0.8, seed=11)
+    d = dt.to_dense(a)
+    assert dt.frobenius_norm(a) == pytest.approx(np.linalg.norm(d))
+    assert dt.maxabs_norm(a) == pytest.approx(np.abs(d).max())
+    assert dt.gershgorin_norm(a) == pytest.approx(np.abs(d).sum(axis=1).max())
+    np.testing.assert_allclose(column_norms(a),
+                               np.linalg.norm(d, axis=0), rtol=1e-12)
+
+
+def test_frobenius_norm_symmetric():
+    n = [2, 3]
+    a = _rand("a", n, n, occ=1.0, seed=12, mtype=SYMMETRIC)
+    assert dt.frobenius_norm(a) == pytest.approx(np.linalg.norm(dt.to_dense(a)))
+
+
+def test_filter():
+    a = dt.create("a", [2, 2], [2, 2])
+    a.put_block(0, 0, 1e-8 * np.ones((2, 2)))
+    a.put_block(1, 1, np.ones((2, 2)))
+    a.finalize()
+    dt.filter_matrix(a, 1e-4)
+    assert a.nblks == 1
+    assert a.get_block(0, 0) is None
+
+
+def test_hadamard():
+    a = _rand("a", occ=0.6, seed=13)
+    b = _rand("b", occ=0.6, seed=14)
+    c = dt.hadamard_product(a, b)
+    np.testing.assert_allclose(dt.to_dense(c), dt.to_dense(a) * dt.to_dense(b),
+                               rtol=1e-12)
+
+
+def test_function_of_elements():
+    a = _rand("a", occ=0.5, seed=15)
+    d = dt.to_dense(a)
+    import jax.numpy as jnp
+
+    dt.function_of_elements(a, jnp.tanh)
+    want = np.where(d != 0, np.tanh(d), 0.0)
+    np.testing.assert_allclose(dt.to_dense(a), want, rtol=1e-12)
+
+
+def test_diag_roundtrip():
+    n = [2, 3]
+    a = _rand("a", n, n, occ=1.0, seed=16)
+    v = np.arange(5.0)
+    dt.set_diag(a, v)
+    np.testing.assert_allclose(dt.get_diag(a), v)
+
+
+def test_add_on_diag():
+    n = [2, 3]
+    a = _rand("a", n, n, occ=0.3, seed=17)
+    d = dt.to_dense(a)
+    dt.add_on_diag(a, 2.5)
+    np.testing.assert_allclose(dt.to_dense(a), d + 2.5 * np.eye(5), rtol=1e-12)
+
+
+def test_new_transposed():
+    a = _rand("a", occ=0.5, seed=18)
+    t = dt.new_transposed(a)
+    np.testing.assert_allclose(dt.to_dense(t), dt.to_dense(a).T, rtol=1e-12)
+
+
+def test_new_transposed_complex_conjugate():
+    a = _rand("a", occ=0.7, dtype=np.complex128, seed=19)
+    t = dt.new_transposed(a, conjugate=True)
+    np.testing.assert_allclose(dt.to_dense(t), dt.to_dense(a).conj().T, rtol=1e-12)
+
+
+def test_desymmetrize():
+    n = [2, 3]
+    a = _rand("a", n, n, occ=1.0, seed=20, mtype=SYMMETRIC)
+    full = dt.desymmetrize(a)
+    assert full.matrix_type == "N"
+    np.testing.assert_allclose(dt.to_dense(full), dt.to_dense(a), rtol=1e-12)
+
+
+def test_compress_keeps_order():
+    a = _rand("a", occ=1.0, seed=21)
+    keep = np.zeros(a.nblks, bool)
+    keep[::2] = True
+    keys_before = a.keys[keep]
+    compress(a, keep)
+    np.testing.assert_array_equal(a.keys, keys_before)
+    d = dt.to_dense(a)
+    assert np.isfinite(d).all()
+
+
+def test_hadamard_antisymmetric_inputs():
+    """A∘A is symmetric; result must be expanded, not mislabeled."""
+    n = [2, 2]
+    a = _rand("a", n, n, occ=1.0, seed=40, mtype="A")
+    b = _rand("b", n, n, occ=1.0, seed=41, mtype="A")
+    c = dt.hadamard_product(a, b)
+    np.testing.assert_allclose(dt.to_dense(c), dt.to_dense(a) * dt.to_dense(b),
+                               rtol=1e-12)
+
+
+def test_scale_by_vector_rejects_symmetric():
+    n = [2, 2]
+    a = _rand("a", n, n, occ=1.0, seed=42, mtype=SYMMETRIC)
+    with pytest.raises(ValueError):
+        dt.scale_by_vector(a, np.ones(4))
+
+
+def test_dot_hermitian_complex():
+    n = [2, 3]
+    a = _rand("a", n, n, occ=1.0, dtype=np.complex128, seed=43, mtype="H")
+    b = _rand("b", n, n, occ=1.0, dtype=np.complex128, seed=44, mtype="H")
+    want = (dt.to_dense(a) * dt.to_dense(b)).sum()
+    got = dt.dot(a, b)
+    assert got == pytest.approx(want)
+
+
+def test_checksum_pos_detects_misplacement():
+    from dbcsr_tpu.ops.test_methods import checksum
+
+    a = dt.create("a", [2, 2], [2, 2])
+    blk = np.arange(4.0).reshape(2, 2)
+    a.put_block(0, 0, blk)
+    a.finalize()
+    b = dt.create("b", [2, 2], [2, 2])
+    b.put_block(1, 1, blk)  # same values, wrong position
+    b.finalize()
+    assert checksum(a) == checksum(b)          # plain checksum blind to position
+    assert checksum(a, pos=True) != checksum(b, pos=True)
