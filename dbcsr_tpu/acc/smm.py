@@ -44,12 +44,11 @@ def _accum_dtype(dtype):
     return d
 
 
-@functools.partial(jax.jit, donate_argnums=0)
-def _process_stack_xla(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
-    """One stack chunk: gather -> batched matmul -> sorted segment-add."""
+def _chunk_contrib(a_data, b_data, a_idx, b_idx, c_idx, alpha, nseg, out_dtype):
+    """One stack chunk: gather -> batched matmul -> sorted segment-sum."""
     a = jnp.take(a_data, a_idx, axis=0)
     b = jnp.take(b_data, b_idx, axis=0)
-    acc = _accum_dtype(c_data.dtype)
+    acc = _accum_dtype(out_dtype)
     # HIGHEST precision: f32 runs as true f32 on the MXU (bf16x3 passes),
     # matching the reference's numerics contract; bf16 data still uses
     # fast bf16 inputs with f32 accumulation via preferred_element_type.
@@ -60,11 +59,32 @@ def _process_stack_xla(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
         preferred_element_type=acc,
         precision=jax.lax.Precision.HIGHEST,
     )
-    prod = (alpha.astype(acc) * prod).astype(c_data.dtype)
-    contrib = jax.ops.segment_sum(
-        prod, c_idx, num_segments=c_data.shape[0], indices_are_sorted=True
-    )
-    return c_data + contrib
+    prod = (alpha.astype(acc) * prod).astype(out_dtype)
+    return jax.ops.segment_sum(prod, c_idx, num_segments=nseg, indices_are_sorted=True)
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _process_stack_xla(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
+    """Process a whole stack in one device program.
+
+    The chunk loop lives INSIDE jit as a `lax.scan` over (nchunks, L)
+    index arrays — the TPU-native replacement for the reference's
+    stream-cycled stack buffers (`dbcsr_mm_accdrv.F:279-326`): one
+    dispatch and one compilation per (m,n,k,bucket) instead of a Python
+    loop of per-chunk launches.  Entries padded with c_idx == Nc are
+    dropped by the segment-sum.
+    """
+    nseg = c_data.shape[0]
+
+    def body(c, idx):
+        ai, bi, ci = idx
+        contrib = _chunk_contrib(
+            a_data, b_data, ai, bi, ci, alpha, nseg, c.dtype
+        )
+        return c + contrib, None
+
+    c_data, _ = jax.lax.scan(body, c_data, (a_idx, b_idx, c_idx))
+    return c_data
 
 
 def pad_stack(a_idx, b_idx, c_idx, target_len: int, drop_segment: int):
@@ -101,19 +121,22 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0):
     nseg = c_data.shape[0]
     alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)
     chunk = max(cfg.mm_stack_size, 1)
-    use_pallas = _pallas_enabled(cfg, c_data, a_data, b_data)
-    for s0 in range(0, S, chunk):
-        s1 = min(s0 + chunk, S)
-        L = bucket_size(s1 - s0)
-        ai, bi, ci = pad_stack(a_idx[s0:s1], b_idx[s0:s1], c_idx[s0:s1], L, nseg)
-        ai, bi, ci = jnp.asarray(ai), jnp.asarray(bi), jnp.asarray(ci)
-        if use_pallas:
-            from dbcsr_tpu.acc.pallas_smm import process_stack_pallas
+    # pad to a whole number of chunks (bucketed) and reshape to
+    # (nchunks, chunk) so the scan shape reuses the jit cache
+    if S <= chunk:
+        chunk = bucket_size(S)
+        nchunks = 1
+    else:
+        nchunks = bucket_size(-(-S // chunk), minimum=1)
+    ai, bi, ci = pad_stack(a_idx, b_idx, c_idx, nchunks * chunk, nseg)
+    ai = jnp.asarray(ai.reshape(nchunks, chunk))
+    bi = jnp.asarray(bi.reshape(nchunks, chunk))
+    ci = jnp.asarray(ci.reshape(nchunks, chunk))
+    if _pallas_enabled(cfg, c_data, a_data, b_data):
+        from dbcsr_tpu.acc.pallas_smm import process_stack_pallas
 
-            c_data = process_stack_pallas(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
-        else:
-            c_data = _process_stack_xla(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
-    return c_data
+        return process_stack_pallas(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
+    return _process_stack_xla(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
 
 
 def _pallas_enabled(cfg, c_data, a_data, b_data) -> bool:

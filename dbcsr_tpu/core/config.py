@@ -24,6 +24,10 @@ class Config:
     # max entries pushed to the device per kernel call before flushing
     # (ref MM_STACK_SIZE: 30000 accel / 1000 CPU, dbcsr_config.F:77-79)
     mm_stack_size: int = 30000
+    # dense-mode multiply for near-full matrices with uniform blocking
+    # (ref MM_DENSE + decision at dbcsr_mm.F:593-617); None = auto
+    mm_dense: object = None
+    dense_occ_threshold: float = 0.8
     # use the fused pallas SMM kernel when available (ref: libsmm_acc JIT
     # kernels vs cuBLAS loop)
     use_pallas: bool = True
@@ -49,7 +53,9 @@ def _apply_env(cfg: Config) -> None:
         env = os.environ.get(f"DBCSR_TPU_{f.name.upper()}")
         if env is None:
             continue
-        if isinstance(getattr(cfg, f.name), bool):
+        if f.name == "mm_dense":
+            setattr(cfg, f.name, env.lower() in ("1", "true", "yes"))
+        elif isinstance(getattr(cfg, f.name), bool):
             setattr(cfg, f.name, env.lower() in ("1", "true", "yes"))
         elif isinstance(getattr(cfg, f.name), int):
             setattr(cfg, f.name, int(env))

@@ -111,6 +111,13 @@ def multiply(
         if not np.array_equal(c.col_blk_sizes, b.col_blk_sizes):
             raise ValueError("C col blocking != op(B) col blocking")
 
+        no_limits = all(
+            x is None for x in (first_row, last_row, first_col, last_col, first_k, last_k)
+        )
+        if _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits):
+            with timed("multiply_dense"):
+                return _dense_multiply(a, b, c, alpha, beta)
+
         with timed("multiply_index"):
             cand = _candidates(
                 a, b, c, filter_eps,
@@ -148,6 +155,92 @@ def multiply(
         mflops = 2 * c.nfullrows * c.nfullcols * a.nfullcols
         stats.record_multiply(mflops)
         return int(flops)
+
+
+def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits) -> bool:
+    """Dense-mode decision (ref `dbcsr_mm.F:593-617`): near-full uniformly
+    blocked matrices degrade gracefully to one dense MXU matmul."""
+    from dbcsr_tpu.core.config import get_config
+
+    cfg = get_config()
+    if cfg.mm_dense is False or cfg.mm_driver == "pallas":
+        return False
+    if filter_eps is not None or retain_sparsity or not no_limits:
+        return False
+    if c.matrix_type != NO_SYMMETRY:
+        return False
+    # uniform blocking in every dimension (the reference re-blocks matrices
+    # to a dense blocking instead; round-1 scope: already-uniform only)
+    for m in (a, b, c):
+        if len(np.unique(m.row_blk_sizes)) > 1 or len(np.unique(m.col_blk_sizes)) > 1:
+            return False
+    if cfg.mm_dense is True or cfg.mm_driver == "dense":
+        return True
+    th = cfg.dense_occ_threshold
+    return a.occupation() >= th and b.occupation() >= th
+
+
+@functools.partial(jax.jit, static_argnames=("nbr", "nbc", "bm", "bn"))
+def _blocks_to_dense(data, rows, cols, nbr, nbc, bm, bn):
+    grid = jnp.zeros((nbr, nbc, bm, bn), data.dtype)
+    grid = grid.at[rows, cols].set(data, mode="drop")
+    return grid.transpose(0, 2, 1, 3).reshape(nbr * bm, nbc * bn)
+
+
+@functools.partial(jax.jit, donate_argnums=2, static_argnames=("nbr", "nbc", "bm", "bn"))
+def _dense_product_to_blocks(ad, bd, c_blocks, c_rows, c_cols, alpha, beta, nbr, nbc, bm, bn):
+    acc = ad.dtype
+    cd = jax.lax.dot_general(
+        ad, bd, (((1,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=acc,
+    )
+    grid = cd.reshape(nbr, bm, nbc, bn).transpose(0, 2, 1, 3)
+    old = jnp.zeros((nbr, nbc, bm, bn), cd.dtype)
+    old = old.at[c_rows, c_cols].set(c_blocks, mode="drop")
+    out = alpha * grid + beta * old
+    return out.reshape(nbr * nbc, bm, bn)
+
+
+def _dense_multiply(a, b, c, alpha, beta) -> int:
+    """Dense-mode path: scatter blocks to dense, one MXU matmul, carve C
+    back into a full block pattern (ref `dbcsr_make_dense` +
+    `use_dense_mult`, `dbcsr_mm.F:593-617,770-810`)."""
+    bm = int(c.row_blk_sizes[0])
+    bn = int(c.col_blk_sizes[0])
+    bk = int(a.col_blk_sizes[0])
+    nbr, nbc, nbk = a.nblkrows, c.nblkcols, a.nblkcols
+    ar, ac = a.entry_coords()
+    br_, bc_ = b.entry_coords()
+    ad = _blocks_to_dense(
+        a.bins[0].data[: a.nblks] if a.nblks else jnp.zeros((0, bm, bk), c.dtype),
+        jnp.asarray(ar), jnp.asarray(ac), nbr, nbk, bm, bk,
+    )
+    bd = _blocks_to_dense(
+        b.bins[0].data[: b.nblks] if b.nblks else jnp.zeros((0, bk, bn), c.dtype),
+        jnp.asarray(br_), jnp.asarray(bc_), nbk, nbc, bk, bn,
+    )
+    cr, cc = c.entry_coords()
+    c_blocks = (
+        c.bins[0].data[: c.nblks]
+        if c.nblks
+        else jnp.zeros((0, bm, bn), c.dtype)
+    )
+    alpha_dev = jnp.asarray(alpha, dtype=c.dtype)
+    beta_dev = jnp.asarray(beta, dtype=c.dtype)
+    out = _dense_product_to_blocks(
+        ad, bd, c_blocks, jnp.asarray(cr), jnp.asarray(cc),
+        alpha_dev, beta_dev, nbr, nbc, bm, bn,
+    )
+    new_keys = np.arange(nbr * nbc, dtype=np.int64)  # full pattern, row-major
+    cap = bucket_size(len(new_keys))
+    pad = cap - len(new_keys)
+    if pad:
+        out = jnp.concatenate([out, jnp.zeros((pad, bm, bn), out.dtype)])
+    c.set_structure_from_device(new_keys, [_Bin((bm, bn), out, len(new_keys))])
+    flops = 2 * nbr * bm * nbc * bn * nbk * bk
+    stats.record_stack(bm, bn, bk, nbr * nbc * nbk)
+    stats.record_multiply(flops)
+    return flops
 
 
 def _candidates(a, b, c, filter_eps, fr, lr, fc, lc, fk, lk):

@@ -1,0 +1,214 @@
+"""Performance driver: the `dbcsr_perf` analog.
+
+Replicates `tests/dbcsr_performance_driver.F` +
+`dbcsr_performance_multiply.F`: parse a `.perf` input (same format as
+`tests/input.perf` in the reference), build random block-sparse
+matrices, run nrep multiplies, report per-repeat time and mean/std
+GFLOP/s plus a checksum.
+
+Usage:  python -m dbcsr_tpu.perf.driver tests/inputs/test_square_sparse.perf
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from dbcsr_tpu.core.kinds import dtype_of
+from dbcsr_tpu.core.matrix import BlockSparseMatrix
+from dbcsr_tpu.ops.test_methods import checksum as matrix_checksum
+from dbcsr_tpu.ops.test_methods import make_random_matrix
+from dbcsr_tpu.mm.multiply import multiply
+
+
+@dataclasses.dataclass
+class PerfConfig:
+    npcols: int = 0
+    use_rma: bool = False
+    operation: str = "dbcsr_multiply"
+    m: int = 1000
+    n: int = 1000
+    k: int = 1000
+    sparsity_a: float = 0.0
+    sparsity_b: float = 0.0
+    sparsity_c: float = 0.0
+    transa: str = "N"
+    transb: str = "N"
+    symm_a: str = "N"
+    symm_b: str = "N"
+    symm_c: str = "N"
+    data_type: int = 3
+    alpha: complex = 1.0
+    beta: complex = 1.0
+    limits: Tuple[int, int, int, int, int, int] = (0, 0, 0, 0, 0, 0)
+    retain_sparsity: bool = False
+    nrep: int = 1
+    m_sizes: List[Tuple[int, int]] = dataclasses.field(default_factory=lambda: [(1, 5)])
+    n_sizes: List[Tuple[int, int]] = dataclasses.field(default_factory=lambda: [(1, 5)])
+    k_sizes: List[Tuple[int, int]] = dataclasses.field(default_factory=lambda: [(1, 5)])
+    check: bool = False
+    check_threshold: float = 0.0
+    check_refs: Tuple[float, float] = (0.0, 0.0)
+
+
+def _fortran_bool(tok: str) -> bool:
+    return tok.strip().upper().startswith("T")
+
+
+def _fortran_float(tok: str) -> float:
+    return float(tok.strip().lower().replace("d", "e"))
+
+
+def parse_perf_file(path: str) -> PerfConfig:
+    """Parse the reference `.perf` format (`tests/input.perf`): positional
+    values, '#' comments."""
+    with open(path) as f:
+        toks = [ln.strip() for ln in f if ln.strip() and not ln.strip().startswith("#")]
+    it = iter(toks)
+    nx = lambda: next(it)  # noqa: E731
+    cfg = PerfConfig()
+    cfg.npcols = int(nx())
+    cfg.use_rma = _fortran_bool(nx())
+    cfg.operation = nx()
+    cfg.m, cfg.n, cfg.k = int(nx()), int(nx()), int(nx())
+    cfg.sparsity_a = _fortran_float(nx())
+    cfg.sparsity_b = _fortran_float(nx())
+    cfg.sparsity_c = _fortran_float(nx())
+    cfg.transa, cfg.transb = nx(), nx()
+    cfg.symm_a, cfg.symm_b, cfg.symm_c = nx(), nx(), nx()
+    cfg.data_type = int(nx())
+    ar, ai_ = _fortran_float(nx()), _fortran_float(nx())
+    br, bi = _fortran_float(nx()), _fortran_float(nx())
+    cfg.alpha = complex(ar, ai_) if ai_ else ar
+    cfg.beta = complex(br, bi) if bi else br
+    cfg.limits = tuple(int(nx()) for _ in range(6))
+    cfg.retain_sparsity = _fortran_bool(nx())
+    cfg.nrep = int(nx())
+    nm, nn, nk = int(nx()), int(nx()), int(nx())
+    cfg.m_sizes = [(int(nx()), int(nx())) for _ in range(nm)]
+    cfg.n_sizes = [(int(nx()), int(nx())) for _ in range(nn)]
+    cfg.k_sizes = [(int(nx()), int(nx())) for _ in range(nk)]
+    cfg.check = _fortran_bool(nx())
+    cfg.check_threshold = _fortran_float(nx())
+    cfg.check_refs = (_fortran_float(nx()), _fortran_float(nx()))
+    return cfg
+
+
+def expand_block_sizes(total: int, pattern: List[Tuple[int, int]]) -> np.ndarray:
+    """Cycle (multiplicity, size) pairs until `total` is covered
+    (ref `dbcsr_performance_multiply.F` block-size multisets)."""
+    sizes = []
+    covered = 0
+    while covered < total:
+        for mult, size in pattern:
+            for _ in range(mult):
+                take = min(size, total - covered)
+                if take <= 0:
+                    break
+                sizes.append(take)
+                covered += take
+            if covered >= total:
+                break
+    return np.asarray(sizes, np.int32)
+
+
+def _element_to_block_limits(lim_lo, lim_hi, offsets) -> Tuple[Optional[int], Optional[int]]:
+    """Convert 1-based full-matrix element limits to 0-based block limits."""
+    if lim_lo == 0 and lim_hi == 0:
+        return None, None
+    lo = int(np.searchsorted(offsets, lim_lo - 1, side="right") - 1)
+    hi = int(np.searchsorted(offsets, lim_hi - 1, side="right") - 1)
+    return lo, hi
+
+
+def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True):
+    """Run the configured multiply nrep times; returns a result dict
+    (ref `perf_multiply`, `dbcsr_performance_multiply.F:452-515`)."""
+    dtype = dtype_of(cfg.data_type)
+    rng = np.random.default_rng(seed)
+    m_sizes = expand_block_sizes(cfg.m, cfg.m_sizes)
+    n_sizes = expand_block_sizes(cfg.n, cfg.n_sizes)
+    k_sizes = expand_block_sizes(cfg.k, cfg.k_sizes)
+
+    a_rbs, a_cbs = (m_sizes, k_sizes) if cfg.transa == "N" else (k_sizes, m_sizes)
+    b_rbs, b_cbs = (k_sizes, n_sizes) if cfg.transb == "N" else (n_sizes, k_sizes)
+    a = make_random_matrix("A", a_rbs, a_cbs, dtype=dtype,
+                           occupation=1.0 - cfg.sparsity_a,
+                           matrix_type=cfg.symm_a, rng=rng)
+    b = make_random_matrix("B", b_rbs, b_cbs, dtype=dtype,
+                           occupation=1.0 - cfg.sparsity_b,
+                           matrix_type=cfg.symm_b, rng=rng)
+    c = make_random_matrix("C", m_sizes, n_sizes, dtype=dtype,
+                           occupation=1.0 - cfg.sparsity_c,
+                           matrix_type=cfg.symm_c, rng=rng)
+
+    moff = np.concatenate([[0], np.cumsum(m_sizes)])
+    noff = np.concatenate([[0], np.cumsum(n_sizes)])
+    koff = np.concatenate([[0], np.cumsum(k_sizes)])
+    fr, lr = _element_to_block_limits(cfg.limits[0], cfg.limits[1], moff)
+    fc, lc = _element_to_block_limits(cfg.limits[2], cfg.limits[3], noff)
+    fk, lk = _element_to_block_limits(cfg.limits[4], cfg.limits[5], koff)
+
+    times, flops_list = [], []
+    for _ in range(cfg.nrep):
+        c_run = c.copy()
+        _block_until_ready(c_run)
+        t0 = time.perf_counter()
+        flops = multiply(
+            cfg.transa, cfg.transb, cfg.alpha, a, b, cfg.beta, c_run,
+            retain_sparsity=cfg.retain_sparsity,
+            first_row=fr, last_row=lr, first_col=fc, last_col=lc,
+            first_k=fk, last_k=lk,
+        )
+        _block_until_ready(c_run)
+        times.append(time.perf_counter() - t0)
+        flops_list.append(flops)
+    gflops = [f / t / 1e9 for f, t in zip(flops_list, times)]
+    cs = matrix_checksum(c_run)
+    cs_pos = matrix_checksum(c_run, pos=True)
+    result = {
+        "times_s": times,
+        "flops": flops_list[-1],
+        "gflops_mean": float(np.mean(gflops)),
+        "gflops_std": float(np.std(gflops)),
+        "gflops_best": float(np.max(gflops)),
+        "checksum": cs,
+        "checksum_pos": cs_pos,
+        "device": str(jax.devices()[0]),
+    }
+    if verbose:
+        print(f" matrix sizes M/N/K          {cfg.m} {cfg.n} {cfg.k}")
+        print(f" sparsities A/B/C            {cfg.sparsity_a} {cfg.sparsity_b} {cfg.sparsity_c}")
+        print(f" device                      {result['device']}")
+        print(f" flops per multiply          {result['flops']:,}")
+        print(f" time per multiply           {[f'{t:.4f}' for t in times]}")
+        print(f" perf total                  {result['gflops_mean']:.2f} +/- "
+              f"{result['gflops_std']:.2f} GFLOP/s (best {result['gflops_best']:.2f})")
+        print(f" checksum                    {cs:.15e}")
+    return result
+
+
+def _block_until_ready(matrix: BlockSparseMatrix) -> None:
+    for b in matrix.bins:
+        if b.count:
+            jax.block_until_ready(b.data)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print(__doc__)
+        return 1
+    cfg = parse_perf_file(argv[0])
+    run_perf(cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -219,3 +219,32 @@ def test_multiply_aliased_c_is_b_with_beta():
     da, db = to_dense(a), to_dense(b)
     multiply("N", "N", 1.0, a, b, 0.5, b)
     np.testing.assert_allclose(to_dense(b), da @ db + 0.5 * db, rtol=1e-12, atol=1e-12)
+
+
+def test_dense_mode_matches_sparse_path():
+    """Uniform-blocked occ=1 goes dense; force sparse and compare."""
+    from dbcsr_tpu.core.config import set_config
+
+    rbs = [4] * 6
+    a = _rand("a", rbs, rbs, 1.0, seed=50)
+    b = _rand("b", rbs, rbs, 1.0, seed=51)
+    c_dense = _rand("c", rbs, rbs, 0.5, seed=52)
+    c_sparse = c_dense.copy()
+    multiply("N", "N", 1.5, a, b, 0.5, c_dense)  # auto -> dense mode
+    set_config(mm_dense=False)
+    try:
+        multiply("N", "N", 1.5, a, b, 0.5, c_sparse)
+    finally:
+        set_config(mm_dense=None)
+    np.testing.assert_allclose(to_dense(c_dense), to_dense(c_sparse),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_dense_mode_not_used_with_filter():
+    """filter_eps forces the sparse path even at occ=1."""
+    rbs = [4] * 4
+    a = _rand("a", rbs, rbs, 1.0, seed=53)
+    b = _rand("b", rbs, rbs, 1.0, seed=54)
+    c = create("c", rbs, rbs)
+    multiply("N", "N", 1.0, a, b, 0.0, c, filter_eps=1e30)
+    assert c.nblks == 0  # all filtered -> sparse machinery ran
