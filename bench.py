@@ -1,0 +1,49 @@
+"""Benchmark: the north-star config on the real TPU chip.
+
+dbcsr_performance_multiply on 10,000x10,000 BCSR, 23x23 blocks,
+occupancy 0.1, dreal (BASELINE.json; CP2K H2O-like workload).  Prints
+ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline compares against the same workload on this host's CPU via
+the same engine (XLA CPU, f64): 2.98 GFLOP/s best-of-5, measured
+2026-07-29 (see BASELINE.md for the reference's own published per-kernel
+numbers, which are GPU-specific).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+CPU_BASELINE_GFLOPS = 2.98  # north-star config, this host, XLA-CPU f64
+
+def main():
+    import numpy as np
+
+    from dbcsr_tpu.perf.driver import PerfConfig, run_perf
+
+    dtype_enum = int(os.environ.get("DBCSR_TPU_BENCH_DTYPE", "3"))  # 3 = f64
+    nrep = int(os.environ.get("DBCSR_TPU_BENCH_NREP", "3"))
+    cfg = PerfConfig(
+        m=10000, n=10000, k=10000,
+        sparsity_a=0.9, sparsity_b=0.9, sparsity_c=0.9,
+        data_type=dtype_enum, beta=0.0, nrep=nrep,
+        m_sizes=[(1, 23)], n_sizes=[(1, 23)], k_sizes=[(1, 23)],
+    )
+    res = run_perf(cfg, verbose=False)
+    out = {
+        "metric": "dbcsr_performance_multiply GFLOP/s (10k^2 BCSR, 23x23 blocks, occ=0.1, dreal)",
+        "value": round(res["gflops_best"], 3),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(res["gflops_best"] / CPU_BASELINE_GFLOPS, 3),
+        "mean": round(res["gflops_mean"], 3),
+        "checksum": res["checksum"],
+        "device": res["device"],
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

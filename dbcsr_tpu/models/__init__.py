@@ -1,0 +1,14 @@
+"""Flagship workloads built on the engine.
+
+The reference library's "model" is linear-scaling DFT in CP2K: its hot
+loop is density-matrix purification — repeated block-sparse matrix
+squaring/cubing with on-the-fly filtering (the workload
+`dbcsr_multiply` exists to serve).  `purify` implements McWeeny
+purification on the single-chip engine and on the distributed mesh.
+"""
+
+from dbcsr_tpu.models.purify import (
+    mcweeny_purify,
+    mcweeny_step,
+    mcweeny_step_distributed,
+)

@@ -1,0 +1,19 @@
+"""Distributed layer: 2D/2.5D process grids over jax device meshes.
+
+TPU-native re-design of the reference's MPI machinery (SURVEY §2.3/2.4):
+
+* 2D cartesian communicator (`mp_cart_create`, `dbcsr_mpiwrap.F:1073`)
+  ->  `jax.sharding.Mesh` with axes ('kl', 'pr', 'pc').
+* Cannon metronome loop with nonblocking isend/irecv panel shifts
+  (`dbcsr_mm_cannon.F:1345`)  ->  `shard_map` + static `lax.ppermute`
+  ring permutations inside a `lax.fori_loop`; XLA overlaps the
+  collective with compute (the comm-thread analog).
+* 2.5D / 3D-layer k-replication (`dbcsr_mm_3d.F`, NUM_LAYERS_3D)  ->
+  the 'kl' mesh axis: each layer owns a k-slab, C is `psum` over 'kl'.
+* MPI alltoallv redistribution  ->  resharding via `jax.device_put` /
+  XLA's sharding propagation.
+"""
+
+from dbcsr_tpu.parallel.mesh import make_grid, grid_shape
+from dbcsr_tpu.parallel.cannon import cannon_multiply_dense
+from dbcsr_tpu.parallel.dist_matrix import DistMatrix, distribute, collect, multiply_distributed

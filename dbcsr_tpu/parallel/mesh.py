@@ -1,0 +1,50 @@
+"""Mesh construction for the 2.5D process grid.
+
+Axes: ('kl', 'pr', 'pc') — kl = 3D k-layers (ref NUM_LAYERS_3D /
+`dbcsr_mm_3d.F:983-1134`), pr x pc = the square Cannon grid (ref
+`dbcsr_mp_type`, `dbcsr_types.F:110-134`).  Cannon needs pr == pc; the
+layer axis absorbs non-square device counts (8 devices -> 2 x 2x2),
+playing the role the reference gives to image distributions for grid
+mismatch (`dbcsr_types.F:188-223`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def grid_shape(n_devices: int, layers: Optional[int] = None) -> Tuple[int, int]:
+    """Pick (kl, s) with kl * s * s == n_devices, preferring the largest
+    square grid (fewest layers)."""
+    if layers is not None:
+        s2, rem = divmod(n_devices, layers)
+        s = int(round(np.sqrt(s2)))
+        if rem or s * s != s2:
+            raise ValueError(f"{n_devices} devices != {layers} * square")
+        return layers, s
+    best = None
+    for s in range(int(np.sqrt(n_devices)), 0, -1):
+        if n_devices % (s * s) == 0:
+            best = (n_devices // (s * s), s)
+            break
+    return best
+
+
+def make_grid(
+    n_devices: Optional[int] = None,
+    devices=None,
+    layers: Optional[int] = None,
+) -> Mesh:
+    """Build the ('kl','pr','pc') mesh (ref `mp_cart_create`)."""
+    if devices is None:
+        devices = jax.devices()[: (n_devices or len(jax.devices()))]
+    n = len(devices)
+    if n_devices is not None and n < n_devices:
+        raise ValueError(f"requested {n_devices} devices, have {n}")
+    kl, s = grid_shape(n, layers)
+    arr = np.asarray(devices).reshape(kl, s, s)
+    return Mesh(arr, axis_names=("kl", "pr", "pc"))
