@@ -125,6 +125,57 @@ def desymmetrize(matrix: BlockSparseMatrix, name: Optional[str] = None) -> Block
     return out.finalize()
 
 
+def submatrix(
+    matrix: BlockSparseMatrix,
+    row_lo: int,
+    row_hi: int,
+    col_lo: int,
+    col_hi: int,
+    name: Optional[str] = None,
+) -> BlockSparseMatrix:
+    """Block-index submatrix [row_lo, row_hi) x [col_lo, col_hi) with
+    renumbered block indices (ref `dbcsr_crop_matrix` flavor; also the
+    building block of the TAS grid split, `dbcsr_tas_split.F`).
+    Block data is shared (device arrays are immutable); only the index
+    is rebuilt."""
+    if matrix.matrix_type != NO_SYMMETRY:
+        matrix = desymmetrize(matrix)
+    if not matrix.valid:
+        raise RuntimeError("finalize() first")
+    rows, cols = matrix.entry_coords()
+    keep = (rows >= row_lo) & (rows < row_hi) & (cols >= col_lo) & (cols < col_hi)
+    out = BlockSparseMatrix(
+        name or f"{matrix.name}[{row_lo}:{row_hi},{col_lo}:{col_hi}]",
+        matrix.row_blk_sizes[row_lo:row_hi],
+        matrix.col_blk_sizes[col_lo:col_hi],
+        matrix.dtype,
+        None,
+        NO_SYMMETRY,
+    )
+    sub_rows = rows[keep] - row_lo
+    sub_cols = cols[keep] - col_lo
+    new_keys = sub_rows * out.nblkcols + sub_cols
+    order = np.argsort(new_keys, kind="stable")
+    new_keys = new_keys[order]
+    ent = np.nonzero(keep)[0][order]
+    old_bin = matrix.ent_bin[ent]
+    old_slot = matrix.ent_slot[ent]
+    nb, nsl, shapes = _bin_entries(
+        out.row_blk_sizes, out.col_blk_sizes, sub_rows[order], sub_cols[order]
+    )
+    bins = []
+    for b, (bm, bn) in enumerate(shapes):
+        mask = nb == b
+        count = int(mask.sum())
+        src_bin = matrix.bins[old_bin[mask][0]]
+        perm = np.empty(count, np.int32)
+        perm[nsl[mask]] = old_slot[mask]
+        data = _gather_blocks(src_bin.data, jnp.asarray(perm), bucket_size(count))
+        bins.append(_Bin((bm, bn), data, count))
+    out.set_structure_from_device(new_keys, bins)
+    return out
+
+
 def redistribute(
     matrix: BlockSparseMatrix, dist: Distribution, name: Optional[str] = None
 ) -> BlockSparseMatrix:

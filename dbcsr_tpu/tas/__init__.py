@@ -1,0 +1,13 @@
+"""TAS (tall-and-skinny) layer.
+
+Re-design of `src/tas`: matrices with one dimension much larger than
+the other are processed as a grid-split stack of ordinary block-sparse
+matrices — split the long dimension into groups, replicate the small
+matrix per group, multiply per group, reduce
+(`dbcsr_tas_mm.F:10-17,79`).  On the 2.5D mesh the group axis maps to
+'kl'; single-chip, groups bound the working set of each multiply.
+"""
+
+from dbcsr_tpu.tas.base import TASMatrix
+from dbcsr_tpu.tas.split import estimate_split_factor, choose_nsplit
+from dbcsr_tpu.tas.mm import tas_multiply

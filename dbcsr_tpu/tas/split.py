@@ -1,0 +1,35 @@
+"""Split-factor heuristics.
+
+Ref `dbcsr_tas_mm.F:1427-1464` (split factor from nnz ratios) and
+`dbcsr_tas_split.F:207-281` (nsplit acceptance).  The split factor
+estimates how much longer the long dimension is than the short ones,
+weighted by data so a sparse long dimension doesn't over-split.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def estimate_split_factor(m_full: int, n_full: int, k_full: int,
+                          nnz_a: int, nnz_b: int, nnz_c: int) -> float:
+    """Ratio long/short weighted by occupancy (ref split_factor_estimate)."""
+    dims = sorted([m_full, n_full, k_full])
+    short = max(1, int(np.sqrt(dims[0] * dims[1])))
+    long_ = dims[2]
+    geom = long_ / short
+    # damp by relative fill of the long matrix: nearly-empty long
+    # dimensions don't need splitting
+    total = max(1, nnz_a + nnz_b + nnz_c)
+    dense_total = max(1, m_full * k_full + k_full * n_full + m_full * n_full)
+    fill = min(1.0, 3.0 * total / dense_total)
+    return max(1.0, geom * max(fill, 0.05))
+
+
+def choose_nsplit(split_factor: float, ngroups_max: int, nblks_long: int) -> int:
+    """Accept an nsplit near the split factor, bounded by available
+    groups and the block count of the long dimension
+    (ref accept_pgrid/nsplit heuristics, dbcsr_tas_split.F:207-281)."""
+    n = int(round(split_factor))
+    n = max(1, min(n, ngroups_max, nblks_long))
+    return n

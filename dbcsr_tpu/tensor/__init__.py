@@ -1,0 +1,12 @@
+"""Tensor layer: n-rank block-sparse tensor contraction.
+
+Re-design of `src/tensors`: a rank-2..4 block-sparse tensor is stored
+as a block-sparse matrix through an nd->2d mapping (which tensor dims
+become matrix rows vs cols, `dbcsr_tensor_types.F:119-136`);
+`contract` aligns indices, remaps operands to compatible matrix
+layouts, runs the TAS multiply, and maps back
+(`dbcsr_tensor.F:418,1162-1183`).
+"""
+
+from dbcsr_tpu.tensor.types import BlockSparseTensor, create_tensor
+from dbcsr_tpu.tensor.contract import contract, tensor_copy, remap

@@ -1,0 +1,121 @@
+"""Tensor contraction.
+
+Ref `dbcsr_t_contract` (`dbcsr_tensor.F:418`) and its expert path
+(:540): align indices (:1162), remap operands to matrix-compatible
+layouts (`reshape_mm_compatible`, :1183), run the TAS multiply, map the
+result back.  `contract_a[i]` is contracted against `contract_b[i]`;
+`notcontract_a` dims land in C at positions `map_1` (order-preserving),
+`notcontract_b` at `map_2`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dbcsr_tpu.core.timings import timed
+from dbcsr_tpu.ops.operations import scale
+from dbcsr_tpu.tas.mm import tas_multiply
+from dbcsr_tpu.tensor.types import BlockSparseTensor
+
+
+def remap(
+    t: BlockSparseTensor,
+    row_dims: Sequence[int],
+    col_dims: Sequence[int],
+    name: Optional[str] = None,
+) -> BlockSparseTensor:
+    """Same tensor, different nd->2d mapping (ref `dbcsr_t_remap`,
+    `dbcsr_tensor.F:1604`)."""
+    row_dims, col_dims = tuple(row_dims), tuple(col_dims)
+    if (row_dims, col_dims) == (t.row_dims, t.col_dims):
+        return t
+    out = BlockSparseTensor(
+        name or t.name, t.blk_sizes, row_dims, col_dims, t.dtype
+    )
+    for idx, blk in t.iterate_blocks():
+        out.put_block(idx, blk)
+    return out.finalize()
+
+
+def tensor_copy(
+    dest: BlockSparseTensor, src: BlockSparseTensor, summation: bool = False
+) -> BlockSparseTensor:
+    """Copy blocks between same-shape tensors in any mappings
+    (ref `dbcsr_t_copy` -> `dbcsr_t_reshape`, `dbcsr_tensor_reshape.F:67`)."""
+    if dest.nblks_per_dim != src.nblks_per_dim:
+        raise ValueError("tensor shapes differ")
+    for idx, blk in src.iterate_blocks():
+        dest.put_block(idx, blk, summation=summation)
+    return dest.finalize()
+
+
+def contract(
+    alpha,
+    tensor_a: BlockSparseTensor,
+    tensor_b: BlockSparseTensor,
+    beta,
+    tensor_c: BlockSparseTensor,
+    contract_a: Sequence[int],
+    notcontract_a: Sequence[int],
+    contract_b: Sequence[int],
+    notcontract_b: Sequence[int],
+    map_1: Optional[Sequence[int]] = None,
+    map_2: Optional[Sequence[int]] = None,
+    filter_eps: Optional[float] = None,
+    nsplit: Optional[int] = None,
+) -> int:
+    """C[map_1, map_2] = alpha * sum over contracted dims of A*B + beta*C.
+
+    Returns flops.  (ref `dbcsr_t_contract`, `dbcsr_tensor.F:418`)
+    """
+    ca, nca = tuple(contract_a), tuple(notcontract_a)
+    cb, ncb = tuple(contract_b), tuple(notcontract_b)
+    if map_1 is None:
+        map_1 = tuple(range(len(nca)))
+    if map_2 is None:
+        map_2 = tuple(range(len(nca), len(nca) + len(ncb)))
+    map_1, map_2 = tuple(map_1), tuple(map_2)
+
+    if sorted(ca + nca) != list(range(tensor_a.ndim)):
+        raise ValueError("contract_a + notcontract_a must partition A dims")
+    if sorted(cb + ncb) != list(range(tensor_b.ndim)):
+        raise ValueError("contract_b + notcontract_b must partition B dims")
+    if len(ca) != len(cb):
+        raise ValueError("contracted dim counts differ")
+    for da, db in zip(ca, cb):
+        if not np.array_equal(tensor_a.blk_sizes[da], tensor_b.blk_sizes[db]):
+            raise ValueError(f"contracted dim blockings differ: A{da} vs B{db}")
+    if sorted(map_1 + map_2) != list(range(tensor_c.ndim)):
+        raise ValueError("map_1 + map_2 must partition C dims")
+    for da, dc in zip(nca, map_1):
+        if not np.array_equal(tensor_a.blk_sizes[da], tensor_c.blk_sizes[dc]):
+            raise ValueError(f"A dim {da} blocking != C dim {dc}")
+    for db, dc in zip(ncb, map_2):
+        if not np.array_equal(tensor_b.blk_sizes[db], tensor_c.blk_sizes[dc]):
+            raise ValueError(f"B dim {db} blocking != C dim {dc}")
+
+    with timed("tensor_contract"):
+        # remap operands into matrix-compatible layouts (ref :1183)
+        a2 = remap(tensor_a, nca, ca, name=tensor_a.name + "_mm")
+        b2 = remap(tensor_b, cb, ncb, name=tensor_b.name + "_mm")
+        c_layout = (map_1, map_2)
+        if (tensor_c.row_dims, tensor_c.col_dims) == c_layout:
+            flops = tas_multiply(
+                "N", "N", alpha, a2.matrix, b2.matrix, beta, tensor_c.matrix,
+                filter_eps=filter_eps, nsplit=nsplit,
+            )
+            return flops
+        tmp = BlockSparseTensor(
+            tensor_c.name + "_mm", tensor_c.blk_sizes, map_1, map_2, tensor_c.dtype
+        )
+        tmp.finalize()
+        flops = tas_multiply(
+            "N", "N", alpha, a2.matrix, b2.matrix, 0.0, tmp.matrix,
+            filter_eps=filter_eps, nsplit=nsplit,
+        )
+        if beta != 1.0:
+            scale(tensor_c.matrix, beta)
+        tensor_copy(tensor_c, tmp, summation=True)
+        return flops

@@ -1,0 +1,176 @@
+"""Block-sparse tensor type and the nd->2d mapping.
+
+Ref `dbcsr_tensor_types.F:119-136` (`nd_to_2d_mapping`): tensor dims
+are partitioned into (row_dims, col_dims); the tensor is stored as a
+block-sparse matrix whose block rows enumerate the mixed-radix product
+of the row dims' blocks (C-order) and likewise for columns.  A tensor
+block of shape (s_0,...,s_{d-1}) is stored as the matrix block
+transpose(row_dims + col_dims).reshape(prod_rows, prod_cols).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dbcsr_tpu.core.matrix import BlockSparseMatrix
+
+
+def _mixed_radix_sizes(blk_sizes: List[np.ndarray], dims: Sequence[int]) -> np.ndarray:
+    """Matrix block sizes for the product of `dims` (C-order)."""
+    if not dims:
+        return np.asarray([1], np.int32)
+    out = np.asarray([1], np.int64)
+    for d in dims:
+        out = np.multiply.outer(out, blk_sizes[d].astype(np.int64)).reshape(-1)
+    return out.astype(np.int32)
+
+
+class BlockSparseTensor:
+    """A rank-d block-sparse tensor stored as a matrix."""
+
+    def __init__(
+        self,
+        name: str,
+        blk_sizes: List[np.ndarray],
+        row_dims: Sequence[int],
+        col_dims: Sequence[int],
+        dtype=np.float64,
+    ):
+        self.name = name
+        self.blk_sizes = [np.ascontiguousarray(s, np.int32) for s in blk_sizes]
+        self.ndim = len(self.blk_sizes)
+        self.row_dims = tuple(row_dims)
+        self.col_dims = tuple(col_dims)
+        if sorted(self.row_dims + self.col_dims) != list(range(self.ndim)):
+            raise ValueError("row_dims + col_dims must partition the tensor dims")
+        self.dtype = dtype
+        self.matrix = BlockSparseMatrix(
+            name,
+            _mixed_radix_sizes(self.blk_sizes, self.row_dims),
+            _mixed_radix_sizes(self.blk_sizes, self.col_dims),
+            dtype,
+        )
+
+    # ------------------------------------------------------------- indexing
+    @property
+    def nblks_per_dim(self) -> Tuple[int, ...]:
+        return tuple(len(s) for s in self.blk_sizes)
+
+    def _flat(self, idx: Sequence[int], dims: Sequence[int]) -> int:
+        f = 0
+        for d in dims:
+            f = f * len(self.blk_sizes[d]) + idx[d]
+        return f
+
+    def _unflat(self, flat: int, dims: Sequence[int]) -> List[int]:
+        out = []
+        for d in reversed(dims):
+            out.append(flat % len(self.blk_sizes[d]))
+            flat //= len(self.blk_sizes[d])
+        return list(reversed(out))
+
+    def block_coords(self, row: int, col: int) -> Tuple[int, ...]:
+        """Matrix (row, col) -> tensor block multi-index."""
+        idx = [0] * self.ndim
+        for d, v in zip(self.row_dims, self._unflat(row, self.row_dims)):
+            idx[d] = v
+        for d, v in zip(self.col_dims, self._unflat(col, self.col_dims)):
+            idx[d] = v
+        return tuple(idx)
+
+    def block_shape(self, idx: Sequence[int]) -> Tuple[int, ...]:
+        return tuple(int(self.blk_sizes[d][idx[d]]) for d in range(self.ndim))
+
+    # --------------------------------------------------------------- blocks
+    def put_block(self, idx: Sequence[int], block, summation: bool = False) -> None:
+        """Stage a rank-d block (ref `dbcsr_t_put_block`)."""
+        block = np.asarray(block)
+        if block.shape != self.block_shape(idx):
+            raise ValueError(
+                f"block {tuple(idx)} has shape {block.shape}, "
+                f"expected {self.block_shape(idx)}"
+            )
+        perm = self.row_dims + self.col_dims
+        mat = block.transpose(perm).reshape(
+            int(np.prod([block.shape[d] for d in self.row_dims], dtype=np.int64)),
+            int(np.prod([block.shape[d] for d in self.col_dims], dtype=np.int64)),
+        )
+        self.matrix.put_block(
+            self._flat(idx, self.row_dims), self._flat(idx, self.col_dims), mat,
+            summation=summation,
+        )
+
+    def get_block(self, idx: Sequence[int]):
+        """Fetch a rank-d block or None (ref `dbcsr_t_get_block`)."""
+        mat = self.matrix.get_block(
+            self._flat(idx, self.row_dims), self._flat(idx, self.col_dims)
+        )
+        if mat is None:
+            return None
+        shape = self.block_shape(idx)
+        perm = self.row_dims + self.col_dims
+        inv = np.argsort(perm)
+        return mat.reshape(tuple(shape[d] for d in perm)).transpose(inv)
+
+    def finalize(self) -> "BlockSparseTensor":
+        self.matrix.finalize()
+        return self
+
+    def iterate_blocks(self) -> Iterator[Tuple[Tuple[int, ...], np.ndarray]]:
+        """Yield (multi-index, rank-d block) (ref `dbcsr_t_iterator`)."""
+        perm = self.row_dims + self.col_dims
+        inv = np.argsort(perm)
+        for r, c, mat in self.matrix.iterate_blocks():
+            idx = self.block_coords(r, c)
+            shape = self.block_shape(idx)
+            yield idx, mat.reshape(tuple(shape[d] for d in perm)).transpose(inv)
+
+    @property
+    def nblks(self) -> int:
+        return self.matrix.nblks
+
+    def to_dense(self) -> np.ndarray:
+        """Densify (test oracle; ref tensor unittest pattern)."""
+        full = tuple(int(s.sum()) for s in self.blk_sizes)
+        out = np.zeros(full, dtype=np.dtype(self.dtype))
+        offs = [np.concatenate([[0], np.cumsum(s)]) for s in self.blk_sizes]
+        for idx, blk in self.iterate_blocks():
+            sl = tuple(
+                slice(offs[d][idx[d]], offs[d][idx[d]] + blk.shape[d])
+                for d in range(self.ndim)
+            )
+            out[sl] = blk
+        return out
+
+    def block_indices(self) -> List[Tuple[int, ...]]:
+        rows, cols = self.matrix.entry_coords()
+        return [self.block_coords(int(r), int(c)) for r, c in zip(rows, cols)]
+
+    def __repr__(self) -> str:
+        return (
+            f"BlockSparseTensor({self.name!r}, rank {self.ndim}, "
+            f"nblks/dim {self.nblks_per_dim}, map {self.row_dims}|{self.col_dims})"
+        )
+
+
+def create_tensor(
+    name: str,
+    blk_sizes: List,
+    row_dims: Optional[Sequence[int]] = None,
+    col_dims: Optional[Sequence[int]] = None,
+    dtype=np.float64,
+) -> BlockSparseTensor:
+    """Create a tensor (ref `dbcsr_t_create`).  Default mapping splits
+    dims in half: first ceil(d/2) dims -> rows."""
+    nd = len(blk_sizes)
+    if row_dims is None and col_dims is None:
+        half = (nd + 1) // 2
+        row_dims, col_dims = tuple(range(half)), tuple(range(half, nd))
+    elif row_dims is None:
+        row_dims = tuple(d for d in range(nd) if d not in set(col_dims))
+    elif col_dims is None:
+        col_dims = tuple(d for d in range(nd) if d not in set(row_dims))
+    return BlockSparseTensor(name, blk_sizes, row_dims, col_dims, dtype)

@@ -1,0 +1,87 @@
+"""TAS layer tests: all transpose combos on random tall matrices with
+random block sizes (modeled on `dbcsr_tas_unittest.F:48-100`)."""
+
+import numpy as np
+import pytest
+
+from dbcsr_tpu import create, make_random_matrix, to_dense
+from dbcsr_tpu.tas import TASMatrix, choose_nsplit, estimate_split_factor, tas_multiply
+
+
+def _tall(name, nlong, nshort, seed, occ=0.3):
+    rng = np.random.default_rng(seed)
+    long_sizes = rng.integers(2, 6, nlong).astype(np.int32)
+    short_sizes = rng.integers(2, 6, nshort).astype(np.int32)
+    return long_sizes, short_sizes, rng
+
+
+@pytest.mark.parametrize("transa,transb", [("N", "N"), ("T", "N"), ("N", "T"), ("T", "T")])
+def test_tas_multiply_transposes(transa, transb):
+    """Tall A (m long), small B; all transpose combos vs dense oracle."""
+    ls, ss, rng = _tall("x", 30, 4, seed=1)
+    # op(A): (m x k) with m long; op(B): (k x n)
+    a_shape = (ls, ss) if transa == "N" else (ss, ls)
+    b_shape = (ss, ss) if transb == "N" else (ss, ss)
+    a = make_random_matrix("a", a_shape[0], a_shape[1], occupation=0.3, rng=rng)
+    b = make_random_matrix("b", b_shape[0], b_shape[1], occupation=0.6, rng=rng)
+    c = create("c", ls, ss)
+    tas_multiply(transa, transb, 1.0, a, b, 0.0, c, nsplit=4)
+    da = to_dense(a) if transa == "N" else to_dense(a).T
+    db = to_dense(b) if transb == "N" else to_dense(b).T
+    np.testing.assert_allclose(to_dense(c), da @ db, rtol=1e-12, atol=1e-12)
+
+
+def test_tas_k_split_inner_product():
+    """A^T B with k long (two tall matrices) must split over k and sum."""
+    ls, ss, rng = _tall("x", 40, 3, seed=2)
+    a = make_random_matrix("a", ls, ss, occupation=0.4, rng=rng)  # (k x m)
+    b = make_random_matrix("b", ls, ss, occupation=0.4, rng=rng)  # (k x n)
+    c = create("c", ss, ss)
+    tas_multiply("T", "N", 1.0, a, b, 0.0, c, nsplit=5)
+    np.testing.assert_allclose(to_dense(c), to_dense(a).T @ to_dense(b),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_tas_beta_accumulate():
+    ls, ss, rng = _tall("x", 20, 3, seed=3)
+    a = make_random_matrix("a", ls, ss, occupation=0.5, rng=rng)
+    b = make_random_matrix("b", ss, ss, occupation=0.8, rng=rng)
+    c = make_random_matrix("c", ls, ss, occupation=0.3, rng=rng)
+    c0 = to_dense(c)
+    tas_multiply("N", "N", 2.0, a, b, 0.5, c, nsplit=3)
+    np.testing.assert_allclose(to_dense(c), 2.0 * to_dense(a) @ to_dense(b) + 0.5 * c0,
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_tas_matches_single_multiply():
+    """nsplit>1 must give the same result as nsplit=1."""
+    from dbcsr_tpu import multiply
+
+    ls, ss, rng = _tall("x", 25, 4, seed=4)
+    a = make_random_matrix("a", ls, ss, occupation=0.4, rng=rng)
+    b = make_random_matrix("b", ss, ss, occupation=0.7, rng=rng)
+    c1 = create("c1", ls, ss)
+    c2 = create("c2", ls, ss)
+    multiply("N", "N", 1.0, a, b, 0.0, c1)
+    tas_multiply("N", "N", 1.0, a, b, 0.0, c2, nsplit=6)
+    np.testing.assert_allclose(to_dense(c2), to_dense(c1), rtol=1e-12, atol=1e-12)
+
+
+def test_tas_wrapper_and_auto_split():
+    ls, ss, rng = _tall("x", 50, 3, seed=5)
+    a = TASMatrix(make_random_matrix("a", ls, ss, occupation=0.2, rng=rng))
+    b = TASMatrix(make_random_matrix("b", ss, ss, occupation=0.9, rng=rng))
+    c = TASMatrix(create("c", ls, ss))
+    assert a.long_dim == "rows"
+    tas_multiply("N", "N", 1.0, a, b, 0.0, c)  # auto nsplit
+    np.testing.assert_allclose(to_dense(c.matrix),
+                               to_dense(a.matrix) @ to_dense(b.matrix),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_split_heuristics():
+    sf = estimate_split_factor(10000, 100, 100, 10**5, 10**4, 10**5)
+    assert sf > 1
+    assert choose_nsplit(sf, ngroups_max=8, nblks_long=1000) <= 8
+    assert choose_nsplit(0.5, 8, 10) == 1
+    assert choose_nsplit(100.0, 8, 3) == 3

@@ -1,0 +1,133 @@
+"""Tensor layer tests (modeled on `dbcsr_tensor_unittest.F:101-300`):
+format permutations must carry identical blocks; 3- and 4-rank
+contractions vs einsum oracle."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from dbcsr_tpu.tensor import BlockSparseTensor, contract, create_tensor, remap, tensor_copy
+
+
+def _rand_tensor(name, blk_sizes, occ, row_dims=None, col_dims=None, seed=0):
+    rng = np.random.default_rng(seed)
+    t = create_tensor(name, blk_sizes, row_dims, col_dims)
+    nblks = t.nblks_per_dim
+    for idx in itertools.product(*(range(n) for n in nblks)):
+        if rng.random() < occ:
+            t.put_block(idx, rng.standard_normal(t.block_shape(idx)))
+    return t.finalize()
+
+
+def test_put_get_roundtrip_rank3():
+    sizes = [[2, 3], [4, 2], [3]]
+    t = create_tensor("t", sizes, (0,), (1, 2))
+    blk = np.random.default_rng(0).standard_normal((3, 2, 3))
+    t.put_block((1, 1, 0), blk)
+    t.finalize()
+    np.testing.assert_array_equal(t.get_block((1, 1, 0)), blk)
+    assert t.get_block((0, 0, 0)) is None
+
+
+@pytest.mark.parametrize("mapping", [((0,), (1, 2)), ((1,), (0, 2)),
+                                     ((0, 1), (2,)), ((2, 0), (1,))])
+def test_formats_carry_identical_blocks(mapping):
+    """ref dbcsr_t_test_formats: same tensor in different nd->2d mappings
+    must hold identical blocks."""
+    sizes = [[2, 3], [4, 2], [3, 2]]
+    t0 = _rand_tensor("t0", sizes, occ=0.7, seed=1)
+    t1 = remap(t0, *mapping)
+    assert sorted(t0.block_indices()) == sorted(t1.block_indices())
+    for idx, blk in t0.iterate_blocks():
+        np.testing.assert_array_equal(t1.get_block(idx), blk)
+    np.testing.assert_array_equal(t0.to_dense(), t1.to_dense())
+
+
+def test_tensor_copy_between_mappings():
+    sizes = [[2, 2], [3], [2, 4]]
+    src = _rand_tensor("s", sizes, occ=0.8, row_dims=(0, 1), col_dims=(2,), seed=2)
+    dst = create_tensor("d", sizes, (2,), (1, 0))
+    tensor_copy(dst, src)
+    np.testing.assert_array_equal(dst.to_dense(), src.to_dense())
+
+
+def test_contract_rank3_with_matrix():
+    """T(i,j,k) * M(k,l) -> C(i,j,l)  (3-center integral pattern)."""
+    si, sj, sk, sl = [2, 3], [3, 2], [4, 2], [2, 2]
+    a = _rand_tensor("a", [si, sj, sk], occ=0.8, seed=3)
+    b = _rand_tensor("b", [sk, sl], occ=0.9, seed=4)
+    c = create_tensor("c", [si, sj, sl])
+    c.finalize()
+    contract(1.0, a, b, 0.0, c,
+             contract_a=(2,), notcontract_a=(0, 1),
+             contract_b=(0,), notcontract_b=(1,),
+             map_1=(0, 1), map_2=(2,))
+    want = np.einsum("ijk,kl->ijl", a.to_dense(), b.to_dense())
+    np.testing.assert_allclose(c.to_dense(), want, rtol=1e-12, atol=1e-12)
+
+
+def test_contract_rank3_rank3_over_two_dims():
+    """A(i,a,b) * B(j,a,b) -> C(i,j) (RPA-like double contraction)."""
+    si, sj, sa, sb = [2, 2], [3], [2, 3], [2, 2]
+    a = _rand_tensor("a", [si, sa, sb], occ=0.9, seed=5)
+    b = _rand_tensor("b", [sj, sa, sb], occ=0.9, seed=6)
+    c = create_tensor("c", [si, sj])
+    c.finalize()
+    contract(1.0, a, b, 0.0, c,
+             contract_a=(1, 2), notcontract_a=(0,),
+             contract_b=(1, 2), notcontract_b=(0,),
+             map_1=(0,), map_2=(1,))
+    want = np.einsum("iab,jab->ij", a.to_dense(), b.to_dense())
+    np.testing.assert_allclose(c.to_dense(), want, rtol=1e-12, atol=1e-12)
+
+
+def test_contract_beta_and_alpha():
+    si, sk = [2, 3], [3, 2]
+    a = _rand_tensor("a", [si, sk], occ=1.0, seed=7)
+    b = _rand_tensor("b", [sk, si], occ=1.0, seed=8)
+    c = _rand_tensor("c", [si, si], occ=0.5, seed=9)
+    c0 = c.to_dense()
+    contract(2.0, a, b, 0.5, c,
+             contract_a=(1,), notcontract_a=(0,),
+             contract_b=(0,), notcontract_b=(1,))
+    want = 2.0 * np.einsum("ik,kj->ij", a.to_dense(), b.to_dense()) + 0.5 * c0
+    np.testing.assert_allclose(c.to_dense(), want, rtol=1e-12, atol=1e-12)
+
+
+def test_contract_into_nonstandard_c_mapping():
+    """C stored with a different mapping than the contraction layout."""
+    si, sj, sk = [2, 2], [3, 2], [2, 3]
+    a = _rand_tensor("a", [si, sk], occ=1.0, seed=10)
+    b = _rand_tensor("b", [sk, sj], occ=1.0, seed=11)
+    c = create_tensor("c", [si, sj], row_dims=(1,), col_dims=(0,))
+    c.finalize()
+    contract(1.0, a, b, 0.0, c,
+             contract_a=(1,), notcontract_a=(0,),
+             contract_b=(0,), notcontract_b=(1,))
+    want = a.to_dense() @ b.to_dense()
+    np.testing.assert_allclose(c.to_dense(), want, rtol=1e-12, atol=1e-12)
+
+
+def test_contract_rank4():
+    """A(i,j,a,b) * B(a,b,k,l) -> C(i,j,k,l)."""
+    s = [2, 2]
+    a = _rand_tensor("a", [s, s, s, s], occ=0.6, seed=12)
+    b = _rand_tensor("b", [s, s, s, s], occ=0.6, seed=13)
+    c = create_tensor("c", [s, s, s, s])
+    c.finalize()
+    contract(1.0, a, b, 0.0, c,
+             contract_a=(2, 3), notcontract_a=(0, 1),
+             contract_b=(0, 1), notcontract_b=(2, 3),
+             map_1=(0, 1), map_2=(2, 3))
+    want = np.einsum("ijab,abkl->ijkl", a.to_dense(), b.to_dense())
+    np.testing.assert_allclose(c.to_dense(), want, rtol=1e-12, atol=1e-12)
+
+
+def test_contract_validates_blockings():
+    a = _rand_tensor("a", [[2], [3]], occ=1.0, seed=14)
+    b = _rand_tensor("b", [[4], [2]], occ=1.0, seed=15)
+    c = create_tensor("c", [[2], [2]])
+    c.finalize()
+    with pytest.raises(ValueError):
+        contract(1.0, a, b, 0.0, c, (1,), (0,), (0,), (1,))
