@@ -49,7 +49,19 @@ from dbcsr_tpu.ops.operations import (
     get_diag,
     trace,
 )
-from dbcsr_tpu.ops.transformations import new_transposed, desymmetrize, redistribute
-from dbcsr_tpu.ops.test_methods import make_random_matrix, to_dense, from_dense
+from dbcsr_tpu.ops.transformations import (
+    desymmetrize,
+    new_transposed,
+    redistribute,
+    submatrix,
+)
+from dbcsr_tpu.ops.csr import complete_redistribute, csr_from_matrix, matrix_from_csr
+from dbcsr_tpu.ops.io import binary_read, binary_write
+from dbcsr_tpu.ops.test_methods import (
+    checksum,
+    from_dense,
+    make_random_matrix,
+    to_dense,
+)
 
 __version__ = "0.1.0"

@@ -16,4 +16,10 @@ TPU-native re-design of the reference's MPI machinery (SURVEY §2.3/2.4):
 
 from dbcsr_tpu.parallel.mesh import make_grid, grid_shape
 from dbcsr_tpu.parallel.cannon import cannon_multiply_dense
-from dbcsr_tpu.parallel.dist_matrix import DistMatrix, distribute, collect, multiply_distributed
+from dbcsr_tpu.parallel.dist_matrix import (
+    DistMatrix,
+    collect,
+    distribute,
+    multiply_distributed,
+    replicate,
+)

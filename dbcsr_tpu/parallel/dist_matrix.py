@@ -29,11 +29,14 @@ from dbcsr_tpu.core.matrix import BlockSparseMatrix
 from dbcsr_tpu.parallel.cannon import cannon_multiply_dense
 from dbcsr_tpu.utils.rounding import ceil_div
 
-# sharding of each operand role (Cannon layout, see cannon.py)
+# sharding of each operand role (Cannon layout, see cannon.py);
+# 'R' = fully replicated (ref dbcsr_repl_full, dbcsr_replicate_all,
+# dbcsr_transformations.F:108)
 _ROLE_SPECS = {
     "A": P("pr", ("kl", "pc")),
     "B": P(("kl", "pr"), "pc"),
     "C": P("pr", "pc"),
+    "R": P(),
 }
 
 
@@ -119,6 +122,21 @@ def collect(dm: DistMatrix, drop_zero_blocks: bool = True) -> BlockSparseMatrix:
             if not drop_zero_blocks or np.any(blk != 0):
                 out.put_block(r, c, blk)
     return out.finalize()
+
+
+def replicate(matrix: BlockSparseMatrix, mesh: Mesh, name: Optional[str] = None) -> DistMatrix:
+    """Replicate a matrix onto every device (ref `dbcsr_replicate_all`,
+    `dbcsr_transformations.F:108`) — the layout TAS uses for the small
+    matrix of a split multiply.
+
+    The reference pairs this with `dbcsr_sum_replicated`
+    (`dbcsr_operations.F:2383`) to merge per-rank updates; under jax
+    SPMD a replicated array is single-valued by construction, so that
+    merge is expressed as a `lax.psum` inside whatever shard_map
+    computation produced per-device contributions (see the 'kl'
+    reduction in `cannon.py` for the pattern).
+    """
+    return distribute(matrix, mesh, role="R", name=name)
 
 
 def multiply_distributed(

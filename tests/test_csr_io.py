@@ -1,0 +1,92 @@
+"""CSR conversion round trips (ref `dbcsr_test_csr_conversions.F`),
+binary I/O round trips (ref `dbcsr_test_binary_io`), and
+complete_redistribute re-blocking."""
+
+import numpy as np
+import pytest
+
+from dbcsr_tpu import make_random_matrix, to_dense
+from dbcsr_tpu.ops.csr import complete_redistribute, csr_from_matrix, matrix_from_csr
+from dbcsr_tpu.ops.io import binary_read, binary_write
+from dbcsr_tpu.ops.test_methods import checksum
+
+
+def test_csr_roundtrip():
+    rng = np.random.default_rng(0)
+    m = make_random_matrix("m", [2, 3, 4], [3, 2, 2], occupation=0.5, rng=rng)
+    indptr, indices, data = csr_from_matrix(m)
+    # CSR is a valid scipy-style triple
+    assert len(indptr) == m.nfullrows + 1
+    assert indptr[-1] == len(indices) == len(data)
+    dense = np.zeros((m.nfullrows, m.nfullcols))
+    for r in range(m.nfullrows):
+        for p in range(indptr[r], indptr[r + 1]):
+            dense[r, indices[p]] = data[p]
+    np.testing.assert_array_equal(dense, to_dense(m))
+    m2 = matrix_from_csr("m2", indptr, indices, data,
+                         m.row_blk_sizes, m.col_blk_sizes)
+    np.testing.assert_array_equal(to_dense(m2), to_dense(m))
+
+
+def test_csr_from_symmetric():
+    rng = np.random.default_rng(1)
+    m = make_random_matrix("s", [2, 3], [2, 3], occupation=1.0,
+                           matrix_type="S", rng=rng)
+    indptr, indices, data = csr_from_matrix(m)
+    dense = np.zeros((5, 5))
+    for r in range(5):
+        for p in range(indptr[r], indptr[r + 1]):
+            dense[r, indices[p]] = data[p]
+    np.testing.assert_allclose(dense, to_dense(m))
+
+
+def test_complete_redistribute_reblocking():
+    rng = np.random.default_rng(2)
+    m = make_random_matrix("m", [3, 4, 2], [2, 5], occupation=0.7, rng=rng)
+    m2 = complete_redistribute(m, [2, 2, 5], [4, 3])
+    np.testing.assert_array_equal(to_dense(m2), to_dense(m))
+    # and back
+    m3 = complete_redistribute(m2, [3, 4, 2], [2, 5])
+    np.testing.assert_array_equal(to_dense(m3), to_dense(m))
+
+
+def test_complete_redistribute_rejects_bad_blocking():
+    rng = np.random.default_rng(3)
+    m = make_random_matrix("m", [2, 2], [2, 2], occupation=1.0, rng=rng)
+    with pytest.raises(ValueError):
+        complete_redistribute(m, [3, 3], [2, 2])
+
+
+@pytest.mark.parametrize("dtype,mtype", [
+    (np.float64, "N"), (np.float32, "N"), (np.complex128, "N"), (np.float64, "S"),
+])
+def test_binary_roundtrip(tmp_path, dtype, mtype):
+    rng = np.random.default_rng(4)
+    n = [2, 3, 4]
+    m = make_random_matrix("m", n, n, dtype=dtype, occupation=0.6,
+                           matrix_type=mtype, rng=rng)
+    path = str(tmp_path / "mat.dbcsr")
+    binary_write(m, path)
+    m2 = binary_read(path)
+    assert m2.matrix_type == m.matrix_type
+    assert np.dtype(m2.dtype) == np.dtype(dtype)
+    np.testing.assert_array_equal(to_dense(m2), to_dense(m))
+    assert checksum(m2) == checksum(m)
+    assert checksum(m2, pos=True) == checksum(m, pos=True)
+
+
+def test_binary_read_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.bin"
+    p.write_bytes(b"NOTDBCSR" + b"\0" * 64)
+    with pytest.raises(ValueError):
+        binary_read(str(p))
+
+
+def test_replicate_on_mesh():
+    from dbcsr_tpu.parallel import collect, make_grid, replicate
+
+    rng = np.random.default_rng(5)
+    m = make_random_matrix("m", [2, 3], [2, 2], occupation=1.0, rng=rng)
+    mesh = make_grid(8)
+    dm = replicate(m, mesh)
+    np.testing.assert_array_equal(to_dense(collect(dm)), to_dense(m))
