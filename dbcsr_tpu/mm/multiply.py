@@ -244,8 +244,36 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
 
 
 def _candidates(a, b, c, filter_eps, fr, lr, fc, lc, fk, lk):
-    """Vectorized symbolic product: all (i, k, j) triples as parallel
-    arrays (a_ent indexes op(A) entries, b_ent op(B) entries)."""
+    """Symbolic product: all (i, k, j) triples as parallel arrays
+    (a_ent indexes op(A) entries, b_ent op(B) entries).  Uses the native
+    C++ engine when available; the NumPy path below is the fallback and
+    the reference implementation for tests."""
+    na2 = nb2 = row_eps = None
+    if filter_eps is not None:
+        # squared f32 norms, per-A-row eps (ref dbcsr_mm_cannon.F:1098-1105)
+        na2 = a.block_norms().astype(np.float32) ** 2
+        nb2 = b.block_norms().astype(np.float32) ** 2
+        row_counts = np.diff(a.row_ptr)
+        with np.errstate(over="ignore"):  # huge eps -> inf is a valid threshold
+            row_eps = (
+                np.float32(filter_eps) / np.maximum(1, row_counts).astype(np.float32)
+            ) ** 2
+
+    from dbcsr_tpu import native
+
+    res = native.symbolic_product(
+        a.row_ptr, (a.keys % a.nblkcols).astype(np.int32),
+        b.row_ptr, (b.keys % b.nblkcols).astype(np.int32),
+        na2, nb2, row_eps,
+        sym_c=c.matrix_type != NO_SYMMETRY,
+        fr=fr, lr=lr, fc=fc, lc=lc, fk=fk, lk=lk,
+    )
+    if res is not None:
+        return res
+    return _candidates_numpy(a, b, c, na2, nb2, row_eps, fr, lr, fc, lc, fk, lk)
+
+
+def _candidates_numpy(a, b, c, na2, nb2, row_eps, fr, lr, fc, lc, fk, lk):
     rows_a = np.repeat(
         np.arange(a.nblkrows, dtype=np.int64), np.diff(a.row_ptr)
     )
@@ -290,15 +318,7 @@ def _candidates(a, b, c, filter_eps, fr, lr, fc, lc, fk, lk):
         # don't compute the redundant triangle (ref symmetric skip,
         # dbcsr_mm_csr.F:281)
         keep &= i <= j
-    if filter_eps is not None:
-        # squared f32 norms, per-A-row eps (ref dbcsr_mm_cannon.F:1098-1105)
-        na2 = a.block_norms().astype(np.float32) ** 2
-        nb2 = b.block_norms().astype(np.float32) ** 2
-        row_counts = np.diff(a.row_ptr)
-        with np.errstate(over="ignore"):  # huge eps -> inf is a valid threshold
-            row_eps = (
-                np.float32(filter_eps) / np.maximum(1, row_counts).astype(np.float32)
-            ) ** 2
+    if na2 is not None:
         keep &= na2[a_ent] * nb2[b_ent] >= row_eps[i]
     if not keep.all():
         i, j, a_ent, b_ent = i[keep], j[keep], a_ent[keep], b_ent[keep]

@@ -95,20 +95,25 @@ def matrix_from_csr(
     brow = np.searchsorted(row_off, erows, side="right") - 1
     bcol = np.searchsorted(col_off, ecols, side="right") - 1
     bkey = brow * out.nblkcols + bcol
-    uniq = np.unique(bkey)
-    # scatter values into per-block host buffers
-    blocks = {}
-    for key in uniq:
-        r, c = divmod(int(key), out.nblkcols)
-        blocks[key] = np.zeros((out.row_blk_sizes[r], out.col_blk_sizes[c]),
-                               np.dtype(data.dtype))
+    uniq, blk_of_entry = np.unique(bkey, return_inverse=True)
+    ur, uc = np.divmod(uniq, out.nblkcols)
+    bms = out.row_blk_sizes[ur].astype(np.int64)
+    bns = out.col_blk_sizes[uc].astype(np.int64)
+    sizes = bms * bns
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    flat = np.zeros(int(offsets[-1]), np.dtype(data.dtype))
     lr = erows - row_off[brow]
     lc = ecols - col_off[bcol]
-    for e in range(len(erows)):
-        blocks[bkey[e]][lr[e], lc[e]] = data[e]
-    for key, blk in blocks.items():
-        r, c = divmod(int(key), out.nblkcols)
-        out.put_block(r, c, blk)
+    vals = np.ascontiguousarray(data)
+
+    from dbcsr_tpu import native
+
+    if not native.coo_fill_blocks(blk_of_entry, lr, lc, vals,
+                                  offsets[:-1], bns, flat):
+        flat[offsets[blk_of_entry] + lr * bns[blk_of_entry] + lc] = vals
+    for u in range(len(uniq)):
+        blk = flat[offsets[u] : offsets[u + 1]].reshape(bms[u], bns[u])
+        out.put_block(int(ur[u]), int(uc[u]), blk)
     return out.finalize()
 
 
