@@ -223,8 +223,7 @@ class BlockSparseMatrix:
         cols = (keys % self.nblkcols).astype(np.int64)
         self.keys = keys
         self.row_ptr = np.zeros(self.nblkrows + 1, np.int64)
-        np.add.at(self.row_ptr, rows + 1, 1)
-        np.cumsum(self.row_ptr, out=self.row_ptr)
+        self.row_ptr[1:] = np.cumsum(np.bincount(rows, minlength=self.nblkrows))
         bin_ids, slots, shapes = _bin_entries(
             self.row_blk_sizes, self.col_blk_sizes, rows, cols
         )
@@ -244,19 +243,22 @@ class BlockSparseMatrix:
             self.bins.append(_Bin((int(bm), int(bn)), jnp.asarray(host), count))
             self._shape_to_bin[(int(bm), int(bn))] = b
 
-    def set_structure_from_device(self, keys: np.ndarray, bins: List[_Bin]) -> None:
+    def set_structure_from_device(
+        self, keys: np.ndarray, bins: List[_Bin], binning=None
+    ) -> None:
         """Adopt a prebuilt index + device bins (used by the multiply
-        engine, which assembles C on device)."""
+        engine, which assembles C on device).  ``binning`` optionally
+        carries a precomputed ``_bin_entries`` result to avoid
+        recomputing it."""
         keys = np.ascontiguousarray(keys, np.int64)
         rows = (keys // self.nblkcols).astype(np.int64)
         cols = (keys % self.nblkcols).astype(np.int64)
-        bin_ids, slots, shapes = _bin_entries(
-            self.row_blk_sizes, self.col_blk_sizes, rows, cols
-        )
+        if binning is None:
+            binning = _bin_entries(self.row_blk_sizes, self.col_blk_sizes, rows, cols)
+        bin_ids, slots, shapes = binning
         self.keys = keys
         self.row_ptr = np.zeros(self.nblkrows + 1, np.int64)
-        np.add.at(self.row_ptr, rows + 1, 1)
-        np.cumsum(self.row_ptr, out=self.row_ptr)
+        self.row_ptr[1:] = np.cumsum(np.bincount(rows, minlength=self.nblkrows))
         self.ent_bin = bin_ids
         self.ent_slot = slots
         by_shape = {b.shape: b for b in bins}
@@ -362,19 +364,50 @@ class BlockSparseMatrix:
 
 
 def _bin_entries(row_blk_sizes, col_blk_sizes, rows, cols):
-    """Assign each entry a shape-bin id and an in-bin slot (key order)."""
+    """Assign each entry a shape-bin id and an in-bin slot (key order).
+
+    Avoids sorting the (possibly huge) entry list: distinct block SIZES
+    are few (the reference enumerates them the same way,
+    `dbcsr_mm_common.F:309`), so bin ids come from a small size->id
+    lookup and slots from per-bin cumulative counts.
+    """
     n = len(rows)
     if n == 0:
         return np.empty(0, np.int32), np.empty(0, np.int32), []
-    shapes = np.stack([row_blk_sizes[rows], col_blk_sizes[cols]], axis=1)
-    uniq, inv = np.unique(shapes, axis=0, return_inverse=True)
-    inv = inv.astype(np.int32)
-    counts = np.bincount(inv, minlength=len(uniq))
-    starts = np.concatenate([[0], np.cumsum(counts[:-1])])
-    order = np.argsort(inv, kind="stable")
+    ur = np.unique(row_blk_sizes)
+    uc = np.unique(col_blk_sizes)
+    if len(ur) * len(uc) > max(4 * n, 1 << 20):
+        # degenerate many-distinct-sizes case: dense size table would
+        # dwarf the entry list; pay the O(n log n) sort instead
+        code64 = row_blk_sizes[rows].astype(np.int64) << 32 | col_blk_sizes[cols]
+        uniq, inv = np.unique(code64, return_inverse=True)
+        inv = inv.astype(np.int32)
+        shapes = [(int(u >> 32), int(u & 0xFFFFFFFF)) for u in uniq]
+    else:
+        # size -> small id per entry via tiny searchsorted tables
+        rid = np.searchsorted(ur, row_blk_sizes[rows])
+        cid = np.searchsorted(uc, col_blk_sizes[cols])
+        code = rid.astype(np.int32) * len(uc) + cid
+        counts_all = np.bincount(code, minlength=len(ur) * len(uc))
+        present = np.nonzero(counts_all)[0]
+        remap = np.zeros(len(ur) * len(uc), np.int32)
+        remap[present] = np.arange(len(present), dtype=np.int32)
+        inv = remap[code]
+        shapes = [(int(ur[p // len(uc)]), int(uc[p % len(uc)])) for p in present]
+    nbins = len(shapes)
+    if nbins == 1:
+        return inv, np.arange(n, dtype=np.int32), shapes
     slots = np.empty(n, np.int32)
-    slots[order] = (np.arange(n) - np.repeat(starts, counts)).astype(np.int32)
-    return inv, slots, [(int(s[0]), int(s[1])) for s in uniq]
+    if nbins <= 16:
+        for b in range(nbins):
+            idx = np.nonzero(inv == b)[0]
+            slots[idx] = np.arange(len(idx), dtype=np.int32)
+    else:
+        counts = np.bincount(inv, minlength=nbins)
+        starts = np.concatenate([[0], np.cumsum(counts[:-1])])
+        order = np.argsort(inv, kind="stable")
+        slots[order] = (np.arange(n) - np.repeat(starts, counts)).astype(np.int32)
+    return inv, slots, shapes
 
 
 def create(

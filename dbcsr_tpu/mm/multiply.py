@@ -352,7 +352,7 @@ def _rebuild_c(c: BlockSparseMatrix, new_keys: np.ndarray, beta) -> None:
                 beta_dev,
             )
         bins.append(_Bin((bm, bn), data, count))
-    c.set_structure_from_device(new_keys, bins)
+    c.set_structure_from_device(new_keys, bins, binning=(nb, nsl, shapes))
 
 
 def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha) -> int:

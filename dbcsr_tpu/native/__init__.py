@@ -26,23 +26,38 @@ _SO = os.path.join(os.path.dirname(__file__), "libdbcsr_index.so")
 
 
 def _build() -> Optional[str]:
+    # compile to a process-private temp path, then rename atomically so
+    # concurrent ranks never load a partially written .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmds = [
-        ["g++", "-O3", "-fopenmp", "-fPIC", "-shared", _SRC, "-o", _SO],
-        ["g++", "-O3", "-fPIC", "-shared", _SRC, "-o", _SO],  # no OpenMP
+        ["g++", "-O3", "-fopenmp", "-fPIC", "-shared", _SRC, "-o", tmp],
+        ["g++", "-O3", "-fPIC", "-shared", _SRC, "-o", tmp],  # no OpenMP
     ]
     for cmd in cmds:
         try:
             r = subprocess.run(cmd, capture_output=True, timeout=120)
             if r.returncode == 0:
+                os.replace(tmp, _SO)
                 return _SO
         except (OSError, subprocess.TimeoutExpired):
             continue
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
     return None
 
 
+def _fresh() -> bool:
+    try:
+        return os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+    except OSError:
+        return False
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
-    """The loaded native library, building it if needed; None if
-    unavailable or disabled."""
+    """The loaded native library, (re)building it when the source is
+    newer than the shared object; None if unavailable or disabled."""
     global _LIB, _TRIED
     if os.environ.get("DBCSR_TPU_NATIVE", "1") == "0":
         return None
@@ -50,7 +65,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _TRIED:
             return _LIB
         _TRIED = True
-        so = _SO if os.path.exists(_SO) else _build()
+        so = _SO if _fresh() else _build()
         if so is None:
             return None
         try:
@@ -60,19 +75,23 @@ def get_lib() -> Optional[ctypes.CDLL]:
         i64p = ctypes.POINTER(ctypes.c_int64)
         i32p = ctypes.POINTER(ctypes.c_int32)
         f32p = ctypes.POINTER(ctypes.c_float)
-        lib.dbcsr_symbolic_product.restype = ctypes.c_int64
-        lib.dbcsr_symbolic_product.argtypes = [
-            i64p, ctypes.c_int64, i32p, i64p, i32p,
-            f32p, f32p, f32p, ctypes.c_int32,
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int64, i64p, i64p, i64p, i64p,
-        ]
-        lib.dbcsr_coo_fill_blocks.restype = None
-        lib.dbcsr_coo_fill_blocks.argtypes = [
-            ctypes.c_int64, i64p, i64p, i64p,
-            ctypes.c_void_p, ctypes.c_int64, i64p, i64p, ctypes.c_void_p,
-        ]
+        try:
+            lib.dbcsr_symbolic_product.restype = ctypes.c_int64
+            lib.dbcsr_symbolic_product.argtypes = [
+                i64p, ctypes.c_int64, i32p, i64p, i32p,
+                f32p, f32p, f32p, ctypes.c_int32,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, i64p, i64p, i64p, i64p,
+            ]
+            lib.dbcsr_coo_fill_blocks.restype = None
+            lib.dbcsr_coo_fill_blocks.argtypes = [
+                ctypes.c_int64, i64p, i64p, i64p,
+                ctypes.c_void_p, ctypes.c_int64, i64p, i64p, ctypes.c_void_p,
+            ]
+        except AttributeError:
+            # stale library missing an expected symbol -> NumPy fallback
+            return None
         _LIB = lib
         return _LIB
 

@@ -65,7 +65,7 @@ int64_t dbcsr_symbolic_product(
         const int32_t j = b_cols[f];
         if ((fc >= 0 && j < fc) || (lc >= 0 && j > lc)) continue;
         if (sym_c && i > j) continue;
-        if (use_eps && an2 * b_norms2[f] < eps2) continue;
+        if (use_eps && !(an2 * b_norms2[f] >= eps2)) continue;  // NaN -> drop, as numpy
         ++cnt;
       }
     }
@@ -91,7 +91,7 @@ int64_t dbcsr_symbolic_product(
         const int32_t j = b_cols[f];
         if ((fc >= 0 && j < fc) || (lc >= 0 && j > lc)) continue;
         if (sym_c && i > j) continue;
-        if (use_eps && an2 * b_norms2[f] < eps2) continue;
+        if (use_eps && !(an2 * b_norms2[f] >= eps2)) continue;  // NaN -> drop, as numpy
         out_i[w] = i;
         out_j[w] = j;
         out_a[w] = e;
@@ -117,7 +117,8 @@ void dbcsr_coo_fill_blocks(
     const int64_t* blk_buf_offset, // per block: offset (in elements) in out
     const int64_t* blk_ncols,      // per block: leading dimension
     char* out) {
-#pragma omp parallel for schedule(static)
+  // serial on purpose: duplicate (row, col) entries in non-canonical CSR
+  // input must resolve deterministically last-wins, not by thread race
   for (int64_t e = 0; e < nnz; ++e) {
     const int64_t b = blk_of_entry[e];
     const int64_t pos =

@@ -184,7 +184,7 @@ def add(
                 fac * jnp.take(src.bins[src_bin].data, jnp.asarray(src_slots), axis=0)
             )
         bins.append(_Bin((bm, bn), data, count))
-    matrix_a.set_structure_from_device(new_keys, bins)
+    matrix_a.set_structure_from_device(new_keys, bins, binning=(nb, nsl, shapes))
     return matrix_a
 
 
@@ -241,7 +241,7 @@ def hadamard_product(
             )
             data = data.at[jnp.asarray(nsl[mask])].set(prod)
         bins.append(_Bin((bm, bn), data, count))
-    out.set_structure_from_device(common, bins)
+    out.set_structure_from_device(common, bins, binning=(nb, nsl, shapes))
     return out
 
 

@@ -172,7 +172,7 @@ def submatrix(
         perm[nsl[mask]] = old_slot[mask]
         data = _gather_blocks(src_bin.data, jnp.asarray(perm), bucket_size(count))
         bins.append(_Bin((bm, bn), data, count))
-    out.set_structure_from_device(new_keys, bins)
+    out.set_structure_from_device(new_keys, bins, binning=(nb, nsl, shapes))
     return out
 
 

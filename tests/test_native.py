@@ -50,7 +50,9 @@ def test_symbolic_product_with_filtering(lib):
     c = create("c", n, n).finalize()
     na2 = (a.block_norms().astype(np.float32)) ** 2
     nb2 = (b.block_norms().astype(np.float32)) ** 2
-    row_eps = np.full(len(n), np.float32(2.0), np.float32)
+    # threshold sits inside the norm^2-product distribution (~16^2 for
+    # 4x4 standard-normal blocks) so SOME but not all candidates drop
+    row_eps = np.full(len(n), np.float32(200.0), np.float32)
     got = native.symbolic_product(
         a.row_ptr, (a.keys % a.nblkcols).astype(np.int32),
         b.row_ptr, (b.keys % b.nblkcols).astype(np.int32),
@@ -58,7 +60,11 @@ def test_symbolic_product_with_filtering(lib):
     )
     want = _candidates_numpy(a, b, c, na2, nb2, row_eps,
                              None, None, None, None, None, None)
-    assert len(got[0]) < a.nblks * b.nblks  # filtering really dropped some
+    unfiltered = native.symbolic_product(
+        a.row_ptr, (a.keys % a.nblkcols).astype(np.int32),
+        b.row_ptr, (b.keys % b.nblkcols).astype(np.int32),
+    )
+    assert len(got[0]) < len(unfiltered[0])  # filtering really dropped some
     for g, w in zip(got, want):
         np.testing.assert_array_equal(g, w)
 
@@ -86,3 +92,26 @@ def test_multiply_uses_native_same_result(lib):
     multiply("N", "N", 1.0, a, b, 0.0, c, filter_eps=1e-30)
     np.testing.assert_allclose(to_dense(c), to_dense(a) @ to_dense(b),
                                rtol=1e-12, atol=1e-12)
+
+
+def test_symbolic_product_nan_norm_product_drops(lib):
+    # inf (overflowed f32 norm^2) * 0.0 (zero block) = NaN: both paths
+    # must DROP the candidate (numpy: keep only when product >= eps)
+    rng = np.random.default_rng(3)
+    n = [2] * 4
+    a = make_random_matrix("a", n, n, occupation=1.0, rng=rng)
+    b = make_random_matrix("b", n, n, occupation=1.0, rng=rng)
+    c = create("c", n, n).finalize()
+    na2 = np.full(a.nblks, np.float32(np.inf), np.float32)
+    nb2 = np.zeros(b.nblks, np.float32)
+    row_eps = np.full(len(n), np.float32(1e-6), np.float32)
+    got = native.symbolic_product(
+        a.row_ptr, (a.keys % a.nblkcols).astype(np.int32),
+        b.row_ptr, (b.keys % b.nblkcols).astype(np.int32),
+        na2, nb2, row_eps, sym_c=False,
+    )
+    want = _candidates_numpy(a, b, c, na2, nb2, row_eps,
+                             None, None, None, None, None, None)
+    assert len(got[0]) == 0
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
