@@ -29,13 +29,14 @@ from dbcsr_tpu.core.kinds import (
 )
 from dbcsr_tpu.core.config import get_config, set_config, print_config
 from dbcsr_tpu.core.lib import init_lib, finalize_lib, print_statistics
-from dbcsr_tpu.core.dist import ProcessGrid, Distribution
+from dbcsr_tpu.core.dist import ProcessGrid, Distribution, dist_bin
 from dbcsr_tpu.core.matrix import BlockSparseMatrix, create
 from dbcsr_tpu.mm.multiply import multiply
 from dbcsr_tpu.ops.operations import (
     add,
     add_on_diag,
     copy,
+    crop_matrix,
     dot,
     filter_matrix,
     frobenius_norm,
@@ -48,6 +49,8 @@ from dbcsr_tpu.ops.operations import (
     set_diag,
     get_diag,
     trace,
+    triu,
+    verify_matrix,
 )
 from dbcsr_tpu.ops.transformations import (
     desymmetrize,

@@ -1,19 +1,229 @@
-"""Fused Pallas TPU kernel for stack processing (placeholder).
+"""Fused Pallas TPU kernel for parameter-stack processing.
 
-Will fuse gather -> small-GEMM -> segment-accumulate in VMEM, replacing
-the reference's five CUDA kernel families
-(`src/acc/libsmm_acc/kernels/smm_acc_dnt_*.h`) with one blocked Pallas
-matmul whose tuning space is (entries-per-step, k-concat length, vmem
-budget).  Until implemented, `supports` returns False and the XLA path
-in `dbcsr_tpu.acc.smm` is used.
+TPU-native replacement for the reference's five CUDA kernel families
+(`src/acc/libsmm_acc/kernels/smm_acc_dnt_{tiny,small,medium,largeDB1,
+largeDB2}.h`): a single blocked kernel whose tuning knob is the
+*grouping* R — how many stack entries one grid step processes (the
+CUDA kernels' `grouping` template parameter plays the same role).
+
+Design (vs the CUDA design, by intent):
+
+* The stack arrives **sorted by C block** (the engine guarantees it),
+  so each C block is one contiguous run of entries.  Runs are chopped
+  into grid steps of R entries; a step's contributions are summed into
+  a float32 VMEM accumulator that persists across the run, and the C
+  block is written back once when the run ends — no atomics
+  (`atomicAdd` in `smm_acc_common.h`) and bit-reproducible order.
+* A/B blocks are *gathered by the Pallas pipeline itself*: the int32
+  stack arrays are scalar-prefetch operands and the BlockSpec
+  `index_map`s read them to pick which (1, m, k) block to DMA next —
+  the Mosaic pipeline double-buffers these fetches exactly like the
+  CUDA kernels' double-buffered shared-memory loads (largeDB1/2).
+* Short runs are padded to a multiple of R with entries pointing at a
+  guaranteed-zero block row (the engine's bucket padding), which
+  contribute exact zeros — the analog of the reference's masked
+  tail entries.
+
+Only real float32/bfloat16 stacks take this path (`supports`); f64 and
+complex fall back to the XLA gather/segment-sum path in
+`dbcsr_tpu.acc.smm` (TPU has no native f64 MXU path to win with).
 """
 
 from __future__ import annotations
 
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_SUPPORTED = (np.dtype(np.float32), np.dtype(jnp.bfloat16))
+# blocks bigger than this blow the VMEM budget for 2*R in-flight panels
+_MAX_DIM = 256
+
 
 def supports(c_data, a_data, b_data) -> bool:
-    return False
+    if jnp.dtype(c_data.dtype) not in _SUPPORTED:
+        return False
+    if jnp.dtype(a_data.dtype) != jnp.dtype(c_data.dtype):
+        return False
+    if jnp.dtype(b_data.dtype) != jnp.dtype(c_data.dtype):
+        return False
+    dims = a_data.shape[1:] + b_data.shape[1:] + c_data.shape[1:]
+    return max(dims) <= _MAX_DIM
 
 
-def process_stack_pallas(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
-    raise NotImplementedError("pallas SMM kernel not yet implemented")
+def _choose_grouping(run_lengths: np.ndarray) -> int:
+    """Pick R (entries per grid step) from the run-length distribution —
+    the one-knob analog of the CUDA `grouping` parameter."""
+    avg = float(run_lengths.mean()) if len(run_lengths) else 1.0
+    for r in (8, 4, 2):
+        if avg >= r * 0.75:
+            return r
+    return 1
+
+
+def build_grouped_stack(c_idx: np.ndarray, a_idx: np.ndarray, b_idx: np.ndarray,
+                        a_pad_row: int, b_pad_row: int, grouping: int | None = None):
+    """Chop the (sorted-by-c) stack into grid steps of R entries.
+
+    Returns int32 arrays ai2 (S, R), bi2 (S, R), ci2 (S,) where padded
+    slots point at (a_pad_row, b_pad_row) — a zero block row each.
+    """
+    s_total = len(c_idx)
+    run_first = np.flatnonzero(np.diff(c_idx)) + 1
+    run_starts = np.concatenate([[0], run_first])
+    run_lens = np.diff(np.concatenate([run_starts, [s_total]]))
+    r_grp = grouping or _choose_grouping(run_lens)
+    steps_per_run = -(-run_lens // r_grp)
+    nsteps = int(steps_per_run.sum())
+    # flat destination slot of each stack entry: step base of its run
+    # (in units of R) plus its position within the run
+    run_of = np.repeat(np.arange(len(run_lens)), run_lens)
+    pos_in_run = np.arange(s_total) - run_starts[run_of]
+    step_base = np.concatenate([[0], np.cumsum(steps_per_run)])[:-1]
+    dst = step_base[run_of] * r_grp + pos_in_run
+    ai2 = np.full(nsteps * r_grp, a_pad_row, np.int32)
+    bi2 = np.full(nsteps * r_grp, b_pad_row, np.int32)
+    ai2[dst] = a_idx
+    bi2[dst] = b_idx
+    ci2 = np.empty(nsteps, np.int32)
+    ci2[step_base[run_of] + pos_in_run // r_grp] = c_idx
+    return ai2.reshape(nsteps, r_grp), bi2.reshape(nsteps, r_grp), ci2, r_grp
+
+
+def _a_map(s, ai, bi, ci, *, r):
+    return (ai[s, r], 0, 0)
+
+
+def _b_map(s, ai, bi, ci, *, r):
+    return (bi[s, r], 0, 0)
+
+
+def _c_map(s, ai, bi, ci):
+    return (ci[s], 0, 0)
+
+
+def _smm_kernel(ai_ref, bi_ref, ci_ref, *refs, r_grp):
+    a_refs = refs[:r_grp]
+    b_refs = refs[r_grp : 2 * r_grp]
+    alpha_ref = refs[2 * r_grp]
+    c_ref = refs[2 * r_grp + 1]
+    o_ref = refs[2 * r_grp + 2]
+    acc_ref = refs[2 * r_grp + 3]
+    s = pl.program_id(0)
+    cur = ci_ref[s]
+    prev = ci_ref[jnp.maximum(s - 1, 0)]
+    first = jnp.logical_or(s == 0, cur != prev)
+    contrib = jnp.zeros(acc_ref.shape, jnp.float32)
+    for r in range(r_grp):
+        contrib = contrib + jax.lax.dot_general(
+            a_refs[r][0],
+            b_refs[r][0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    contrib = alpha_ref[0, 0] * contrib
+
+    @pl.when(first)
+    def _():
+        acc_ref[...] = c_ref[0].astype(jnp.float32) + contrib
+
+    @pl.when(jnp.logical_not(first))
+    def _():
+        acc_ref[...] = acc_ref[...] + contrib
+
+    o_ref[0] = acc_ref[...].astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("r_grp", "interpret"),
+    donate_argnums=(0,),
+)
+def _pallas_process(c_data, a_data, b_data, ai2, bi2, ci2, alpha, *, r_grp, interpret):
+    nsteps = ci2.shape[0]
+    m, k = a_data.shape[1:]
+    n = b_data.shape[2]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nsteps,),
+        in_specs=[
+            *[
+                pl.BlockSpec((1, m, k), functools.partial(_a_map, r=r))
+                for r in range(r_grp)
+            ],
+            *[
+                pl.BlockSpec((1, k, n), functools.partial(_b_map, r=r))
+                for r in range(r_grp)
+            ],
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, m, n), _c_map),
+        ],
+        out_specs=pl.BlockSpec((1, m, n), _c_map),
+        scratch_shapes=[pltpu.VMEM((m, n), jnp.float32)],
+    )
+    kernel = functools.partial(_smm_kernel, r_grp=r_grp)
+    # operand positions (incl. the 3 scalar-prefetch args):
+    # 0..2 = ai2/bi2/ci2, 3..3+2R-1 = A/B, 3+2R = alpha, 3+2R+1 = c_data
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(c_data.shape, c_data.dtype),
+        input_output_aliases={3 + 2 * r_grp + 1: 0},
+        interpret=interpret,
+    )(
+        ai2, bi2, ci2,
+        *([a_data] * r_grp),
+        *([b_data] * r_grp),
+        alpha,
+        c_data,
+    )
+
+
+def process_stack_pallas(
+    c_data,
+    a_data,
+    b_data,
+    a_idx: np.ndarray,
+    b_idx: np.ndarray,
+    c_idx: np.ndarray,
+    alpha,
+    a_pad_row: int | None = None,
+    b_pad_row: int | None = None,
+):
+    """Process a flat stack (host int arrays, sorted by ``c_idx``).
+
+    ``a_pad_row``/``b_pad_row`` must index a zero row of the data
+    arrays; when None, a zero row is appended on the fly.
+    """
+    if len(a_idx) == 0:
+        return c_data
+    if a_pad_row is None:
+        a_data = jnp.concatenate([a_data, jnp.zeros((1,) + a_data.shape[1:], a_data.dtype)])
+        a_pad_row = a_data.shape[0] - 1
+    if b_pad_row is None:
+        b_data = jnp.concatenate([b_data, jnp.zeros((1,) + b_data.shape[1:], b_data.dtype)])
+        b_pad_row = b_data.shape[0] - 1
+    ai2, bi2, ci2, r_grp = build_grouped_stack(
+        np.asarray(c_idx), np.asarray(a_idx), np.asarray(b_idx), a_pad_row, b_pad_row
+    )
+    from dbcsr_tpu.utils.rounding import bucket_size
+
+    # bucket the step count so jit shapes recur; padding steps repeat the
+    # final C block with all-zero-block entries (exact no-ops)
+    cap = bucket_size(ai2.shape[0])
+    if cap > ai2.shape[0]:
+        pad = cap - ai2.shape[0]
+        ai2 = np.concatenate([ai2, np.full((pad, r_grp), a_pad_row, np.int32)])
+        bi2 = np.concatenate([bi2, np.full((pad, r_grp), b_pad_row, np.int32)])
+        ci2 = np.concatenate([ci2, np.full(pad, ci2[-1], np.int32)])
+    alpha_arr = jnp.asarray([[alpha]], dtype=jnp.float32)
+    interpret = jax.devices()[0].platform != "tpu"
+    return _pallas_process(
+        c_data, a_data, b_data,
+        jnp.asarray(ai2), jnp.asarray(bi2), jnp.asarray(ci2),
+        alpha_arr, r_grp=r_grp, interpret=interpret,
+    )

@@ -104,7 +104,8 @@ def pad_stack(a_idx, b_idx, c_idx, target_len: int, drop_segment: int):
     )
 
 
-def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0):
+def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
+                  a_pad_row=None, b_pad_row=None):
     """Process a full (possibly large) stack, chunked to mm_stack_size.
 
     ``c_idx`` must be sorted ascending (the stack builder guarantees it);
@@ -112,12 +113,23 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0):
     happens in a fixed, reproducible order (ref determinism requirement:
     stack order is deterministic in `dbcsr_mm_csr.F`).
 
+    ``a_pad_row``/``b_pad_row`` optionally name a guaranteed-zero row of
+    the data arrays (the engine's bucket padding) used by the Pallas
+    path to mask short groups.
+
     Returns the updated ``c_data`` device array.
     """
     cfg = get_config()
     S = len(a_idx)
     if S == 0:
         return c_data
+    if _pallas_enabled(cfg, c_data, a_data, b_data):
+        from dbcsr_tpu.acc.pallas_smm import process_stack_pallas
+
+        return process_stack_pallas(
+            c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha,
+            a_pad_row=a_pad_row, b_pad_row=b_pad_row,
+        )
     nseg = c_data.shape[0]
     alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)
     chunk = max(cfg.mm_stack_size, 1)
@@ -132,10 +144,6 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0):
     ai = jnp.asarray(ai.reshape(nchunks, chunk))
     bi = jnp.asarray(bi.reshape(nchunks, chunk))
     ci = jnp.asarray(ci.reshape(nchunks, chunk))
-    if _pallas_enabled(cfg, c_data, a_data, b_data):
-        from dbcsr_tpu.acc.pallas_smm import process_stack_pallas
-
-        return process_stack_pallas(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
     return _process_stack_xla(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
 
 

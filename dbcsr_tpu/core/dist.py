@@ -86,3 +86,31 @@ def random_dist(nblks: int, nbins: int, seed: int = 0) -> np.ndarray:
 
 def cyclic_dist(nblks: int, nbins: int) -> np.ndarray:
     return (np.arange(nblks) % nbins).astype(np.int32)
+
+
+def dist_bin(
+    nelements: int,
+    nbins: int,
+    element_sizes: Optional[np.ndarray] = None,
+    rng: Optional[np.random.Generator] = None,
+) -> np.ndarray:
+    """Load-aware 1-D binning (ref `dbcsr_dist_bin`,
+    `dbcsr_dist_operations.F:705-745`): with sizes, assign each element
+    in order to the currently least-loaded bin (min-heap); without,
+    uniform random."""
+    import heapq
+
+    if element_sizes is None:
+        rng = rng or np.random.default_rng()
+        return rng.integers(0, nbins, nelements).astype(np.int32)
+    element_sizes = np.asarray(element_sizes)
+    if len(element_sizes) != nelements:
+        raise ValueError("element_sizes length != nelements")
+    heap = [(0, b) for b in range(nbins)]
+    heapq.heapify(heap)
+    out = np.empty(nelements, np.int32)
+    for i in range(nelements):
+        load, b = heapq.heappop(heap)
+        out[i] = b
+        heapq.heappush(heap, (load + int(element_sizes[i]), b))
+    return out

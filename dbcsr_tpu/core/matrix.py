@@ -24,8 +24,10 @@ lower-triangle writes onto the stored transpose.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, Iterator, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -64,6 +66,12 @@ def _fold_block(block: np.ndarray, matrix_type: str) -> np.ndarray:
     if matrix_type == HERMITIAN:
         return block.conj().T
     raise AssertionError(matrix_type)
+
+
+@functools.partial(jax.jit, static_argnames=("count",))
+def _rezero_pad_rows(data, count: int):
+    mask = (jnp.arange(data.shape[0]) < count).reshape(-1, 1, 1)
+    return jnp.where(mask, data, jnp.zeros_like(data))
 
 
 class BlockSparseMatrix:
@@ -347,10 +355,19 @@ class BlockSparseMatrix:
         return m
 
     def map_bin_data(self, fn) -> None:
-        """Apply a jax fn to every bin's device data in place."""
+        """Apply a jax fn to every bin's device data in place.
+
+        Bucket-padding rows (slot >= count) are re-zeroed afterwards:
+        the engine's Pallas path masks short stack groups with them and
+        relies on the rows-beyond-count-are-zero invariant, which an
+        arbitrary elementwise fn (fn(0) != 0) would otherwise break.
+        """
         for b in self.bins:
             if b.count:
-                b.data = fn(b.data)
+                data = fn(b.data)
+                if data.shape[0] > b.count:
+                    data = _rezero_pad_rows(data, b.count)
+                b.data = data
 
     def zero_data(self) -> None:
         self.map_bin_data(lambda d: jnp.zeros_like(d))

@@ -384,14 +384,20 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha) -> int:
         cbin, abin, bbin = int(cb[s0]), int(ab[s0]), int(bb[s0])
         m, k = a.bins[abin].shape
         _, n = b.bins[bbin].shape
+        a_bin = a.bins[abin]
+        b_bin = b.bins[bbin]
         c.bins[cbin].data = process_stack(
             c.bins[cbin].data,
-            a.bins[abin].data,
-            b.bins[bbin].data,
+            a_bin.data,
+            b_bin.data,
             a_slot[s0:s1],
             b_slot[s0:s1],
             c_slot[s0:s1],
             alpha,
+            # bucket-padded rows beyond count are zeros — the Pallas
+            # path masks short groups with them
+            a_pad_row=a_bin.count if a_bin.count < a_bin.data.shape[0] else None,
+            b_pad_row=b_bin.count if b_bin.count < b_bin.data.shape[0] else None,
         )
         stats.record_stack(m, n, k, s1 - s0)
         flops += 2 * m * n * k * (s1 - s0)

@@ -414,3 +414,142 @@ def add_on_diag(matrix: BlockSparseMatrix, alpha) -> BlockSparseMatrix:
         blk = blk + alpha * np.eye(matrix.row_blk_sizes[r], dtype=matrix.dtype)
         matrix.put_block(r, r, blk)
     return matrix.finalize()
+
+
+# ------------------------------------------------------------ triu / crop
+@jax.jit
+def _zero_strict_lower(data, slots):
+    """Zero the strictly-lower triangle of the selected blocks."""
+    bm, bn = data.shape[1], data.shape[2]
+    ri = jnp.arange(bm)[None, :, None]
+    ci = jnp.arange(bn)[None, None, :]
+    blocks = jnp.take(data, slots, axis=0)
+    blocks = jnp.where(ri > ci, jnp.zeros_like(blocks), blocks)
+    return data.at[slots].set(blocks)
+
+
+def triu(matrix: BlockSparseMatrix) -> BlockSparseMatrix:
+    """In-place block upper triangle (ref `dbcsr_triu`,
+    `dbcsr_operations.F:1849-1885`): drop blocks with block-row >
+    block-col, zero the strictly-lower elements of diagonal blocks."""
+    _require_valid(matrix)
+    if matrix.matrix_type != NO_SYMMETRY:
+        # stored triangle is already row<=col; materialize plain type
+        from dbcsr_tpu.ops.transformations import desymmetrize
+
+        desymmetrized = desymmetrize(matrix, name=matrix.name)
+        matrix.__dict__.update(desymmetrized.__dict__)
+    rows, cols = matrix.entry_coords()
+    compress(matrix, rows <= cols)
+    rows, cols = matrix.entry_coords()
+    diag = np.nonzero(rows == cols)[0]
+    for b_id, b in enumerate(matrix.bins):
+        sel = diag[matrix.ent_bin[diag] == b_id]
+        if len(sel):
+            b.data = _zero_strict_lower(b.data, jnp.asarray(matrix.ent_slot[sel]))
+    return matrix
+
+
+@jax.jit
+def _mask_block_range(data, slots, r_lo, r_hi, c_lo, c_hi):
+    """Keep only elements with block-local row in [r_lo, r_hi] and col in
+    [c_lo, c_hi] (per selected block); zero the rest."""
+    bm, bn = data.shape[1], data.shape[2]
+    ri = jnp.arange(bm)[None, :, None]
+    ci = jnp.arange(bn)[None, None, :]
+    keep = (
+        (ri >= r_lo[:, None, None])
+        & (ri <= r_hi[:, None, None])
+        & (ci >= c_lo[:, None, None])
+        & (ci <= c_hi[:, None, None])
+    )
+    blocks = jnp.take(data, slots, axis=0)
+    return data.at[slots].set(jnp.where(keep, blocks, jnp.zeros_like(blocks)))
+
+
+def crop_matrix(
+    matrix: BlockSparseMatrix,
+    row_bounds=None,
+    col_bounds=None,
+    name: Optional[str] = None,
+) -> BlockSparseMatrix:
+    """Copy restricted to an element range (ref `dbcsr_crop_matrix`,
+    `dbcsr_operations.F:1666-1847`).  Bounds are inclusive 0-based
+    (element, not block) index pairs; blocking is unchanged — blocks
+    straddling a bound keep zeros outside it."""
+    _require_valid(matrix)
+    from dbcsr_tpu.ops.transformations import desymmetrize
+
+    src = desymmetrize(matrix) if matrix.matrix_type != NO_SYMMETRY else matrix
+    out = copy(src, name=name or f"crop({matrix.name})")
+    r0, r1 = row_bounds if row_bounds is not None else (0, out.nfullrows - 1)
+    c0, c1 = col_bounds if col_bounds is not None else (0, out.nfullcols - 1)
+    roff = out.row_blk_offsets
+    coff = out.col_blk_offsets
+    rows, cols = out.entry_coords()
+    keep = (
+        (roff[rows + 1] - 1 >= r0)
+        & (roff[rows] <= r1)
+        & (coff[cols + 1] - 1 >= c0)
+        & (coff[cols] <= c1)
+    )
+    compress(out, keep)
+    rows, cols = out.entry_coords()
+    # blocks straddling a bound get the outside part zeroed
+    r_lo = np.maximum(r0 - roff[rows], 0)
+    r_hi = np.minimum(r1 - roff[rows], out.row_blk_sizes[rows] - 1)
+    c_lo = np.maximum(c0 - coff[cols], 0)
+    c_hi = np.minimum(c1 - coff[cols], out.col_blk_sizes[cols] - 1)
+    partial = (
+        (r_lo > 0)
+        | (r_hi < out.row_blk_sizes[rows] - 1)
+        | (c_lo > 0)
+        | (c_hi < out.col_blk_sizes[cols] - 1)
+    )
+    sel = np.nonzero(partial)[0]
+    for b_id, b in enumerate(out.bins):
+        ss = sel[out.ent_bin[sel] == b_id]
+        if len(ss):
+            b.data = _mask_block_range(
+                b.data,
+                jnp.asarray(out.ent_slot[ss]),
+                jnp.asarray(r_lo[ss]),
+                jnp.asarray(r_hi[ss]),
+                jnp.asarray(c_lo[ss]),
+                jnp.asarray(c_hi[ss]),
+            )
+    return out
+
+
+def verify_matrix(matrix: BlockSparseMatrix, check_data: bool = True) -> bool:
+    """Structural invariant check (ref `dbcsr_verify_matrix`,
+    `dbcsr_dist_util.F:578-732`); raises AssertionError on violation."""
+    _require_valid(matrix)
+    keys = matrix.keys
+    assert np.all(np.diff(keys) > 0), "index keys not strictly sorted"
+    nb = matrix.nblkrows * matrix.nblkcols
+    assert len(keys) == 0 or (keys[0] >= 0 and keys[-1] < nb), "key out of range"
+    rows, cols = matrix.entry_coords()
+    counts = np.bincount(rows, minlength=matrix.nblkrows)
+    assert np.array_equal(np.diff(matrix.row_ptr), counts), "row_ptr inconsistent"
+    assert len(matrix.ent_bin) == len(keys) and len(matrix.ent_slot) == len(keys)
+    for b_id, b in enumerate(matrix.bins):
+        sel = matrix.ent_bin == b_id
+        slots = matrix.ent_slot[sel]
+        assert len(np.unique(slots)) == len(slots), f"bin {b_id} slot collision"
+        assert b.count == int(sel.sum()), f"bin {b_id} count mismatch"
+        assert b.data.shape[0] >= b.count, f"bin {b_id} capacity < count"
+        assert slots.size == 0 or slots.max() < b.count, f"bin {b_id} slot >= count"
+        bm, bn = b.shape
+        assert np.all(matrix.row_blk_sizes[rows[sel]] == bm), f"bin {b_id} row size"
+        assert np.all(matrix.col_blk_sizes[cols[sel]] == bn), f"bin {b_id} col size"
+    if matrix.matrix_type != NO_SYMMETRY:
+        assert np.all(rows <= cols), "symmetric matrix stores lower-triangle block"
+    if check_data:
+        for b in matrix.bins:
+            if b.count:
+                finite = jnp.all(jnp.isfinite(b.data.real)) & jnp.all(
+                    jnp.isfinite(b.data.imag)
+                )
+                assert bool(finite), "non-finite block data"
+    return True

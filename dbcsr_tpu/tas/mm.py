@@ -57,6 +57,16 @@ def tas_multiply(
     k_full = a.nfullcols if transa.upper() == "N" else a.nfullrows
     nblk_k = a.nblkcols if transa.upper() == "N" else a.nblkrows
 
+    # batched-MM state machine (ref dbcsr_tas_mm.F:1595-1692): defer
+    # filtering to the batch finalize, reuse the split decision
+    batch = getattr(c, "_tas_batched_state", None)
+    if batch is not None:
+        if filter_eps is not None:
+            batch["filter_eps"] = filter_eps
+        filter_eps = None
+        if nsplit is None:
+            nsplit = batch.get("nsplit")
+
     with timed("tas_multiply"):
         if nsplit is None:
             for t in (matrix_a, matrix_b, matrix_c):
@@ -67,6 +77,8 @@ def tas_multiply(
             sf = estimate_split_factor(m_full, n_full, k_full, a.nnz, b.nnz, c.nnz)
             long_blks = max(c.nblkrows, c.nblkcols, nblk_k)
             nsplit = choose_nsplit(sf, ngroups_max, long_blks)
+        if batch is not None and batch.get("nsplit") is None:
+            batch["nsplit"] = nsplit  # reuse the split for the whole batch
 
         dims = {"m": m_full, "n": n_full, "k": k_full}
         long_dim = max(dims, key=dims.get)

@@ -9,4 +9,9 @@ layouts, runs the TAS multiply, and maps back
 """
 
 from dbcsr_tpu.tensor.types import BlockSparseTensor, create_tensor
-from dbcsr_tpu.tensor.contract import contract, tensor_copy, remap
+from dbcsr_tpu.tensor.contract import contract, tensor_copy, remap, restrict_tensor
+from dbcsr_tpu.tensor.batched import (
+    batched_contract_init,
+    batched_contract_finalize,
+    batched_contraction,
+)

@@ -51,6 +51,38 @@ def tensor_copy(
     return dest.finalize()
 
 
+def restrict_tensor(
+    t: BlockSparseTensor,
+    dim_bounds,
+    name: Optional[str] = None,
+) -> BlockSparseTensor:
+    """Copy keeping only blocks whose multi-index lies within
+    ``dim_bounds`` — a {dim: (lo, hi)} map of inclusive block-index
+    ranges (the restriction step behind the reference's contract
+    ``bounds_1/2/3`` arguments, `dbcsr_tensor.F:470-490`)."""
+    from dbcsr_tpu.ops.operations import compress, copy as matrix_copy
+
+    dim_bounds = {d: b for d, b in (dim_bounds or {}).items() if b is not None}
+    if not dim_bounds:
+        out = BlockSparseTensor(
+            name or t.name, t.blk_sizes, t.row_dims, t.col_dims, t.dtype
+        )
+        out.matrix = matrix_copy(t.matrix, name=out.name)
+        return out
+    nd_idx = t.entry_multi_coords()
+    mask = np.ones(len(nd_idx), bool)
+    for d, (lo, hi) in dim_bounds.items():
+        mask &= (nd_idx[:, d] >= lo) & (nd_idx[:, d] <= hi)
+    out = BlockSparseTensor(
+        name or t.name, t.blk_sizes, t.row_dims, t.col_dims, t.dtype
+    )
+    if mask.all():
+        out.matrix = matrix_copy(t.matrix, name=out.name)
+    else:
+        out.matrix = compress(matrix_copy(t.matrix, name=out.name), mask)
+    return out
+
+
 def contract(
     alpha,
     tensor_a: BlockSparseTensor,
@@ -65,10 +97,19 @@ def contract(
     map_2: Optional[Sequence[int]] = None,
     filter_eps: Optional[float] = None,
     nsplit: Optional[int] = None,
+    bounds_1=None,
+    bounds_2=None,
+    bounds_3=None,
 ) -> int:
     """C[map_1, map_2] = alpha * sum over contracted dims of A*B + beta*C.
 
     Returns flops.  (ref `dbcsr_t_contract`, `dbcsr_tensor.F:418`)
+
+    ``bounds_1[i]`` optionally restricts contracted dim pair
+    (contract_a[i], contract_b[i]) to an inclusive block-index range;
+    ``bounds_2[i]`` restricts notcontract_a[i], ``bounds_3[i]``
+    notcontract_b[i] (ref bounds args, `dbcsr_tensor.F:470-490`; the
+    batched-contraction driver chunks index space with these).
     """
     ca, nca = tuple(contract_a), tuple(notcontract_a)
     cb, ncb = tuple(contract_b), tuple(notcontract_b)
@@ -96,10 +137,33 @@ def contract(
         if not np.array_equal(tensor_b.blk_sizes[db], tensor_c.blk_sizes[dc]):
             raise ValueError(f"B dim {db} blocking != C dim {dc}")
 
+    def _bounds_map(dims, bounds):
+        if bounds is None:
+            return {}
+        bounds = list(bounds)
+        if len(bounds) != len(dims):
+            raise ValueError("bounds length must match the dim-section length")
+        return {d: b for d, b in zip(dims, bounds) if b is not None}
+
+    a_bounds = {**_bounds_map(ca, bounds_1), **_bounds_map(nca, bounds_2)}
+    b_bounds = {**_bounds_map(cb, bounds_1), **_bounds_map(ncb, bounds_3)}
+
+    # batched-contraction state on C defers filtering to the finalize;
+    # the split decision is cached by the TAS batched-MM state that
+    # batched_contract_init installed on C's matrix
+    # (ref dbcsr_t_batched_contract_init/finalize, dbcsr_tensor.F:1964-2186)
+    batch = getattr(tensor_c, "_batched_state", None)
+    if batch is not None:
+        if filter_eps is not None:
+            batch["filter_eps"] = filter_eps
+        filter_eps = None
+
     with timed("tensor_contract"):
+        restricted_a = restrict_tensor(tensor_a, a_bounds)
+        restricted_b = restrict_tensor(tensor_b, b_bounds)
         # remap operands into matrix-compatible layouts (ref :1183)
-        a2 = remap(tensor_a, nca, ca, name=tensor_a.name + "_mm")
-        b2 = remap(tensor_b, cb, ncb, name=tensor_b.name + "_mm")
+        a2 = remap(restricted_a, nca, ca, name=tensor_a.name + "_mm")
+        b2 = remap(restricted_b, cb, ncb, name=tensor_b.name + "_mm")
         c_layout = (map_1, map_2)
         if (tensor_c.row_dims, tensor_c.col_dims) == c_layout:
             flops = tas_multiply(

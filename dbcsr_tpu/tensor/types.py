@@ -149,6 +149,23 @@ class BlockSparseTensor:
         rows, cols = self.matrix.entry_coords()
         return [self.block_coords(int(r), int(c)) for r, c in zip(rows, cols)]
 
+    def entry_multi_coords(self) -> np.ndarray:
+        """(nblks, ndim) int64 array of tensor block multi-indices, in
+        matrix key order (vectorized `block_coords`)."""
+        rows, cols = self.matrix.entry_coords()
+        nd = np.empty((len(rows), self.ndim), np.int64)
+        f = rows.copy()
+        for d in reversed(self.row_dims):
+            n = len(self.blk_sizes[d])
+            nd[:, d] = f % n
+            f //= n
+        f = cols.copy()
+        for d in reversed(self.col_dims):
+            n = len(self.blk_sizes[d])
+            nd[:, d] = f % n
+            f //= n
+        return nd
+
     def __repr__(self) -> str:
         return (
             f"BlockSparseTensor({self.name!r}, rank {self.ndim}, "

@@ -1,0 +1,200 @@
+"""Tests for triu / crop_matrix / verify_matrix / dist_bin and the
+Pallas stack kernel (interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+from dbcsr_tpu import (
+    crop_matrix,
+    dist_bin,
+    make_random_matrix,
+    to_dense,
+    triu,
+    verify_matrix,
+)
+from dbcsr_tpu.ops.test_methods import from_dense
+
+
+def _random(name="M", nbr=7, nbc=7, sizes=(3, 5, 2), occ=0.6, seed=3, **kw):
+    rng = np.random.default_rng(seed)
+    rbs = rng.choice(sizes, nbr)
+    cbs = rng.choice(sizes, nbc)
+    return make_random_matrix(name, rbs, cbs, occupation=occ, rng=rng, **kw)
+
+
+def test_triu_matches_block_triu():
+    m = _random()
+    dense = to_dense(m)
+    roff = m.row_blk_offsets
+    coff = m.col_blk_offsets
+    triu(m)
+    verify_matrix(m)
+    got = to_dense(m)
+    # expected: zero below the *block* diagonal; within diagonal blocks,
+    # zero the strictly-lower local triangle (ref dbcsr_triu semantics)
+    want = dense.copy()
+    for r in range(m.nblkrows):
+        for c in range(m.nblkcols):
+            sub = want[roff[r] : roff[r + 1], coff[c] : coff[c + 1]]
+            if r > c:
+                sub[:] = 0
+            elif r == c:
+                sub[:] = np.triu(sub)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_crop_matrix_element_bounds():
+    m = _random(occ=0.8)
+    dense = to_dense(m)
+    r0, r1 = 4, m.nfullrows - 3
+    c0, c1 = 2, m.nfullcols - 5
+    out = crop_matrix(m, (r0, r1), (c0, c1))
+    verify_matrix(out)
+    got = to_dense(out)
+    want = np.zeros_like(dense)
+    want[r0 : r1 + 1, c0 : c1 + 1] = dense[r0 : r1 + 1, c0 : c1 + 1]
+    np.testing.assert_array_equal(got, want)
+    # original untouched
+    np.testing.assert_array_equal(to_dense(m), dense)
+
+
+def test_crop_matrix_no_bounds_is_copy():
+    m = _random()
+    out = crop_matrix(m)
+    np.testing.assert_array_equal(to_dense(out), to_dense(m))
+
+
+def test_verify_matrix_catches_corruption():
+    m = _random()
+    verify_matrix(m)
+    m.keys = m.keys[::-1].copy()  # break sorted invariant
+    with pytest.raises(AssertionError):
+        verify_matrix(m)
+
+
+def test_dist_bin_balanced():
+    rng = np.random.default_rng(0)
+    sizes = rng.integers(1, 50, 200)
+    bins = dist_bin(200, 7, element_sizes=sizes)
+    assert bins.shape == (200,)
+    assert bins.min() >= 0 and bins.max() < 7
+    loads = np.bincount(bins, weights=sizes, minlength=7)
+    # greedy least-loaded keeps spread within max element size
+    assert loads.max() - loads.min() <= sizes.max()
+
+
+def test_dist_bin_random_mode():
+    bins = dist_bin(100, 5, rng=np.random.default_rng(1))
+    assert bins.shape == (100,) and bins.min() >= 0 and bins.max() < 5
+
+
+# ------------------------------------------------------------ pallas kernel
+def test_pallas_stack_matches_oracle():
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.acc.pallas_smm import process_stack_pallas
+
+    rng = np.random.default_rng(0)
+    m, n, k = 9, 7, 5
+    na, nb, nc = 30, 40, 10
+    s_len = 150
+    a = jnp.asarray(rng.standard_normal((na, m, k)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((nb, k, n)), jnp.float32)
+    c0 = jnp.asarray(rng.standard_normal((nc, m, n)), jnp.float32)
+    ai = rng.integers(0, na, s_len).astype(np.int32)
+    bi = rng.integers(0, nb, s_len).astype(np.int32)
+    ci = np.sort(rng.integers(0, nc - 2, s_len)).astype(np.int32)
+    alpha = -0.75
+    want = np.array(c0, np.float64)
+    for s in range(s_len):
+        want[ci[s]] += alpha * (np.array(a[ai[s]], np.float64) @ np.array(b[bi[s]], np.float64))
+    got = np.asarray(
+        process_stack_pallas(c0, a, b, ai, bi, ci, alpha), np.float64
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("grouping", [1, 2, 4, 8])
+def test_pallas_grouping_variants(grouping):
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.acc import pallas_smm
+
+    rng = np.random.default_rng(grouping)
+    m = n = k = 6
+    na, nb, nc = 12, 12, 6
+    s_len = 40
+    a = jnp.asarray(rng.standard_normal((na, m, k)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((nb, k, n)), jnp.float32)
+    c0 = jnp.zeros((nc, m, n), jnp.float32)
+    ai = rng.integers(0, na - 1, s_len).astype(np.int32)
+    bi = rng.integers(0, nb - 1, s_len).astype(np.int32)
+    ci = np.sort(rng.integers(0, nc, s_len)).astype(np.int32)
+    want = np.zeros((nc, m, n))
+    for s in range(s_len):
+        want[ci[s]] += np.array(a[ai[s]], np.float64) @ np.array(b[bi[s]], np.float64)
+    ai2, bi2, ci2, r = pallas_smm.build_grouped_stack(ci, ai, bi, na - 1, nb - 1, grouping)
+    assert r == grouping
+    # pad rows must be zero rows for the masking to be exact
+    a = a.at[na - 1].set(0)
+    b = b.at[nb - 1].set(0)
+    got = np.asarray(
+        pallas_smm.process_stack_pallas(
+            c0, a, b, ai, bi, ci, 1.0, a_pad_row=na - 1, b_pad_row=nb - 1
+        ),
+        np.float64,
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_engine_end_to_end_f32():
+    """Full multiply through the engine with the pallas driver forced."""
+    from dbcsr_tpu import multiply, set_config
+    from dbcsr_tpu.core.config import get_config
+
+    old = get_config().mm_driver
+    set_config(mm_driver="pallas")
+    try:
+        rng = np.random.default_rng(7)
+        a = make_random_matrix("A", [4, 4, 4], [4, 4, 4], occupation=0.8,
+                               dtype=np.float32, rng=rng)
+        b = make_random_matrix("B", [4, 4, 4], [4, 4, 4], occupation=0.8,
+                               dtype=np.float32, rng=rng)
+        c = make_random_matrix("C", [4, 4, 4], [4, 4, 4], occupation=0.5,
+                               dtype=np.float32, rng=rng)
+        want = 2.0 * to_dense(a) @ to_dense(b) + 0.5 * to_dense(c)
+        multiply("N", "N", 2.0, a, b, 0.5, c)
+        np.testing.assert_allclose(to_dense(c), want, rtol=1e-4, atol=1e-4)
+    finally:
+        set_config(mm_driver=old)
+
+
+def test_function_of_elements_keeps_pad_rows_zero():
+    """Regression: fn(0) != 0 must not leak into bucket-padding rows —
+    the Pallas path masks short stack groups with them."""
+    import jax.numpy as jnp
+
+    from dbcsr_tpu import function_of_elements, multiply, set_config, to_dense
+    from dbcsr_tpu.core.config import get_config
+
+    rng = np.random.default_rng(5)
+    a = make_random_matrix("A", [4, 4, 4], [4, 4, 4], occupation=0.7,
+                           dtype=np.float32, rng=rng)
+    b = make_random_matrix("B", [4, 4, 4], [4, 4, 4], occupation=0.7,
+                           dtype=np.float32, rng=rng)
+    function_of_elements(a, lambda d: d + 1.0)
+    function_of_elements(b, lambda d: d + 1.0)
+    for m in (a, b):
+        for bn in m.bins:
+            if bn.data.shape[0] > bn.count:
+                assert not np.any(np.asarray(bn.data[bn.count:])), "pad rows dirty"
+    c = make_random_matrix("C", [4, 4, 4], [4, 4, 4], occupation=0.0,
+                           dtype=np.float32, rng=rng)
+    want = to_dense(a) @ to_dense(b)
+    old = get_config().mm_driver
+    set_config(mm_driver="pallas")
+    try:
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+    finally:
+        set_config(mm_driver=old)
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-4, atol=1e-4)

@@ -131,3 +131,81 @@ def test_contract_validates_blockings():
     c.finalize()
     with pytest.raises(ValueError):
         contract(1.0, a, b, 0.0, c, (1,), (0,), (0,), (1,))
+
+
+def test_contract_with_bounds():
+    """bounds restrict the contraction to block-index ranges; the result
+    must equal the einsum of the cropped operands."""
+    si, sj, sk = [2, 3, 2], [3, 2, 4], [4, 2, 3]
+    koff = np.concatenate([[0], np.cumsum(sk)])
+    a2 = _rand_tensor("a2", [si, sk], occ=0.9, seed=13)
+    b2 = _rand_tensor("b2", [sk, sj], occ=0.9, seed=14)
+    c2 = create_tensor("c2", [si, sj])
+    from dbcsr_tpu.tensor import contract as t_contract
+
+    t_contract(
+        1.0, a2, b2, 0.0, c2,
+        contract_a=(1,), notcontract_a=(0,),
+        contract_b=(0,), notcontract_b=(1,),
+        bounds_1=[(1, 2)],
+    )
+    a2d = a2.to_dense().copy()
+    b2d = b2.to_dense().copy()
+    a2d[:, : koff[1]] = 0
+    b2d[: koff[1], :] = 0
+    want2 = a2d @ b2d
+    np.testing.assert_allclose(c2.to_dense(), want2, rtol=1e-10, atol=1e-12)
+
+
+def test_batched_contract_accumulates_chunks():
+    """Chunking the contracted dim over bounds inside a batched context
+    must reproduce the full contraction, with filtering deferred."""
+    from dbcsr_tpu.tensor import batched_contraction, contract as t_contract
+
+    si, sk, sj = [2, 3], [3, 2, 4, 2], [2, 3]
+    a = _rand_tensor("a", [si, sk], occ=1.0, seed=21)
+    b = _rand_tensor("b", [sk, sj], occ=1.0, seed=22)
+    c = create_tensor("c", [si, sj])
+    c.finalize()
+    nk = len(sk)
+    with batched_contraction(c):
+        for k0 in range(nk):
+            t_contract(
+                1.0, a, b, 1.0, c,
+                contract_a=(1,), notcontract_a=(0,),
+                contract_b=(0,), notcontract_b=(1,),
+                bounds_1=[(k0, k0)],
+                filter_eps=1e-12,
+            )
+    want = a.to_dense() @ b.to_dense()
+    np.testing.assert_allclose(c.to_dense(), want, rtol=1e-10, atol=1e-12)
+
+
+def test_restrict_tensor_drops_out_of_range_blocks():
+    from dbcsr_tpu.tensor import restrict_tensor
+
+    sizes = [[2, 3, 2], [3, 2], [2, 2, 3]]
+    t = _rand_tensor("t", sizes, occ=1.0, seed=31)
+    r = restrict_tensor(t, {0: (1, 2), 2: (0, 1)})
+    nd = r.entry_multi_coords()
+    assert len(nd) and (nd[:, 0] >= 1).all() and (nd[:, 2] <= 1).all()
+    for idx, blk in r.iterate_blocks():
+        np.testing.assert_array_equal(t.get_block(idx), blk)
+
+
+def test_tas_batched_mm_state_machine():
+    from dbcsr_tpu.ops.test_methods import make_random_matrix, to_dense
+    from dbcsr_tpu.tas import batched_mm, tas_multiply
+
+    rng = np.random.default_rng(41)
+    rbs = [3] * 20
+    cbs = [4, 4]
+    a = make_random_matrix("A", rbs, cbs, occupation=0.7, rng=rng)  # tall
+    b = make_random_matrix("B", cbs, cbs, occupation=1.0, rng=rng)
+    c = make_random_matrix("C", rbs, cbs, occupation=0.0, rng=rng)
+    want = np.zeros((sum(rbs), sum(cbs)))
+    with batched_mm(c):
+        for rep in range(3):
+            tas_multiply("N", "N", 1.0, a, b, 1.0, c, filter_eps=1e-12)
+            want += to_dense(a) @ to_dense(b)
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-10, atol=1e-12)
