@@ -193,11 +193,15 @@ def process_stack_pallas(
     alpha,
     a_pad_row: int | None = None,
     b_pad_row: int | None = None,
+    grouping: int | None = None,
 ):
     """Process a flat stack (host int arrays, sorted by ``c_idx``).
 
     ``a_pad_row``/``b_pad_row`` must index a zero row of the data
-    arrays; when None, a zero row is appended on the fly.
+    arrays; when None, a zero row is appended on the fly.  ``grouping``
+    forces R (otherwise chosen from the run-length heuristic; the
+    caller passes the tuned value from `dbcsr_tpu.acc.params` when one
+    exists).
     """
     if len(a_idx) == 0:
         return c_data
@@ -208,7 +212,8 @@ def process_stack_pallas(
         b_data = jnp.concatenate([b_data, jnp.zeros((1,) + b_data.shape[1:], b_data.dtype)])
         b_pad_row = b_data.shape[0] - 1
     ai2, bi2, ci2, r_grp = build_grouped_stack(
-        np.asarray(c_idx), np.asarray(a_idx), np.asarray(b_idx), a_pad_row, b_pad_row
+        np.asarray(c_idx), np.asarray(a_idx), np.asarray(b_idx),
+        a_pad_row, b_pad_row, grouping=grouping,
     )
     from dbcsr_tpu.utils.rounding import bucket_size
 

@@ -1,0 +1,87 @@
+"""Autotuned kernel-parameter table.
+
+Analog of `src/acc/libsmm_acc/parameters/parameters_<GPU>.json` (+
+`parameters_utils.h` lookup): per-(m, n, k, dtype) tuned launch
+parameters for the stack kernel, keyed by device kind.  Entries are
+produced by `dbcsr_tpu.acc.tune` and consulted at dispatch time — the
+role the reference's per-GPU JSON plays for `libsmm_acc_process`
+(`libsmm_acc.cpp:227-249` parameter lookup on kernel-cache miss).
+
+Schema per entry: {"m", "n", "k", "dtype", "driver": "pallas"|"xla",
+"grouping", "gflops"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from typing import Dict, Optional
+
+_lock = threading.Lock()
+_cache: Dict[str, Dict] = {}
+
+
+def _params_dir() -> str:
+    """Writable parameter directory: $DBCSR_TPU_PARAMS_DIR overrides the
+    in-package default (which may be read-only in an installed tree)."""
+    return os.environ.get(
+        "DBCSR_TPU_PARAMS_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "params"),
+    )
+
+
+def device_kind() -> str:
+    import jax
+
+    return re.sub(r"\W+", "_", jax.devices()[0].device_kind).strip("_")
+
+
+def params_path(kind: Optional[str] = None) -> str:
+    return os.path.join(_params_dir(), f"parameters_{kind or device_kind()}.json")
+
+
+def _key(m: int, n: int, k: int, dtype) -> str:
+    import numpy as np
+
+    return f"{m}x{n}x{k}:{np.dtype(dtype).name}"
+
+
+def _load(kind: Optional[str] = None) -> Dict:
+    kind = kind or device_kind()
+    with _lock:
+        if kind not in _cache:
+            path = params_path(kind)
+            table = {}
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        for e in json.load(f):
+                            table[_key(e["m"], e["n"], e["k"], e["dtype"])] = e
+                except (OSError, ValueError, KeyError):
+                    table = {}
+            _cache[kind] = table
+        return _cache[kind]
+
+
+def lookup(m: int, n: int, k: int, dtype) -> Optional[Dict]:
+    """Tuned entry for this (m, n, k, dtype) on the current device."""
+    try:
+        return _load().get(_key(m, n, k, dtype))
+    except Exception:
+        return None
+
+
+def save_entry(entry: Dict, kind: Optional[str] = None) -> str:
+    """Merge one tuned entry into the device's parameter file."""
+    kind = kind or device_kind()
+    table = _load(kind)
+    with _lock:
+        table[_key(entry["m"], entry["n"], entry["k"], entry["dtype"])] = entry
+        os.makedirs(_params_dir(), exist_ok=True)
+        path = params_path(kind)
+        with open(path, "w") as f:
+            json.dump(sorted(table.values(), key=lambda e: (e["m"], e["n"], e["k"])),
+                      f, indent=1)
+    return path

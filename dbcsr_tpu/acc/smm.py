@@ -123,13 +123,29 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
     S = len(a_idx)
     if S == 0:
         return c_data
-    if _pallas_enabled(cfg, c_data, a_data, b_data):
-        from dbcsr_tpu.acc.pallas_smm import process_stack_pallas
+    if _pallas_supported(cfg, c_data, a_data, b_data):
+        # tuned preference (dbcsr_tpu.acc.params; analog of the per-GPU
+        # parameter table consulted by libsmm_acc.cpp:227-249) —
+        # resolved once here for both the driver choice and grouping
+        from dbcsr_tpu.acc import params as params_mod
 
-        return process_stack_pallas(
-            c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha,
-            a_pad_row=a_pad_row, b_pad_row=b_pad_row,
+        tuned = params_mod.lookup(
+            a_data.shape[1], b_data.shape[2], a_data.shape[2], c_data.dtype
         )
+        prefer_xla = (
+            cfg.mm_driver == "auto" and tuned is not None
+            and tuned.get("driver") == "xla"
+        )
+        if not prefer_xla:
+            from dbcsr_tpu.acc.pallas_smm import process_stack_pallas
+
+            grouping = None
+            if tuned and tuned.get("driver") == "pallas" and tuned.get("grouping"):
+                grouping = int(tuned["grouping"])
+            return process_stack_pallas(
+                c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha,
+                a_pad_row=a_pad_row, b_pad_row=b_pad_row, grouping=grouping,
+            )
     nseg = c_data.shape[0]
     alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)
     chunk = max(cfg.mm_stack_size, 1)
@@ -147,7 +163,7 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
     return _process_stack_xla(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
 
 
-def _pallas_enabled(cfg, c_data, a_data, b_data) -> bool:
+def _pallas_supported(cfg, c_data, a_data, b_data) -> bool:
     if cfg.mm_driver == "xla":
         return False
     if not cfg.use_pallas and cfg.mm_driver != "pallas":

@@ -1,0 +1,142 @@
+"""Kernel autotuner.
+
+Analog of `src/acc/libsmm_acc/tune/` (tune_setup/submit/collect/merge)
+collapsed into one loop: for a given (m, n, k, dtype), time every
+candidate launch config of the stack kernel — the Pallas kernel at each
+grouping R plus the XLA gather/segment-sum path — and write the winner
+into the device parameter table (`dbcsr_tpu.acc.params`), which
+dispatch consults.  The reference's tuning space (algorithm family,
+tile_m/n, w, v, threads, grouping, minblocks per `kernels/smm_acc.py`)
+collapses to {driver, grouping} because XLA/Mosaic own the tiling.
+
+CLI:  python -m dbcsr_tpu.acc.tune M N K [dtype_enum] [stack_size] [nrep]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from dbcsr_tpu.acc import params as params_mod
+from dbcsr_tpu.core.kinds import dtype_of
+
+
+def _time_config(fn, nrep: int) -> float:
+    import jax
+
+    jax.block_until_ready(fn())  # compile/warm
+    best = float("inf")
+    for _ in range(nrep):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def tune_smm(m: int, n: int, k: int, dtype_enum: int = 1,
+             stack_size: int = 30000, nrep: int = 3, out=print, seed=7):
+    """Tune one (m, n, k, dtype); returns and persists the best entry."""
+    import jax
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.acc import pallas_smm
+    from dbcsr_tpu.acc.smm import _process_stack_xla
+    from dbcsr_tpu.utils.rounding import bucket_size
+
+    dtype = dtype_of(dtype_enum)
+    rng = np.random.default_rng(seed)
+    na = nb = max(stack_size // 16, 2)
+    nc = max(stack_size // 8, 1)
+    a = jnp.asarray(rng.standard_normal((na, m, k)).astype(dtype))
+    b = jnp.asarray(rng.standard_normal((nb, k, n)).astype(dtype))
+    ai = rng.integers(0, na - 1, stack_size).astype(np.int32)
+    bi = rng.integers(0, nb - 1, stack_size).astype(np.int32)
+    ci = np.sort(rng.integers(0, nc, stack_size)).astype(np.int32)
+    flops = 2.0 * m * n * k * stack_size
+    candidates = []
+
+    # XLA gather/segment-sum path (always available)
+    chunk = bucket_size(min(stack_size, 30000))
+    nchunks = -(-stack_size // chunk)
+    from dbcsr_tpu.acc.smm import pad_stack
+
+    pai, pbi, pci = pad_stack(ai, bi, ci, nchunks * chunk, nc)
+    xla_args = (
+        jnp.asarray(pai.reshape(nchunks, chunk)),
+        jnp.asarray(pbi.reshape(nchunks, chunk)),
+        jnp.asarray(pci.reshape(nchunks, chunk)),
+    )
+
+    def run_xla():
+        return _process_stack_xla(
+            jnp.zeros((nc, m, n), dtype), a, b, *xla_args,
+            jnp.asarray(1.0, dtype),
+        )
+
+    t = _time_config(run_xla, nrep)
+    candidates.append({"driver": "xla", "grouping": None, "gflops": flops / t / 1e9})
+    out(f"  xla: {flops / t / 1e9:.1f} GFLOP/s")
+
+    if pallas_smm.supports(jnp.zeros((1, m, n), dtype), a, b):
+        zero_a, zero_b = na - 1, nb - 1
+        a = a.at[zero_a].set(0)
+        b = b.at[zero_b].set(0)
+        for r in (1, 2, 4, 8):
+            ai2, bi2, ci2, _ = pallas_smm.build_grouped_stack(
+                ci, ai, bi, zero_a, zero_b, grouping=r
+            )
+            cap = bucket_size(ai2.shape[0])
+            if cap > ai2.shape[0]:
+                pad = cap - ai2.shape[0]
+                ai2 = np.concatenate([ai2, np.full((pad, r), zero_a, np.int32)])
+                bi2 = np.concatenate([bi2, np.full((pad, r), zero_b, np.int32)])
+                ci2 = np.concatenate([ci2, np.full(pad, ci2[-1], np.int32)])
+            dai2, dbi2, dci2 = map(jnp.asarray, (ai2, bi2, ci2))
+            alpha = jnp.asarray([[1.0]], jnp.float32)
+            interpret = jax.devices()[0].platform != "tpu"
+
+            def run_pallas(r=r, dai2=dai2, dbi2=dbi2, dci2=dci2):
+                return pallas_smm._pallas_process(
+                    jnp.zeros((nc, m, n), dtype), a, b, dai2, dbi2, dci2,
+                    alpha, r_grp=r, interpret=interpret,
+                )
+
+            try:
+                t = _time_config(run_pallas, nrep)
+            except Exception as exc:  # config failed to compile/run
+                out(f"  pallas R={r}: failed ({type(exc).__name__})")
+                continue
+            candidates.append(
+                {"driver": "pallas", "grouping": r, "gflops": flops / t / 1e9}
+            )
+            out(f"  pallas R={r}: {flops / t / 1e9:.1f} GFLOP/s")
+
+    best = max(candidates, key=lambda c: c["gflops"])
+    entry = {
+        "m": m, "n": n, "k": k, "dtype": np.dtype(dtype).name,
+        "stack_size": stack_size, **best,
+        "gflops": round(best["gflops"], 2),
+    }
+    path = params_mod.save_entry(entry)
+    out(f"best: {entry['driver']} grouping={entry['grouping']} "
+        f"{entry['gflops']} GFLOP/s -> {path}")
+    return entry
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) < 3:
+        print(__doc__)
+        return 1
+    m, n, k = (int(x) for x in argv[:3])
+    dtype_enum = int(argv[3]) if len(argv) > 3 and int(argv[3]) else 1
+    stack_size = int(argv[4]) if len(argv) > 4 and int(argv[4]) else 30000
+    nrep = int(argv[5]) if len(argv) > 5 and int(argv[5]) else 3
+    tune_smm(m, n, k, dtype_enum, stack_size, nrep)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,3 +23,4 @@ from dbcsr_tpu.parallel.dist_matrix import (
     multiply_distributed,
     replicate,
 )
+from dbcsr_tpu.parallel.sparse_dist import sparse_multiply_distributed

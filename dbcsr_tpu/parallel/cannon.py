@@ -26,6 +26,15 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def mark_varying(x, axes):
+    """Mark an array device-varying over mesh axes (pcast with a
+    fallback for jax versions that only have the deprecated pvary)."""
+    try:
+        return jax.lax.pcast(x, axes, to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, axes)
+
+
 def _skew_perm(s: int, kind: str):
     """Static (src, dst) pairs over the flattened ('pr','pc') axis."""
     pairs = []
@@ -55,7 +64,7 @@ def _local_cannon(a_loc, b_loc, s: int, acc_dtype):
     c_loc = jnp.zeros((a_loc.shape[0], b_loc.shape[1]), acc_dtype)
     # mark the accumulator as device-varying so the fori_loop carry type
     # matches after the varying a@b lands in it
-    c_loc = jax.lax.pvary(c_loc, ("kl", "pr", "pc"))
+    c_loc = mark_varying(c_loc, ("kl", "pr", "pc"))
 
     def tick(t, carry):
         a, b, c = carry

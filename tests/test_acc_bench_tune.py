@@ -1,0 +1,45 @@
+"""acc-layer bench drivers + autotuner/params table tests
+(ref `acc_bench_smm.c` validation pattern and `libsmm_acc` tune/merge)."""
+
+import numpy as np
+
+from dbcsr_tpu.acc import params as params_mod
+from dbcsr_tpu.acc.bench import bench_smm, bench_trans
+
+
+def test_bench_smm_validates(capsys):
+    res = bench_smm(nrep=1, stack_size=300, m=5, n=4, k=6, dtype_enum=3, out=lambda *a: None)
+    assert res["errors"] == 0
+    assert res["gflops"] > 0
+
+
+def test_bench_trans_validates():
+    res = bench_trans(nrep=1, stack_size=300, m=5, n=7, out=lambda *a: None)
+    assert res["errors"] == 0
+
+
+def test_params_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("DBCSR_TPU_PARAMS_DIR", str(tmp_path))
+    params_mod._cache.clear()
+    assert params_mod.lookup(3, 3, 3, np.float32) is None
+    entry = {"m": 3, "n": 3, "k": 3, "dtype": "float32",
+             "driver": "pallas", "grouping": 4, "gflops": 1.0}
+    params_mod.save_entry(entry)
+    params_mod._cache.clear()
+    got = params_mod.lookup(3, 3, 3, np.float32)
+    assert got is not None and got["grouping"] == 4
+    params_mod._cache.clear()
+
+
+def test_tune_smm_writes_entry(tmp_path, monkeypatch):
+    from dbcsr_tpu.acc.tune import tune_smm
+
+    monkeypatch.setenv("DBCSR_TPU_PARAMS_DIR", str(tmp_path))
+    params_mod._cache.clear()
+    entry = tune_smm(4, 4, 4, dtype_enum=1, stack_size=200, nrep=1,
+                     out=lambda *a: None)
+    assert entry["driver"] in ("pallas", "xla")
+    params_mod._cache.clear()
+    got = params_mod.lookup(4, 4, 4, np.float32)
+    assert got is not None and got["gflops"] > 0
+    params_mod._cache.clear()
