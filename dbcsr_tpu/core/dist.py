@@ -66,6 +66,11 @@ class Distribution:
     def local_cols(self, pcol: int) -> np.ndarray:
         return np.nonzero(self.col_dist == pcol)[0]
 
+    def stored_coordinates(self, row: int, col: int):
+        """Owning (prow, pcol) of a block (ref
+        `dbcsr_get_stored_coordinates`, `dbcsr_dist_operations.F`)."""
+        return int(self.row_dist[row]), int(self.col_dist[col])
+
     def transposed(self) -> "Distribution":
         """Ref `dbcsr_transpose_distribution` (`dbcsr_dist_operations.F:55`)."""
         grid = ProcessGrid(self.grid.npcols, self.grid.nprows, self.grid.mesh)

@@ -368,19 +368,28 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha) -> int:
     a_slot = a.ent_slot[a_ent]
     b_slot = b.ent_slot[b_ent]
     g = (cb.astype(np.int64) * len(a.bins) + ab) * len(b.bins) + bb
-    order = np.lexsort((a_ent, c_slot, g))
-    g = g[order]
+    ngroups = len(c.bins) * len(a.bins) * len(b.bins)
+    from dbcsr_tpu import native
+
+    native_sorted = native.group_sort_stacks(g, ngroups, c_slot, a_ent)
+    if native_sorted is not None:
+        order, gbounds = native_sorted
+        nonempty = np.nonzero(np.diff(gbounds))[0]
+        spans = [(int(gbounds[gi]), int(gbounds[gi + 1])) for gi in nonempty]
+    else:
+        order = np.lexsort((a_ent, c_slot, g))
+        g_sorted = g[order]
+        uniq, first = np.unique(g_sorted, return_index=True)
+        b_arr = np.append(first, len(g_sorted))
+        spans = [(int(b_arr[i]), int(b_arr[i + 1])) for i in range(len(uniq))]
     c_slot = c_slot[order]
     a_slot = a_slot[order]
     b_slot = b_slot[order]
     cb = cb[order]
     ab = ab[order]
     bb = bb[order]
-    uniq, first = np.unique(g, return_index=True)
-    bounds = np.append(first, len(g))
     flops = 0
-    for gi in range(len(uniq)):
-        s0, s1 = int(bounds[gi]), int(bounds[gi + 1])
+    for s0, s1 in spans:
         cbin, abin, bbin = int(cb[s0]), int(ab[s0]), int(bb[s0])
         m, k = a.bins[abin].shape
         _, n = b.bins[bbin].shape

@@ -89,6 +89,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64, i64p, i64p, i64p,
                 ctypes.c_void_p, ctypes.c_int64, i64p, i64p, ctypes.c_void_p,
             ]
+            lib.dbcsr_group_sort_stacks.restype = None
+            lib.dbcsr_group_sort_stacks.argtypes = [
+                ctypes.c_int64, i64p, ctypes.c_int64, i32p, i64p, i64p, i64p,
+            ]
         except AttributeError:
             # stale library missing an expected symbol -> NumPy fallback
             return None
@@ -144,6 +148,28 @@ def symbolic_product(
     )
     assert wrote == n, (wrote, n)
     return out_i, out_j, out_a, out_b
+
+
+def group_sort_stacks(group, ngroups, c_slot, a_ent):
+    """Native stack ordering: permutation sorted by (group, c_slot,
+    a_ent) plus group boundaries; None -> caller falls back to lexsort."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    group = _i64(group)
+    c_slot = np.ascontiguousarray(c_slot, np.int32)
+    a_ent = _i64(a_ent)
+    n = len(group)
+    if n and not (0 <= group.min() and group.max() < ngroups):
+        raise ValueError("group ids out of [0, ngroups) — would corrupt memory")
+    order = np.empty(n, np.int64)
+    bounds = np.empty(ngroups + 1, np.int64)
+    lib.dbcsr_group_sort_stacks(
+        n, _ptr(group, ctypes.c_int64), int(ngroups),
+        _ptr(c_slot, ctypes.c_int32), _ptr(a_ent, ctypes.c_int64),
+        _ptr(order, ctypes.c_int64), _ptr(bounds, ctypes.c_int64),
+    )
+    return order, bounds
 
 
 def coo_fill_blocks(blk_of_entry, local_row, local_col, values,

@@ -9,6 +9,7 @@
 //
 // Build: g++ -O3 -fopenmp -fPIC -shared index_engine.cpp -o libdbcsr_index.so
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 
@@ -129,6 +130,38 @@ void dbcsr_coo_fill_blocks(
   }
 }
 
-int32_t dbcsr_native_version() { return 1; }
+// Group-sort the multiply stack: order entries by (group id, C slot,
+// A entry) so the engine can carve one kernel stack per (m,n,k)
+// shape-bin group with deterministic, C-contiguous accumulation order
+// (the role of stack_sort/binning in dbcsr_mm_accdrv.F:364-423 and the
+// size-binned stack maps of dbcsr_mm_csr.F:361-539).  Counting sort by
+// group (stable), then per-group comparison sort, parallel over groups.
+void dbcsr_group_sort_stacks(
+    int64_t n,
+    const int64_t* group,   // group id per entry, in [0, ngroups)
+    int64_t ngroups,
+    const int32_t* c_slot,
+    const int64_t* a_ent,   // deterministic tie-break
+    int64_t* order,         // out: permutation (n)
+    int64_t* bounds) {      // out: ngroups+1 group boundaries
+  int64_t* counts = new int64_t[ngroups + 1]();
+  for (int64_t e = 0; e < n; ++e) ++counts[group[e] + 1];
+  for (int64_t g = 0; g < ngroups; ++g) counts[g + 1] += counts[g];
+  std::memcpy(bounds, counts, (ngroups + 1) * sizeof(int64_t));
+  for (int64_t e = 0; e < n; ++e) order[counts[group[e]]++] = e;
+  delete[] counts;
+
+#pragma omp parallel for schedule(dynamic)
+  for (int64_t g = 0; g < ngroups; ++g) {
+    std::stable_sort(
+        order + bounds[g], order + bounds[g + 1],
+        [c_slot, a_ent](int64_t x, int64_t y) {
+          if (c_slot[x] != c_slot[y]) return c_slot[x] < c_slot[y];
+          return a_ent[x] < a_ent[y];
+        });
+  }
+}
+
+int32_t dbcsr_native_version() { return 2; }
 
 }  // extern "C"

@@ -24,3 +24,12 @@ from dbcsr_tpu.parallel.dist_matrix import (
     replicate,
 )
 from dbcsr_tpu.parallel.sparse_dist import sparse_multiply_distributed
+from dbcsr_tpu.parallel.images import ImageDistribution, make_image_dist
+from dbcsr_tpu.parallel.multihost import (
+    init_multihost,
+    shutdown_multihost,
+    make_multihost_grid,
+    process_count,
+    process_id,
+    is_coordinator,
+)

@@ -70,9 +70,13 @@ def _panel_slots(panel_ids: np.ndarray) -> np.ndarray:
 
 
 def _vcol(k: np.ndarray, kl: int, s: int):
-    """k block -> (layer, panel column) cyclic over kl*s virtual columns."""
-    v = k % (kl * s)
-    return v // s, v % s
+    """k block -> (layer, panel column): the k axis is an image
+    distribution of multiplicity kl over the s physical columns
+    (`parallel/images.py`; ref `dbcsr_create_image_dist`,
+    `dbcsr_mm_dist_operations.F:58`)."""
+    from dbcsr_tpu.parallel.images import ImageDistribution
+
+    return ImageDistribution(s, kl).split(k)
 
 
 @functools.partial(

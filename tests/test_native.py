@@ -17,7 +17,7 @@ def lib():
 
 
 def test_native_builds(lib):
-    assert lib.dbcsr_native_version() == 1
+    assert lib.dbcsr_native_version() >= 2
 
 
 @pytest.mark.parametrize("limits", [
@@ -115,3 +115,19 @@ def test_symbolic_product_nan_norm_product_drops(lib):
     assert len(got[0]) == 0
     for g, w in zip(got, want):
         np.testing.assert_array_equal(g, w)
+
+
+def test_group_sort_stacks_matches_lexsort(lib):
+    rng = np.random.default_rng(0)
+    n, ngroups = 5000, 12
+    g = rng.integers(0, ngroups, n).astype(np.int64)
+    c_slot = rng.integers(0, 40, n).astype(np.int32)
+    a_ent = rng.permutation(n).astype(np.int64)
+    order, bounds = native.group_sort_stacks(g, ngroups, c_slot, a_ent)
+    want = np.lexsort((a_ent, c_slot, g))
+    np.testing.assert_array_equal(order, want)
+    # bounds must delimit the sorted groups
+    gs = g[order]
+    for grp in range(ngroups):
+        s0, s1 = bounds[grp], bounds[grp + 1]
+        assert np.all(gs[s0:s1] == grp)

@@ -120,3 +120,33 @@ def test_distributed_symmetric_input():
     dc = multiply_distributed(1.0, distribute(a, mesh, "A"), distribute(b, mesh, "B"))
     np.testing.assert_allclose(to_dense(collect(dc)), to_dense(a) @ to_dense(b),
                                rtol=1e-12, atol=1e-12)
+
+
+def test_multihost_single_process_semantics():
+    """Serial-stub behavior (ref dbcsr_mpiwrap.F:130-150): one process,
+    mesh equals the single-host grid."""
+    from dbcsr_tpu.parallel import (
+        is_coordinator,
+        make_multihost_grid,
+        process_count,
+        process_id,
+    )
+
+    assert process_count() == 1
+    assert process_id() == 0
+    assert is_coordinator()
+    mesh = make_multihost_grid()
+    assert set(mesh.axis_names) == {"kl", "pr", "pc"}
+    assert mesh.devices.size == 8
+
+
+def test_stored_coordinates():
+    import numpy as np
+
+    from dbcsr_tpu import Distribution
+    from dbcsr_tpu.core.dist import ProcessGrid
+
+    grid = ProcessGrid(2, 3)
+    d = Distribution(np.array([0, 1, 0]), np.array([2, 0, 1, 2]), grid)
+    assert d.stored_coordinates(1, 0) == (1, 2)
+    assert d.stored_coordinates(2, 1) == (0, 0)

@@ -106,3 +106,20 @@ def test_sparse_cannon_rejects_bad_blocking(mesh4):
     c_bad = _rand("C", [3] * 8, [4] * 6, 0.5, 21)
     with pytest.raises(ValueError):
         sparse_multiply_distributed(1.0, a, b, 1.0, c_bad, mesh4)
+
+
+def test_image_distribution_invariants():
+    from dbcsr_tpu.parallel import ImageDistribution, make_image_dist
+
+    d = ImageDistribution(3, 2)
+    assert d.nimages == 6
+    blks = np.arange(25)
+    layer, phys = d.split(blks)
+    assert phys.max() < 3 and layer.max() < 2
+    # every block maps to exactly one image; images partition the blocks
+    seen = np.concatenate([d.blocks_of_image(v, 25) for v in range(6)])
+    assert sorted(seen.tolist()) == list(range(25))
+    np.testing.assert_array_equal(d.image_of(blks), layer * 3 + phys)
+    # lcm pairing: a 2-wide axis meets a 3-wide partner on 6 images
+    pair = make_image_dist(2, 3)
+    assert pair.nimages == 6 and pair.multiplicity == 3
