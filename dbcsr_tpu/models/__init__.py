@@ -11,4 +11,7 @@ from dbcsr_tpu.models.purify import (
     mcweeny_purify,
     mcweeny_step,
     mcweeny_step_distributed,
+    mcweeny_step_sparse_distributed,
+    make_test_density,
 )
+from dbcsr_tpu.models.sign import sign_iteration, sign_step

@@ -96,6 +96,24 @@ def mcweeny_step_distributed(p_a: DistMatrix, p_b: DistMatrix) -> DistMatrix:
     )
 
 
+def mcweeny_step_sparse_distributed(
+    p: BlockSparseMatrix, mesh, filter_eps: Optional[float] = None
+) -> BlockSparseMatrix:
+    """One purification step via the block-sparse Cannon path
+    (`parallel/sparse_dist.py`): device work scales with nnz.
+    Host-resident in/out; P' = 3 P² - 2 P³."""
+    from dbcsr_tpu.ops.operations import filter_matrix
+    from dbcsr_tpu.parallel.sparse_dist import sparse_multiply_distributed
+
+    p2 = sparse_multiply_distributed(1.0, p, p, 0.0, None, mesh, name="P2")
+    if filter_eps is not None:
+        filter_matrix(p2, filter_eps)
+    p3 = sparse_multiply_distributed(1.0, p2, p, 0.0, None, mesh, name="P3")
+    if filter_eps is not None:
+        filter_matrix(p3, filter_eps)
+    return add(p2, p3, 3.0, -2.0)
+
+
 def make_test_density(n_blocks: int, block_size: int, occ: float = 0.2, seed: int = 0):
     """A symmetric matrix with spectrum in [0,1]-ish for purification
     tests: P0 = 0.5*I + small random symmetric sparse part."""

@@ -148,19 +148,27 @@ def sparse_multiply_distributed(
     matrix_c: Optional[BlockSparseMatrix],
     mesh: Mesh,
     name: Optional[str] = None,
+    first_row=None, last_row=None,
+    first_col=None, last_col=None,
+    first_k=None, last_k=None,
 ) -> BlockSparseMatrix:
     """C = alpha*A@B + beta*C on the mesh with block-sparse panels.
 
     Host-resident in/out (the single-controller analog of
     `dbcsr_multiply_generic` driving `multiply_cannon`); device compute
-    and inter-device traffic are fully sparse.
+    and inter-device traffic are fully sparse.  The optional block-index
+    limits restrict the product exactly like `dbcsr_tpu.multiply`'s
+    (used by the TAS group loop).
     """
     with timed("sparse_cannon"):
-        return _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta,
-                                     matrix_c, mesh, name)
+        return _sparse_multiply_impl(
+            alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
+            (first_row, last_row, first_col, last_col, first_k, last_k),
+        )
 
 
-def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name):
+def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
+                          limits=(None,) * 6):
     kl, s = mesh.shape["kl"], mesh.shape["pr"]
     if mesh.shape["pc"] != s:
         raise ValueError("sparse Cannon needs a square ('pr','pc') grid")
@@ -178,7 +186,8 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name)
         and np.array_equal(matrix_c.col_blk_sizes, b.col_blk_sizes)
     ):
         raise ValueError("C blocking incompatible with op(A), op(B)")
-    dtype = np.dtype(a.dtype)
+    # accumulate in C's dtype when C is given (host-path convention)
+    dtype = np.dtype(matrix_c.dtype) if matrix_c is not None else np.dtype(a.dtype)
     bm = int(a.row_blk_sizes.max()) if a.nblkrows else 1
     bk = int(a.col_blk_sizes.max()) if a.nblkcols else 1
     bn = int(b.col_blk_sizes.max()) if b.nblkcols else 1
@@ -190,10 +199,17 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name)
         name or f"{a.name}*{b.name}", a.row_blk_sizes, b.col_blk_sizes, dtype
     )
     rows_t, cols_t, a_ent, b_ent = _candidates(
-        a, b, shell_c, None, None, None, None, None, None, None
+        a, b, shell_c, None, *limits
     )
     k_of_a = (a.keys % a.nblkcols).astype(np.int64)
     k_t = k_of_a[a_ent]
+    true_flops = int(
+        2 * np.sum(
+            a.row_blk_sizes[rows_t].astype(np.int64)
+            * b.col_blk_sizes[cols_t]
+            * a.col_blk_sizes[k_t]
+        )
+    )
 
     # ---- device/tick assignment ----
     i_dev = rows_t % s
@@ -283,13 +299,20 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name)
     out = BlockSparseMatrix(
         name or (matrix_c.name if matrix_c is not None else f"{a.name}*{b.name}"),
         a.row_blk_sizes, b.col_blk_sizes, dtype,
+        dist=matrix_c.dist if matrix_c is not None else None,
     )
     rbs, cbs = out.row_blk_sizes, out.col_blk_sizes
     for e in range(len(c_keys)):
         r, c = int(c_rows[e]), int(c_cols[e])
         blk = c_np[r % s, c % s, c_slots[e], : rbs[r], : cbs[c]]
         out.put_block(r, c, blk)
-    return out.finalize()
+    out.finalize()
+    from dbcsr_tpu.core import stats
+
+    stats.record_stack(bm, bn, bk, len(rows_t))
+    stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
+    out._last_flops = true_flops  # true flop count of this product
+    return out
 
 
 class _HashableMesh:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Optional, Union
 
+
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
 from dbcsr_tpu.core.timings import timed
 from dbcsr_tpu.mm.multiply import multiply
@@ -38,12 +39,19 @@ def tas_multiply(
     filter_eps: Optional[float] = None,
     nsplit: Optional[int] = None,
     ngroups_max: int = 64,
+    mesh=None,
 ) -> int:
     """C = alpha op(A) op(B) + beta C with long-dimension splitting.
 
     Returns total flops.  `nsplit=None` chooses the split from the
     split-factor estimate (ref `dbcsr_tas_mm.F:1427`); `nsplit=1`
     degenerates to a single multiply.
+
+    With ``mesh`` the per-group multiplies run on the block-sparse
+    distributed Cannon path (`parallel/sparse_dist.py`) — the
+    single-controller analog of the reference's per-group process
+    grids (`dbcsr_tas_split.F:304`), with the group loop bounding each
+    multiply's working set.
     """
     a = _unwrap(matrix_a)
     b = _unwrap(matrix_b)
@@ -82,6 +90,11 @@ def tas_multiply(
 
         dims = {"m": m_full, "n": n_full, "k": k_full}
         long_dim = max(dims, key=dims.get)
+        if mesh is not None:
+            return _tas_multiply_mesh(
+                transa, transb, alpha, a, b, beta, c, filter_eps,
+                max(nsplit, 1), long_dim, nblk_k, mesh,
+            )
         if nsplit <= 1:
             return multiply(transa, transb, alpha, a, b, beta, c,
                             filter_eps=filter_eps)
@@ -105,3 +118,52 @@ def tas_multiply(
                 **{limit_lo: g0, limit_hi: g1 - 1},
             )
         return flops
+
+
+def _tas_multiply_mesh(transa, transb, alpha, a, b, beta, c, filter_eps,
+                       nsplit, long_dim, nblk_k, mesh) -> int:
+    """Group loop over the distributed sparse Cannon path, bounded per
+    group by the same block-index limits the host path uses."""
+    from dbcsr_tpu.core.kinds import is_complex
+    from dbcsr_tpu.core.matrix import NO_SYMMETRY
+    from dbcsr_tpu.ops.operations import filter_matrix, scale
+    from dbcsr_tpu.ops.transformations import new_transposed
+    from dbcsr_tpu.parallel.sparse_dist import sparse_multiply_distributed
+
+    def _op(m, trans):
+        t = trans.upper()
+        if t == "N":
+            return m
+        return new_transposed(m, conjugate=(t == "C" and is_complex(m.dtype)))
+
+    a_eff = _op(a, transa)
+    b_eff = _op(b, transb)
+    if beta != 1.0:
+        scale(c, beta)
+    nblk = {"m": c.nblkrows, "n": c.nblkcols, "k": nblk_k}[long_dim]
+    limit_names = {
+        "m": ("first_row", "last_row"),
+        "n": ("first_col", "last_col"),
+        "k": ("first_k", "last_k"),
+    }[long_dim]
+    per = ceil_div(nblk, nsplit)
+    flops = 0
+    acc = c
+    for g0 in range(0, nblk, per):
+        g1 = min(g0 + per, nblk) - 1
+        acc = sparse_multiply_distributed(
+            alpha, a_eff, b_eff, 1.0, acc, mesh, name=c.name,
+            **{limit_names[0]: g0, limit_names[1]: g1},
+        )
+        flops += getattr(acc, "_last_flops", 0)
+    # adopt the accumulated structure into the caller's C object,
+    # preserving its Distribution and dtype; the product is plain
+    # (the sparse path desymmetrizes)
+    for field in ("keys", "row_ptr", "ent_bin", "ent_slot", "bins",
+                  "_shape_to_bin", "valid"):
+        setattr(c, field, getattr(acc, field))
+    c.matrix_type = NO_SYMMETRY
+    c._work.clear()
+    if filter_eps is not None:
+        filter_matrix(c, filter_eps)
+    return flops

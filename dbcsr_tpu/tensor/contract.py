@@ -100,6 +100,7 @@ def contract(
     bounds_1=None,
     bounds_2=None,
     bounds_3=None,
+    mesh=None,
 ) -> int:
     """C[map_1, map_2] = alpha * sum over contracted dims of A*B + beta*C.
 
@@ -168,7 +169,7 @@ def contract(
         if (tensor_c.row_dims, tensor_c.col_dims) == c_layout:
             flops = tas_multiply(
                 "N", "N", alpha, a2.matrix, b2.matrix, beta, tensor_c.matrix,
-                filter_eps=filter_eps, nsplit=nsplit,
+                filter_eps=filter_eps, nsplit=nsplit, mesh=mesh,
             )
             return flops
         tmp = BlockSparseTensor(
@@ -177,7 +178,7 @@ def contract(
         tmp.finalize()
         flops = tas_multiply(
             "N", "N", alpha, a2.matrix, b2.matrix, 0.0, tmp.matrix,
-            filter_eps=filter_eps, nsplit=nsplit,
+            filter_eps=filter_eps, nsplit=nsplit, mesh=mesh,
         )
         if beta != 1.0:
             scale(tensor_c.matrix, beta)

@@ -37,3 +37,50 @@ def test_mcweeny_distributed_matches_single():
     dist = mcweeny_step_distributed(distribute(p, mesh, "A"), distribute(p, mesh, "B"))
     got = to_dense(collect(dist, drop_zero_blocks=False))
     np.testing.assert_allclose(got, single, rtol=1e-12, atol=1e-12)
+
+
+def test_sign_iteration_converges_to_sign():
+    """Newton-Schulz on a symmetric positive definite matrix must reach
+    sign(A) = I."""
+    import numpy as np
+
+    from dbcsr_tpu.models import sign_iteration
+    from dbcsr_tpu.models.purify import make_test_density
+    from dbcsr_tpu.ops.test_methods import to_dense
+
+    a = make_test_density(n_blocks=6, block_size=3, occ=0.4, seed=2)
+    # spd by construction (0.5*I + small symmetric) -> sign(A) = I
+    x, hist = sign_iteration(a, steps=30, tol=1e-12)
+    np.testing.assert_allclose(to_dense(x), np.eye(18), atol=1e-8)
+    assert hist[-1] < 1e-8
+
+
+def test_sign_iteration_mixed_spectrum():
+    import numpy as np
+
+    from dbcsr_tpu.models import sign_iteration
+    from dbcsr_tpu.ops.test_methods import from_dense, to_dense
+
+    rng = np.random.default_rng(0)
+    q, _ = np.linalg.qr(rng.standard_normal((12, 12)))
+    eig = np.array([1.5, 2.0, 0.7, 1.1, 0.9, 0.8, -1.2, -0.5, -2.0, -0.9, 1.3, -1.4])
+    d = (q * eig) @ q.T
+    a = from_dense("A", d, [3, 3, 3, 3], [3, 3, 3, 3])
+    x, _ = sign_iteration(a, steps=60, tol=1e-13)
+    want = (q * np.sign(eig)) @ q.T
+    np.testing.assert_allclose(to_dense(x), want, atol=1e-6)
+
+
+def test_mcweeny_sparse_distributed_matches_single():
+    import numpy as np
+
+    from dbcsr_tpu.models import mcweeny_step, mcweeny_step_sparse_distributed
+    from dbcsr_tpu.models.purify import make_test_density
+    from dbcsr_tpu.ops.test_methods import to_dense
+    from dbcsr_tpu.parallel import make_grid
+
+    mesh = make_grid(8)
+    p = make_test_density(n_blocks=8, block_size=3, occ=0.5, seed=4)
+    want = to_dense(mcweeny_step(p))
+    got = to_dense(mcweeny_step_sparse_distributed(p, mesh))
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)

@@ -85,3 +85,49 @@ def test_split_heuristics():
     assert choose_nsplit(sf, ngroups_max=8, nblks_long=1000) <= 8
     assert choose_nsplit(0.5, 8, 10) == 1
     assert choose_nsplit(100.0, 8, 3) == 3
+
+
+def test_tas_multiply_on_mesh_matches_host():
+    import numpy as np
+
+    from dbcsr_tpu import make_random_matrix, multiply, to_dense
+    from dbcsr_tpu.parallel import make_grid
+    from dbcsr_tpu.tas import tas_multiply
+
+    mesh = make_grid(8)
+    rng = np.random.default_rng(0)
+    tall = [3] * 30
+    short = [4, 4]
+    a = make_random_matrix("A", tall, short, occupation=0.5, rng=rng)
+    b = make_random_matrix("B", short, short, occupation=1.0, rng=rng)
+    c = make_random_matrix("C", tall, short, occupation=0.1, rng=rng)
+    c_host = c.copy()
+    tas_multiply("N", "N", 1.5, a, b, 0.5, c, nsplit=3, mesh=mesh)
+    multiply("N", "N", 1.5, a, b, 0.5, c_host)
+    np.testing.assert_allclose(to_dense(c), to_dense(c_host), rtol=1e-12, atol=1e-12)
+
+
+def test_tensor_contract_on_mesh():
+    import numpy as np
+
+    from dbcsr_tpu.parallel import make_grid
+    from dbcsr_tpu.tensor import contract, create_tensor
+
+    mesh = make_grid(8)
+    rng = np.random.default_rng(1)
+    si, sk, sj = [2, 3], [3, 2, 2], [2, 2]
+    import itertools
+
+    a = create_tensor("a", [si, sk])
+    b = create_tensor("b", [sk, sj])
+    c = create_tensor("c", [si, sj])
+    for t, occ in ((a, 1.0), (b, 1.0)):
+        for idx in itertools.product(*(range(n) for n in t.nblks_per_dim)):
+            if rng.random() < occ:
+                t.put_block(idx, rng.standard_normal(t.block_shape(idx)))
+        t.finalize()
+    c.finalize()
+    contract(1.0, a, b, 0.0, c, (1,), (0,), (0,), (1,), mesh=mesh)
+    np.testing.assert_allclose(
+        c.to_dense(), a.to_dense() @ b.to_dense(), rtol=1e-12, atol=1e-12
+    )
