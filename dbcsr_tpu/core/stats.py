@@ -18,7 +18,14 @@ class _MnkStat:
     flops: int = 0
 
 
+@dataclasses.dataclass
+class _CommStat:
+    nmessages: int = 0
+    nbytes: int = 0
+
+
 _by_mnk: dict = collections.defaultdict(_MnkStat)
+_comm: dict = collections.defaultdict(_CommStat)
 _totals = {"multiplies": 0, "flops": 0, "marketing_flops": 0}
 
 
@@ -33,6 +40,21 @@ def record_stack(m: int, n: int, k: int, nentries: int) -> None:
     st.flops += 2 * m * n * k * nentries
 
 
+def record_comm(kind: str, nmessages: int, nbytes: int) -> None:
+    """Collective-traffic counters (analog of the reference's MPI
+    statistics: message counts/sizes per class,
+    `dbcsr_mm_common.F:135` count_mpi_statistics /
+    `dbcsr_mpi_statistics_type`).  ``kind`` names the collective
+    ('ppermute', 'psum', 'alltoall', 'host2dev', ...)."""
+    from dbcsr_tpu.core.config import get_config
+
+    if not get_config().keep_stats:
+        return
+    st = _comm[kind]
+    st.nmessages += int(nmessages)
+    st.nbytes += int(nbytes)
+
+
 def record_multiply(marketing_flops: int) -> None:
     _totals["multiplies"] += 1
     _totals["marketing_flops"] += marketing_flops
@@ -44,6 +66,7 @@ def total_flops() -> int:
 
 def reset() -> None:
     _by_mnk.clear()
+    _comm.clear()
     for k in _totals:
         _totals[k] = 0
 
@@ -65,4 +88,9 @@ def print_statistics(out=print) -> None:
     out(f" {'total (TPU stacks)':>24} {'':>14} {'':>12} {tot / 1e9:>12.3f}")
     out(f" multiplications:       {_totals['multiplies']}")
     out(f" marketing flops:       {_totals['marketing_flops'] / 1e9:.3f} GFLOP")
+    if _comm:
+        out(" -" + "COLLECTIVE TRAFFIC".center(68) + "-")
+        out(f" {'collective':>24} {'messages':>14} {'MB':>12}")
+        for kind, st in sorted(_comm.items()):
+            out(f" {kind:>24} {st.nmessages:>14} {st.nbytes / 1e6:>12.2f}")
     out(" " + "-" * 70)

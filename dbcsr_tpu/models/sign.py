@@ -15,6 +15,7 @@ from typing import Optional
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
 from dbcsr_tpu.mm.multiply import multiply
 from dbcsr_tpu.ops.operations import (
+    add,
     add_on_diag,
     copy,
     frobenius_norm,
@@ -48,13 +49,16 @@ def sign_iteration(
     A is Gershgorin-scaled so the iteration contracts; convergence is
     measured as ||X_k - X_{k-1}||_F and iteration stops below ``tol``.
     """
+    from dbcsr_tpu.core.matrix import NO_SYMMETRY
+    from dbcsr_tpu.ops.transformations import desymmetrize
+
+    if a.matrix_type != NO_SYMMETRY:
+        a = desymmetrize(a)  # iterates mix with plain multiply results
     g = gershgorin_norm(a)
     x = scale(copy(a, name="X"), 1.0 / g if g > 0 else 1.0)
     history = []
     for _ in range(steps):
         x_new = sign_step(x, filter_eps=filter_eps)
-        from dbcsr_tpu.ops.operations import add
-
         diff = add(copy(x_new), x, 1.0, -1.0)
         history.append(frobenius_norm(diff))
         x = x_new

@@ -311,6 +311,27 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
 
     stats.record_stack(bm, bn, bk, len(rows_t))
     stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
+    # collective-traffic accounting (ref count_mpi_statistics,
+    # dbcsr_mm_common.F:135): each tick ppermutes every device's A and B
+    # panel; the layer reduction psums each device's C panel
+    ndev = kl * s * s
+    itemsize = dtype.itemsize
+    if s > 1:
+        stats.record_comm(
+            "ppermute", 2 * s * ndev,
+            s * ndev * (cap_a * bm * bk + cap_b * bk * bn) * itemsize,
+        )
+    if kl > 1:
+        # ring-reduce model: each of the kl-1 steps moves every
+        # (pr,pc) position's C panel once
+        stats.record_comm(
+            "psum", (kl - 1) * s * s,
+            (kl - 1) * s * s * cap_c * bm * bn * itemsize,
+        )
+    stats.record_comm(
+        "host2dev", 4,
+        a_panels.nbytes + b_panels.nbytes + stacks.nbytes + c_init.nbytes,
+    )
     out._last_flops = true_flops  # true flop count of this product
     return out
 

@@ -122,11 +122,18 @@ def tas_multiply(
 
 def _tas_multiply_mesh(transa, transb, alpha, a, b, beta, c, filter_eps,
                        nsplit, long_dim, nblk_k, mesh) -> int:
-    """Group loop over the distributed sparse Cannon path, bounded per
-    group by the same block-index limits the host path uses."""
+    """One distributed sparse Cannon multiply.
+
+    On the single-controller mesh path the host-side TAS group loop
+    would only repeat full panel assembly + upload per group, so the
+    split collapses to nsplit=1 here: the mesh's 'kl' layer axis
+    already partitions the k space across process groups — the role
+    `dbcsr_tas_split.F:304` gives its grid subgroups — and the
+    symbolic-product limits remain available to callers that chunk
+    explicitly (batched contraction bounds)."""
     from dbcsr_tpu.core.kinds import is_complex
     from dbcsr_tpu.core.matrix import NO_SYMMETRY
-    from dbcsr_tpu.ops.operations import filter_matrix, scale
+    from dbcsr_tpu.ops.operations import filter_matrix
     from dbcsr_tpu.ops.transformations import new_transposed
     from dbcsr_tpu.parallel.sparse_dist import sparse_multiply_distributed
 
@@ -136,29 +143,13 @@ def _tas_multiply_mesh(transa, transb, alpha, a, b, beta, c, filter_eps,
             return m
         return new_transposed(m, conjugate=(t == "C" and is_complex(m.dtype)))
 
-    a_eff = _op(a, transa)
-    b_eff = _op(b, transb)
-    if beta != 1.0:
-        scale(c, beta)
-    nblk = {"m": c.nblkrows, "n": c.nblkcols, "k": nblk_k}[long_dim]
-    limit_names = {
-        "m": ("first_row", "last_row"),
-        "n": ("first_col", "last_col"),
-        "k": ("first_k", "last_k"),
-    }[long_dim]
-    per = ceil_div(nblk, nsplit)
-    flops = 0
-    acc = c
-    for g0 in range(0, nblk, per):
-        g1 = min(g0 + per, nblk) - 1
-        acc = sparse_multiply_distributed(
-            alpha, a_eff, b_eff, 1.0, acc, mesh, name=c.name,
-            **{limit_names[0]: g0, limit_names[1]: g1},
-        )
-        flops += getattr(acc, "_last_flops", 0)
-    # adopt the accumulated structure into the caller's C object,
-    # preserving its Distribution and dtype; the product is plain
-    # (the sparse path desymmetrizes)
+    acc = sparse_multiply_distributed(
+        alpha, _op(a, transa), _op(b, transb), beta, c, mesh, name=c.name
+    )
+    flops = getattr(acc, "_last_flops", 0)
+    # adopt the result structure into the caller's C object, preserving
+    # its Distribution and dtype; the product is plain (the sparse path
+    # desymmetrizes)
     for field in ("keys", "row_ptr", "ent_bin", "ent_slot", "bins",
                   "_shape_to_bin", "valid"):
         setattr(c, field, getattr(acc, field))

@@ -84,3 +84,20 @@ def test_mcweeny_sparse_distributed_matches_single():
     want = to_dense(mcweeny_step(p))
     got = to_dense(mcweeny_step_sparse_distributed(p, mesh))
     np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)
+
+
+def test_sign_iteration_symmetric_storage_input():
+    """Regression: symmetric-stored input must not crash the
+    convergence check (mixed-symmetry add)."""
+    import numpy as np
+
+    from dbcsr_tpu.models import sign_iteration
+    from dbcsr_tpu.ops.test_methods import make_random_matrix, to_dense
+
+    rng = np.random.default_rng(9)
+    a = make_random_matrix("A", [3] * 5, [3] * 5, occupation=0.6,
+                           matrix_type="S", rng=rng)
+    x, hist = sign_iteration(a, steps=3)  # must not raise
+    got = to_dense(x)
+    assert np.all(np.isfinite(got))
+    np.testing.assert_allclose(got, got.T, atol=1e-10)  # sign(A) symmetric

@@ -123,3 +123,18 @@ def test_image_distribution_invariants():
     # lcm pairing: a 2-wide axis meets a 3-wide partner on 6 images
     pair = make_image_dist(2, 3)
     assert pair.nimages == 6 and pair.multiplicity == 3
+
+
+def test_comm_statistics_recorded(mesh8):
+    from dbcsr_tpu.core import stats
+
+    stats.reset()
+    rbs = [4] * 8
+    a = _rand("A", rbs, rbs, 0.5, 30)
+    b = _rand("B", rbs, rbs, 0.5, 31)
+    sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh8)
+    lines = []
+    stats.print_statistics(out=lines.append)
+    joined = "\n".join(lines)
+    assert "ppermute" in joined and "host2dev" in joined
+    stats.reset()
