@@ -35,6 +35,10 @@ class Config:
     # (m,n,k,dtype), like libsmm_acc's JIT-time checksum validation
     # (libsmm_acc.cpp:216)
     validate_kernels: bool = True
+    # lay A/B out as (N, m*k) flat rows before the per-entry gather so
+    # gathers move lane-packed rows instead of tile-padded blocks
+    # (see acc/smm.py:_process_stack_xla_flat)
+    flat_gather: bool = False
     # keep per-(m,n,k) flop statistics (ref STATISTICS block)
     keep_stats: bool = True
 
