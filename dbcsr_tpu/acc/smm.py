@@ -137,6 +137,55 @@ def pad_stack(a_idx, b_idx, c_idx, target_len: int, drop_segment: int):
     )
 
 
+# (m, n, k, dtype) combos whose Pallas kernel passed first-use
+# validation (ref: libsmm_acc's per-kernel JIT-time checksum gate,
+# `libsmm_acc.cpp:81-85,216` — hard exit on mismatch)
+_validated_kernels: set = set()
+_VALIDATE_MAX_ENTRIES = 512
+
+
+class KernelValidationError(RuntimeError):
+    """A device kernel produced results that differ from the host oracle."""
+
+
+def _validate_pallas_kernel(c_data, a_data, b_data, a_idx, b_idx, c_idx,
+                            a_pad_row, b_pad_row, grouping) -> None:
+    """First-use validation of the Pallas kernel for this shape/dtype.
+
+    Runs a prefix of the actual stack (still sorted by c_idx) on a
+    zeroed C through the Pallas path and through a NumPy host oracle,
+    and hard-fails on mismatch — like `validate_kernel` in
+    `libsmm_acc.cpp:216` (checksum vs CPU, exit(1) at :81-85).
+    """
+    from dbcsr_tpu.acc.pallas_smm import process_stack_pallas
+
+    s = min(len(a_idx), _VALIDATE_MAX_ENTRIES)
+    ai = np.asarray(a_idx[:s], np.int32)
+    bi = np.asarray(b_idx[:s], np.int32)
+    ci = np.asarray(c_idx[:s], np.int32)
+    c0 = jnp.zeros_like(c_data)
+    got = process_stack_pallas(
+        c0, a_data, b_data, ai, bi, ci, 1.0,
+        a_pad_row=a_pad_row, b_pad_row=b_pad_row, grouping=grouping,
+    )
+    got = np.asarray(got)
+    a_h = np.asarray(a_data)[ai].astype(np.float64)
+    b_h = np.asarray(b_data)[bi].astype(np.float64)
+    ref = np.zeros(c_data.shape, np.float64)
+    np.add.at(ref, ci, np.einsum("smk,skn->smn", a_h, b_h))
+    scale = max(np.max(np.abs(ref)), 1.0)
+    err = np.max(np.abs(got.astype(np.float64) - ref)) / scale
+    tol = 5e-2 if got.dtype == jnp.bfloat16 else 1e-5
+    if not np.isfinite(err) or err > tol:
+        m, k = a_data.shape[1:]
+        n = b_data.shape[2]
+        raise KernelValidationError(
+            f"pallas SMM kernel validation failed for "
+            f"(m={m}, n={n}, k={k}, dtype={c_data.dtype}): "
+            f"relative error {err:.3e} > {tol:.0e} vs host oracle"
+        )
+
+
 def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
                   a_pad_row=None, b_pad_row=None):
     """Process a full (possibly large) stack, chunked to mm_stack_size.
@@ -175,10 +224,30 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
             grouping = None
             if tuned and tuned.get("driver") == "pallas" and tuned.get("grouping"):
                 grouping = int(tuned["grouping"])
+            if cfg.validate_kernels:
+                key = (
+                    a_data.shape[1], b_data.shape[2], a_data.shape[2],
+                    str(jnp.dtype(c_data.dtype)),
+                )
+                if key not in _validated_kernels:
+                    _validate_pallas_kernel(
+                        c_data, a_data, b_data, a_idx, b_idx, c_idx,
+                        a_pad_row, b_pad_row, grouping,
+                    )
+                    _validated_kernels.add(key)
             return process_stack_pallas(
                 c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha,
                 a_pad_row=a_pad_row, b_pad_row=b_pad_row, grouping=grouping,
             )
+    elif cfg.mm_driver == "pallas":
+        import warnings
+
+        warnings.warn(
+            f"mm_driver='pallas' but dtype {jnp.dtype(c_data.dtype)} / block "
+            f"shape unsupported by the Pallas kernel; falling back to XLA path",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     nseg = c_data.shape[0]
     alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)
     chunk = max(cfg.mm_stack_size, 1)

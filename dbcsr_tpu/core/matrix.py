@@ -24,7 +24,6 @@ lower-triangle writes onto the stored transpose.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
@@ -68,8 +67,10 @@ def _fold_block(block: np.ndarray, matrix_type: str) -> np.ndarray:
     raise AssertionError(matrix_type)
 
 
-@functools.partial(jax.jit, static_argnames=("count",))
-def _rezero_pad_rows(data, count: int):
+@jax.jit
+def _rezero_pad_rows(data, count):
+    # count is a traced scalar: one compiled program per bin shape, not
+    # one per (shape, count) pair as matrices grow
     mask = (jnp.arange(data.shape[0]) < count).reshape(-1, 1, 1)
     return jnp.where(mask, data, jnp.zeros_like(data))
 

@@ -523,33 +523,44 @@ def crop_matrix(
 
 def verify_matrix(matrix: BlockSparseMatrix, check_data: bool = True) -> bool:
     """Structural invariant check (ref `dbcsr_verify_matrix`,
-    `dbcsr_dist_util.F:578-732`); raises AssertionError on violation."""
+    `dbcsr_dist_util.F:578-732`); raises ValueError on violation.
+
+    Explicit raises (not ``assert``) so the checker keeps its contract
+    under ``python -O``."""
+
+    def _check(cond, msg):
+        if not cond:
+            raise ValueError(f"verify_matrix({matrix.name}): {msg}")
+
     _require_valid(matrix)
     keys = matrix.keys
-    assert np.all(np.diff(keys) > 0), "index keys not strictly sorted"
+    _check(np.all(np.diff(keys) > 0), "index keys not strictly sorted")
     nb = matrix.nblkrows * matrix.nblkcols
-    assert len(keys) == 0 or (keys[0] >= 0 and keys[-1] < nb), "key out of range"
+    _check(len(keys) == 0 or (keys[0] >= 0 and keys[-1] < nb), "key out of range")
     rows, cols = matrix.entry_coords()
     counts = np.bincount(rows, minlength=matrix.nblkrows)
-    assert np.array_equal(np.diff(matrix.row_ptr), counts), "row_ptr inconsistent"
-    assert len(matrix.ent_bin) == len(keys) and len(matrix.ent_slot) == len(keys)
+    _check(np.array_equal(np.diff(matrix.row_ptr), counts), "row_ptr inconsistent")
+    _check(
+        len(matrix.ent_bin) == len(keys) and len(matrix.ent_slot) == len(keys),
+        "entry->bin maps length mismatch",
+    )
     for b_id, b in enumerate(matrix.bins):
         sel = matrix.ent_bin == b_id
         slots = matrix.ent_slot[sel]
-        assert len(np.unique(slots)) == len(slots), f"bin {b_id} slot collision"
-        assert b.count == int(sel.sum()), f"bin {b_id} count mismatch"
-        assert b.data.shape[0] >= b.count, f"bin {b_id} capacity < count"
-        assert slots.size == 0 or slots.max() < b.count, f"bin {b_id} slot >= count"
+        _check(len(np.unique(slots)) == len(slots), f"bin {b_id} slot collision")
+        _check(b.count == int(sel.sum()), f"bin {b_id} count mismatch")
+        _check(b.data.shape[0] >= b.count, f"bin {b_id} capacity < count")
+        _check(slots.size == 0 or slots.max() < b.count, f"bin {b_id} slot >= count")
         bm, bn = b.shape
-        assert np.all(matrix.row_blk_sizes[rows[sel]] == bm), f"bin {b_id} row size"
-        assert np.all(matrix.col_blk_sizes[cols[sel]] == bn), f"bin {b_id} col size"
+        _check(np.all(matrix.row_blk_sizes[rows[sel]] == bm), f"bin {b_id} row size")
+        _check(np.all(matrix.col_blk_sizes[cols[sel]] == bn), f"bin {b_id} col size")
     if matrix.matrix_type != NO_SYMMETRY:
-        assert np.all(rows <= cols), "symmetric matrix stores lower-triangle block"
+        _check(np.all(rows <= cols), "symmetric matrix stores lower-triangle block")
     if check_data:
         for b in matrix.bins:
             if b.count:
                 finite = jnp.all(jnp.isfinite(b.data.real)) & jnp.all(
                     jnp.isfinite(b.data.imag)
                 )
-                assert bool(finite), "non-finite block data"
+                _check(bool(finite), "non-finite block data")
     return True

@@ -56,30 +56,39 @@ def restrict_tensor(
     dim_bounds,
     name: Optional[str] = None,
 ) -> BlockSparseTensor:
-    """Copy keeping only blocks whose multi-index lies within
-    ``dim_bounds`` — a {dim: (lo, hi)} map of inclusive block-index
-    ranges (the restriction step behind the reference's contract
-    ``bounds_1/2/3`` arguments, `dbcsr_tensor.F:470-490`)."""
+    """Restrict to blocks whose multi-index lies within ``dim_bounds``
+    — a {dim: (lo, hi)} map of inclusive block-index ranges (the
+    restriction step behind the reference's contract ``bounds_1/2/3``
+    arguments, `dbcsr_tensor.F:470-490`).
+
+    When no restriction applies (and no ``name`` is requested), the
+    input tensor itself is returned — callers must not mutate the
+    result in place.  With a ``name`` or an effective restriction, a
+    fresh copy is returned."""
     from dbcsr_tpu.ops.operations import compress, copy as matrix_copy
 
     dim_bounds = {d: b for d, b in (dim_bounds or {}).items() if b is not None}
-    if not dim_bounds:
-        out = BlockSparseTensor(
-            name or t.name, t.blk_sizes, t.row_dims, t.col_dims, t.dtype
-        )
-        out.matrix = matrix_copy(t.matrix, name=out.name)
+    mask = None
+    if dim_bounds:
+        nd_idx = t.entry_multi_coords()
+        mask = np.ones(len(nd_idx), bool)
+        for d, (lo, hi) in dim_bounds.items():
+            mask &= (nd_idx[:, d] >= lo) & (nd_idx[:, d] <= hi)
+        if mask.all():
+            mask = None
+    if mask is None:
+        if name is None:
+            # no restriction: share the tensor (downstream remap /
+            # multiply do not mutate their inputs, so the O(nnz) copy
+            # is pure overhead on every bound-less contract)
+            return t
+        out = BlockSparseTensor(name, t.blk_sizes, t.row_dims, t.col_dims, t.dtype)
+        out.matrix = matrix_copy(t.matrix, name=name)
         return out
-    nd_idx = t.entry_multi_coords()
-    mask = np.ones(len(nd_idx), bool)
-    for d, (lo, hi) in dim_bounds.items():
-        mask &= (nd_idx[:, d] >= lo) & (nd_idx[:, d] <= hi)
     out = BlockSparseTensor(
         name or t.name, t.blk_sizes, t.row_dims, t.col_dims, t.dtype
     )
-    if mask.all():
-        out.matrix = matrix_copy(t.matrix, name=out.name)
-    else:
-        out.matrix = compress(matrix_copy(t.matrix, name=out.name), mask)
+    out.matrix = compress(matrix_copy(t.matrix, name=out.name), mask)
     return out
 
 
@@ -165,6 +174,15 @@ def contract(
         # remap operands into matrix-compatible layouts (ref :1183)
         a2 = remap(restricted_a, nca, ca, name=tensor_a.name + "_mm")
         b2 = remap(restricted_b, cb, ncb, name=tensor_b.name + "_mm")
+        # restrict/remap may have passed an operand through unchanged;
+        # if the caller aliased C to an operand, multiply would then
+        # read A/B while overwriting them — copy to break the alias
+        from dbcsr_tpu.ops.operations import copy as matrix_copy
+
+        if a2.matrix is tensor_c.matrix:
+            a2.matrix = matrix_copy(a2.matrix, name=a2.name)
+        if b2.matrix is tensor_c.matrix:
+            b2.matrix = matrix_copy(b2.matrix, name=b2.name)
         c_layout = (map_1, map_2)
         if (tensor_c.row_dims, tensor_c.col_dims) == c_layout:
             flops = tas_multiply(

@@ -95,3 +95,67 @@ def test_block_norms(dtype):
     x = x.astype(dtype)
     want = np.linalg.norm(x.reshape(6, -1), axis=1)
     np.testing.assert_allclose(block_norms(x), want, rtol=1e-12)
+
+
+def test_flat_gather_matches_default():
+    """config.flat_gather relayout must not change results (same
+    accumulation order: scan over chunks + sorted segment-sum)."""
+    from dbcsr_tpu.core.config import set_config
+
+    rng = np.random.default_rng(11)
+    a, b, c, ai, bi, ci = _random_stack(rng, 9, 9, 6, 250, 6, 6, 6, np.float64)
+    base = np.asarray(process_stack(c, a, b, ai, bi, ci, alpha=1.5))
+    set_config(flat_gather=True)
+    try:
+        flat = np.asarray(process_stack(c, a, b, ai, bi, ci, alpha=1.5))
+    finally:
+        set_config(flat_gather=False)
+    np.testing.assert_allclose(flat, base, rtol=1e-13, atol=1e-13)
+
+
+def test_validate_kernels_catches_corrupted_kernel(monkeypatch):
+    """Ref: libsmm_acc validates each JIT'd kernel against a CPU
+    checksum and hard-exits on mismatch (`libsmm_acc.cpp:81-85,216`).
+    Injecting a corrupted Pallas result must raise."""
+    from dbcsr_tpu.acc import pallas_smm, smm
+    from dbcsr_tpu.core.config import set_config
+
+    rng = np.random.default_rng(13)
+    a, b, c, ai, bi, ci = _random_stack(rng, 8, 8, 6, 100, 8, 8, 8, np.float32)
+
+    real = pallas_smm.process_stack_pallas
+
+    def corrupted(c_data, a_data, b_data, *args, **kw):
+        return real(c_data, a_data, b_data, *args, **kw) + 1.0
+
+    monkeypatch.setattr(pallas_smm, "process_stack_pallas", corrupted)
+    smm._validated_kernels.discard((8, 8, 8, "float32"))
+    set_config(validate_kernels=True)
+    with pytest.raises(smm.KernelValidationError):
+        process_stack(c.astype(np.float32), a, b, ai, bi, ci)
+    assert (8, 8, 8, "float32") not in smm._validated_kernels
+
+
+def test_validate_kernels_passes_and_caches():
+    from dbcsr_tpu.acc import smm
+
+    rng = np.random.default_rng(17)
+    a, b, c, ai, bi, ci = _random_stack(rng, 8, 8, 6, 100, 9, 9, 9, np.float32)
+    smm._validated_kernels.discard((9, 9, 9, "float32"))
+    got = np.asarray(process_stack(c, a, b, ai, bi, ci))
+    np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.0), rtol=1e-4, atol=1e-4)
+    assert (9, 9, 9, "float32") in smm._validated_kernels
+
+
+def test_forced_pallas_unsupported_dtype_warns():
+    from dbcsr_tpu.core.config import set_config
+
+    rng = np.random.default_rng(19)
+    a, b, c, ai, bi, ci = _random_stack(rng, 5, 5, 4, 50, 4, 4, 4, np.float64)
+    set_config(mm_driver="pallas")
+    try:
+        with pytest.warns(RuntimeWarning, match="falling back to XLA"):
+            got = np.asarray(process_stack(c, a, b, ai, bi, ci))
+    finally:
+        set_config(mm_driver="auto")
+    np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.0), rtol=1e-12)

@@ -68,7 +68,7 @@ def test_verify_matrix_catches_corruption():
     m = _random()
     verify_matrix(m)
     m.keys = m.keys[::-1].copy()  # break sorted invariant
-    with pytest.raises(AssertionError):
+    with pytest.raises(ValueError):
         verify_matrix(m)
 
 
