@@ -128,13 +128,7 @@ def multiply(
             old_keys = c.keys
             cand_keys = i * c.nblkcols + j
             if retain_sparsity:
-                if len(old_keys) == 0:
-                    ok = np.zeros(len(cand_keys), bool)
-                else:
-                    pos = np.searchsorted(old_keys, cand_keys)
-                    ok = (pos < len(old_keys)) & (
-                        old_keys[np.minimum(pos, len(old_keys) - 1)] == cand_keys
-                    )
+                ok = mask_in_sorted(cand_keys, old_keys)
                 i, j, a_ent, b_ent = i[ok], j[ok], a_ent[ok], b_ent[ok]
                 cand_keys = cand_keys[ok]
                 new_keys = old_keys
@@ -155,6 +149,17 @@ def multiply(
         mflops = 2 * c.nfullrows * c.nfullcols * a.nfullcols
         stats.record_multiply(mflops)
         return int(flops)
+
+
+def mask_in_sorted(cand_keys: np.ndarray, sorted_keys: np.ndarray) -> np.ndarray:
+    """Membership of each cand_key in sorted_keys (retain_sparsity's
+    pattern lock, shared by the single-chip and mesh engines)."""
+    if len(sorted_keys) == 0:
+        return np.zeros(len(cand_keys), bool)
+    pos = np.searchsorted(sorted_keys, cand_keys)
+    return (pos < len(sorted_keys)) & (
+        sorted_keys[np.minimum(pos, len(sorted_keys) - 1)] == cand_keys
+    )
 
 
 def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits) -> bool:

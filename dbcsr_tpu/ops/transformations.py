@@ -182,9 +182,13 @@ def redistribute(
     """Move a matrix onto a new distribution (ref `dbcsr_redistribute`,
     `dbcsr_transformations.F:1951`).
 
-    Single-program path: block data stays put on device, only the
-    distribution object changes; the multi-chip path reshards via the
-    parallel layer.
+    The returned copy carries ``dist``, which the distributed engine
+    honors when assembling device panels (`parallel/sparse_dist.py:
+    _resolve_maps`), so blocks genuinely land on different devices at
+    the next mesh operation.  In the single-controller model the host
+    index is global; the data movement happens at panel-assembly time
+    rather than eagerly (the reference, with per-rank memory, must move
+    immediately — `dbcsr_transformations.F:1951`).
     """
     if dist.nblkrows != matrix.nblkrows or dist.nblkcols != matrix.nblkcols:
         raise ValueError("distribution blocking mismatch")

@@ -79,6 +79,68 @@ def _vcol(k: np.ndarray, kl: int, s: int):
     return ImageDistribution(s, kl).split(k)
 
 
+def _grid_map(dist_arr: Optional[np.ndarray], n: int, naxis: int) -> np.ndarray:
+    """A block→grid-position map: the matrix's own distribution when it
+    fits the mesh axis, else cyclic decimation (the reference insists on
+    compatible distributions instead, `dbcsr_mm.F:585-590`; host-side
+    panel assembly lets us fall back gracefully)."""
+    if dist_arr is not None and len(dist_arr) == n and (
+        len(dist_arr) == 0
+        or (dist_arr.min(initial=0) >= 0 and dist_arr.max(initial=0) < naxis)
+    ):
+        return np.ascontiguousarray(dist_arr, np.int64)
+    return np.arange(n, dtype=np.int64) % naxis
+
+
+def _resolve_maps(a, b, matrix_c, s: int, kl: int):
+    """Block→process maps honoring the matrices' `Distribution` objects
+    (ref `dbcsr_distribution_new` row/col→proc arrays,
+    `dbcsr_dist_methods.F:49`).
+
+    Returns (rdist, cdist, k_layer, k_col) over block indices:
+    C-row → 'pr', C-col → 'pc', k-block → (2.5D layer, 'pc' image).
+    Priority: C's distribution, then A's rows / B's cols; the k axis
+    uses A's column map when it spans the grid axis (must equal B's row
+    map for a legal Cannon), falling back to cyclic images.
+    """
+    rdist = None
+    cdist = None
+    for cand_dist, attr, naxis in (
+        (matrix_c.dist if matrix_c is not None else None, "row_dist", s),
+        (a.dist, "row_dist", s),
+    ):
+        if cand_dist is not None and cand_dist.grid.nprows == naxis:
+            rdist = getattr(cand_dist, attr)
+            break
+    for cand_dist, attr in (
+        (matrix_c.dist if matrix_c is not None else None, "col_dist"),
+        (b.dist, "col_dist"),
+    ):
+        if cand_dist is not None and cand_dist.grid.npcols == s:
+            cdist = getattr(cand_dist, attr)
+            break
+    nbk = a.nblkcols
+    rdist = _grid_map(rdist, a.nblkrows, s)
+    cdist = _grid_map(cdist, b.nblkcols, s)
+
+    kdist = None
+    if a.dist.grid.npcols == s and len(a.dist.col_dist) == nbk:
+        kdist = a.dist.col_dist
+    elif b.dist.grid.nprows == s and len(b.dist.row_dist) == nbk:
+        kdist = b.dist.row_dist
+    if kdist is not None and (
+        len(kdist) == 0
+        or (kdist.min(initial=0) >= 0 and kdist.max(initial=0) < s)
+    ):
+        k_col = np.ascontiguousarray(kdist, np.int64)
+        # 2.5D layer: deterministic round-robin within each grid column
+        # (the image-multiplicity decimation generalized to arbitrary maps)
+        k_layer = _panel_slots(k_col) % kl
+    else:
+        k_layer, k_col = _vcol(np.arange(nbk, dtype=np.int64), kl, s)
+    return rdist, cdist, k_layer, k_col
+
+
 @functools.partial(
     jax.jit, static_argnames=("s", "cap_c", "acc_name", "mesh_ref"),
 )
@@ -148,6 +210,8 @@ def sparse_multiply_distributed(
     matrix_c: Optional[BlockSparseMatrix],
     mesh: Mesh,
     name: Optional[str] = None,
+    retain_sparsity: bool = False,
+    filter_eps: Optional[float] = None,
     first_row=None, last_row=None,
     first_col=None, last_col=None,
     first_k=None, last_k=None,
@@ -158,17 +222,23 @@ def sparse_multiply_distributed(
     `dbcsr_multiply_generic` driving `multiply_cannon`); device compute
     and inter-device traffic are fully sparse.  The optional block-index
     limits restrict the product exactly like `dbcsr_tpu.multiply`'s
-    (used by the TAS group loop).
+    (used by the TAS group loop).  ``filter_eps``/``retain_sparsity``
+    follow the single-chip engine's (= the reference's) semantics:
+    on-the-fly norm-product skip with per-A-row eps
+    (`dbcsr_mm_cannon.F:1098-1105`), final ||C||>=eps pass unless
+    retain_sparsity, which instead locks C's pattern.
     """
     with timed("sparse_cannon"):
         return _sparse_multiply_impl(
             alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
             (first_row, last_row, first_col, last_col, first_k, last_k),
+            retain_sparsity=retain_sparsity, filter_eps=filter_eps,
         )
 
 
 def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
-                          limits=(None,) * 6):
+                          limits=(None,) * 6, retain_sparsity=False,
+                          filter_eps=None):
     kl, s = mesh.shape["kl"], mesh.shape["pr"]
     if mesh.shape["pc"] != s:
         raise ValueError("sparse Cannon needs a square ('pr','pc') grid")
@@ -199,8 +269,18 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         name or f"{a.name}*{b.name}", a.row_blk_sizes, b.col_blk_sizes, dtype
     )
     rows_t, cols_t, a_ent, b_ent = _candidates(
-        a, b, shell_c, None, *limits
+        a, b, shell_c, filter_eps, *limits
     )
+    old_keys = matrix_c.keys if matrix_c is not None else np.empty(0, np.int64)
+    if retain_sparsity:
+        # product restricted to C's existing pattern (ref retain_sparsity,
+        # dbcsr_mm.F; shared masking helper with the single-chip engine)
+        from dbcsr_tpu.mm.multiply import mask_in_sorted
+
+        ok = mask_in_sorted(rows_t * shell_c.nblkcols + cols_t, old_keys)
+        rows_t, cols_t, a_ent, b_ent = (
+            rows_t[ok], cols_t[ok], a_ent[ok], b_ent[ok]
+        )
     k_of_a = (a.keys % a.nblkcols).astype(np.int64)
     k_t = k_of_a[a_ent]
     true_flops = int(
@@ -211,32 +291,37 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         )
     )
 
+    # ---- block→process maps (honor the matrices' distributions) ----
+    rdist, cdist, k_layer, k_col = _resolve_maps(a, b, matrix_c, s, kl)
+
     # ---- device/tick assignment ----
-    i_dev = rows_t % s
-    j_dev = cols_t % s
-    layer, kc = _vcol(k_t, kl, s)
+    i_dev = rdist[rows_t]
+    j_dev = cdist[cols_t]
+    layer, kc = k_layer[k_t], k_col[k_t]
     tick_t = (kc - i_dev - j_dev) % s
 
     # ---- panel ids + slots ----
     ar, ac = a.entry_coords()
-    a_layer, a_kc = _vcol(ac, kl, s)
-    a_panel = ((a_layer * s) + (ar % s)) * s + a_kc  # (l, i, kc)
+    a_layer, a_kc = k_layer[ac], k_col[ac]
+    a_panel = ((a_layer * s) + rdist[ar]) * s + a_kc  # (l, i, kc)
     a_slots = _panel_slots(a_panel)
     cap_a = max(int(np.bincount(a_panel, minlength=kl * s * s).max()), 1) if a.nblks else 1
 
     br, bc = b.entry_coords()
-    b_layer, b_kr = _vcol(br, kl, s)
-    b_panel = ((b_layer * s) + b_kr) * s + (bc % s)  # (l, kr, j)
+    b_layer, b_kr = k_layer[br], k_col[br]
+    b_panel = ((b_layer * s) + b_kr) * s + cdist[bc]  # (l, kr, j)
     b_slots = _panel_slots(b_panel)
     cap_b = max(int(np.bincount(b_panel, minlength=kl * s * s).max()), 1) if b.nblks else 1
 
-    # C pattern = old C pattern ∪ product pattern
-    prod_keys = np.unique(rows_t * shell_c.nblkcols + cols_t)
-    old_keys = matrix_c.keys if matrix_c is not None else np.empty(0, np.int64)
-    c_keys = np.union1d(old_keys, prod_keys)
+    # C pattern = old C pattern ∪ product pattern (old only, if retained)
+    if retain_sparsity:
+        c_keys = old_keys
+    else:
+        prod_keys = np.unique(rows_t * shell_c.nblkcols + cols_t)
+        c_keys = np.union1d(old_keys, prod_keys)
     c_rows = (c_keys // shell_c.nblkcols).astype(np.int64)
     c_cols = (c_keys % shell_c.nblkcols).astype(np.int64)
-    c_panel = (c_rows % s) * s + (c_cols % s)
+    c_panel = rdist[c_rows] * s + cdist[c_cols]
     c_slots = _panel_slots(c_panel)
     cap_c = max(int(np.bincount(c_panel, minlength=s * s).max()), 1) if len(c_keys) else 1
 
@@ -277,7 +362,7 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     if matrix_c is not None and matrix_c.nblks and beta != 0:
         c_host = _dense_blocks_host(matrix_c, bm, bn)
         pos_old = np.searchsorted(c_keys, old_keys)
-        c_init[c_rows[pos_old] % s, c_cols[pos_old] % s, c_slots[pos_old]] = c_host
+        c_init[rdist[c_rows[pos_old]], cdist[c_cols[pos_old]], c_slots[pos_old]] = c_host
 
     # ---- run on the mesh ----
     dev = lambda arr, spec: jax.device_put(arr, NamedSharding(mesh, spec))
@@ -295,18 +380,36 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     )
 
     # ---- collect back into a host-indexed matrix ----
+    from dbcsr_tpu.core.dist import Distribution, ProcessGrid
+
     c_np = np.asarray(c_out)
+    out_dist = (
+        matrix_c.dist
+        if matrix_c is not None and matrix_c.dist.grid.nprows == s
+        and matrix_c.dist.grid.npcols == s
+        else Distribution(
+            rdist.astype(np.int32), cdist.astype(np.int32),
+            ProcessGrid(s, s, mesh),
+        )
+    )
     out = BlockSparseMatrix(
         name or (matrix_c.name if matrix_c is not None else f"{a.name}*{b.name}"),
         a.row_blk_sizes, b.col_blk_sizes, dtype,
-        dist=matrix_c.dist if matrix_c is not None else None,
+        dist=out_dist,
     )
     rbs, cbs = out.row_blk_sizes, out.col_blk_sizes
     for e in range(len(c_keys)):
         r, c = int(c_rows[e]), int(c_cols[e])
-        blk = c_np[r % s, c % s, c_slots[e], : rbs[r], : cbs[c]]
+        blk = c_np[rdist[r], cdist[c], c_slots[e], : rbs[r], : cbs[c]]
         out.put_block(r, c, blk)
     out.finalize()
+    if filter_eps is not None and not retain_sparsity:
+        # final ||C|| >= eps pass (ref multrec_filtering,
+        # dbcsr_mm_multrec.F:694-748) — shared criterion with the
+        # single-chip engine so filtered patterns agree exactly
+        from dbcsr_tpu.ops.operations import filter_matrix
+
+        filter_matrix(out, filter_eps)
     from dbcsr_tpu.core import stats
 
     stats.record_stack(bm, bn, bk, len(rows_t))
@@ -337,13 +440,20 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
 
 
 class _HashableMesh:
-    """Static jit argument wrapper (Mesh identity keyed)."""
+    """Static jit argument wrapper, keyed by mesh structure (axis
+    names/sizes + device ids) so recreating an identical mesh reuses the
+    compiled program and a recycled object id can never alias."""
 
     def __init__(self, mesh):
         self.val = mesh
+        self._key = (
+            tuple(mesh.axis_names),
+            tuple(int(x) for x in np.asarray(mesh.devices.shape)),
+            tuple(d.id for d in mesh.devices.flat),
+        )
 
     def __hash__(self):
-        return hash(id(self.val))
+        return hash(self._key)
 
     def __eq__(self, other):
-        return isinstance(other, _HashableMesh) and other.val is self.val
+        return isinstance(other, _HashableMesh) and other._key == self._key

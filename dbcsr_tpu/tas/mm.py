@@ -144,7 +144,8 @@ def _tas_multiply_mesh(transa, transb, alpha, a, b, beta, c, filter_eps,
         return new_transposed(m, conjugate=(t == "C" and is_complex(m.dtype)))
 
     acc = sparse_multiply_distributed(
-        alpha, _op(a, transa), _op(b, transb), beta, c, mesh, name=c.name
+        alpha, _op(a, transa), _op(b, transb), beta, c, mesh, name=c.name,
+        filter_eps=filter_eps,
     )
     flops = getattr(acc, "_last_flops", 0)
     # adopt the result structure into the caller's C object, preserving
@@ -155,6 +156,4 @@ def _tas_multiply_mesh(transa, transb, alpha, a, b, beta, c, filter_eps,
         setattr(c, field, getattr(acc, field))
     c.matrix_type = NO_SYMMETRY
     c._work.clear()
-    if filter_eps is not None:
-        filter_matrix(c, filter_eps)
     return flops

@@ -138,3 +138,74 @@ def test_comm_statistics_recorded(mesh8):
     joined = "\n".join(lines)
     assert "ppermute" in joined and "host2dev" in joined
     stats.reset()
+
+
+def test_sparse_cannon_honors_distribution(mesh8):
+    """Checksum invariance across 3 different Distributions of the same
+    matrices (ref `dbcsr_distribution_new` arbitrary maps,
+    `dbcsr_dist_methods.F:49`)."""
+    from dbcsr_tpu.core.dist import Distribution, ProcessGrid, dist_bin, random_dist
+    from dbcsr_tpu.ops.transformations import redistribute
+
+    s = mesh8.shape["pr"]
+    rbs = list(np.random.default_rng(0).choice([3, 5], 12))
+    a = _rand("A", rbs, rbs, 0.4, 20)
+    b = _rand("B", rbs, rbs, 0.4, 21)
+    want = to_dense(a) @ to_dense(b)
+
+    grid = ProcessGrid(s, s, mesh8)
+    n = len(rbs)
+    dists = [
+        None,  # default cyclic
+        Distribution(random_dist(n, s, seed=1), random_dist(n, s, seed=2), grid),
+        Distribution(
+            dist_bin(n, s, element_sizes=np.asarray(rbs)),
+            dist_bin(n, s, element_sizes=np.asarray(rbs)[::-1].copy()),
+            grid,
+        ),
+    ]
+    sums = []
+    for d in dists:
+        ad = redistribute(a, d) if d is not None else a
+        bd = redistribute(b, d) if d is not None else b
+        c = sparse_multiply_distributed(1.0, ad, bd, 0.0, None, mesh8)
+        np.testing.assert_allclose(to_dense(c), want, rtol=1e-12, atol=1e-12)
+        sums.append(checksum(c))
+    assert sums[0] == sums[1] == sums[2]
+
+
+def test_sparse_cannon_filter_eps_matches_single_chip(mesh8):
+    from dbcsr_tpu import multiply
+
+    rbs = [4] * 12
+    a = _rand("A", rbs, rbs, 0.5, 22)
+    b = _rand("B", rbs, rbs, 0.5, 23)
+    eps = 2.0  # aggressive: actually drops blocks
+    c_mesh = sparse_multiply_distributed(
+        1.0, a, b, 0.0, None, mesh8, filter_eps=eps
+    )
+    c_host = _rand("C", rbs, rbs, 0.0, 24)
+    multiply("N", "N", 1.0, a, b, 0.0, c_host, filter_eps=eps)
+    assert len(c_mesh.keys) < 12 * 12  # filtering did something
+    np.testing.assert_array_equal(c_mesh.keys, c_host.keys)
+    np.testing.assert_allclose(
+        to_dense(c_mesh), to_dense(c_host), rtol=1e-12, atol=1e-12
+    )
+
+
+def test_sparse_cannon_retain_sparsity_matches_single_chip(mesh8):
+    from dbcsr_tpu import multiply
+
+    rbs = [4] * 10
+    a = _rand("A", rbs, rbs, 0.5, 25)
+    b = _rand("B", rbs, rbs, 0.5, 26)
+    c0 = _rand("C", rbs, rbs, 0.25, 27)
+    c_mesh = sparse_multiply_distributed(
+        1.0, a, b, 0.5, c0, mesh8, retain_sparsity=True
+    )
+    c_host = c0.copy()
+    multiply("N", "N", 1.0, a, b, 0.5, c_host, retain_sparsity=True)
+    np.testing.assert_array_equal(c_mesh.keys, c_host.keys)
+    np.testing.assert_allclose(
+        to_dense(c_mesh), to_dense(c_host), rtol=1e-12, atol=1e-12
+    )
