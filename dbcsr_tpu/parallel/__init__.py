@@ -23,7 +23,10 @@ from dbcsr_tpu.parallel.dist_matrix import (
     multiply_distributed,
     replicate,
 )
-from dbcsr_tpu.parallel.sparse_dist import sparse_multiply_distributed
+from dbcsr_tpu.parallel.sparse_dist import (
+    sparse_multiply_distributed,
+    tas_grouped_multiply,
+)
 from dbcsr_tpu.parallel.images import ImageDistribution, make_image_dist
 from dbcsr_tpu.parallel.multihost import (
     init_multihost,

@@ -69,6 +69,88 @@ def _panel_slots(panel_ids: np.ndarray) -> np.ndarray:
     return slots
 
 
+def _prepare_operands(matrix_a, matrix_b, matrix_c):
+    """Shared multiply prologue: desymmetrize, finalize, compatibility
+    guards.  Returns (a, b, matrix_c, dtype, bm, bk, bn)."""
+    a = desymmetrize(matrix_a) if matrix_a.matrix_type != NO_SYMMETRY else matrix_a
+    b = desymmetrize(matrix_b) if matrix_b.matrix_type != NO_SYMMETRY else matrix_b
+    for m in (a, b, matrix_c):
+        if m is not None and not m.valid:
+            m.finalize()
+    if matrix_c is not None and matrix_c.matrix_type != NO_SYMMETRY:
+        matrix_c = desymmetrize(matrix_c)
+    if not np.array_equal(a.col_blk_sizes, b.row_blk_sizes):
+        raise ValueError("inner blockings differ")
+    if matrix_c is not None and not (
+        np.array_equal(matrix_c.row_blk_sizes, a.row_blk_sizes)
+        and np.array_equal(matrix_c.col_blk_sizes, b.col_blk_sizes)
+    ):
+        raise ValueError("C blocking incompatible with op(A), op(B)")
+    dtype = np.dtype(matrix_c.dtype) if matrix_c is not None else np.dtype(a.dtype)
+    bm = int(a.row_blk_sizes.max()) if a.nblkrows else 1
+    bk = int(a.col_blk_sizes.max()) if a.nblkcols else 1
+    bn = int(b.col_blk_sizes.max()) if b.nblkcols else 1
+    return a, b, matrix_c, dtype, bm, bk, bn
+
+
+def _fill_stacks(group_id, st_a, st_b, st_c, nslots, cap_c):
+    """Sort stack entries by (slot-group, C slot, A slot) and scatter
+    into a (nslots, s_cap, 3) array whose padding rows target the
+    dropped segment cap_c.  Shared by the ungrouped and grouped Cannon
+    assemblies (the host-side analog of `dbcsr_mm_accdrv.F:364-423`
+    stack sort/binning)."""
+    order = np.lexsort((st_a, st_c, group_id))
+    group_id, st_a, st_b, st_c = (
+        group_id[order], st_a[order], st_b[order], st_c[order]
+    )
+    counts = np.bincount(group_id, minlength=nslots)
+    s_cap = bucket_size(max(int(counts.max()), 1) if len(counts) else 1)
+    stacks = np.zeros((nslots, s_cap, 3), np.int32)
+    stacks[:, :, 2] = cap_c
+    pos = np.arange(len(group_id)) - np.repeat(
+        np.concatenate([[0], np.cumsum(counts)])[:-1], counts
+    )
+    stacks[group_id, pos, 0] = st_a
+    stacks[group_id, pos, 1] = st_b
+    stacks[group_id, pos, 2] = st_c
+    return stacks
+
+
+def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype):
+    """The shared Cannon metronome: s ticks of gather → batched matmul →
+    sorted segment-sum, ring-shifting A along 'pc' and B along 'pr'
+    (ref the grouped_k_index loop, `dbcsr_mm_cannon.F:1345`)."""
+    bm, bn = a.shape[1], b.shape[2]
+    from dbcsr_tpu.parallel.cannon import mark_varying
+
+    c = jnp.zeros((cap_c, bm, bn), acc_dtype)
+    c = mark_varying(c, ("kl", "pr", "pc"))
+
+    def tick(t, carry):
+        a, b, c = carry
+        entries = st[t]
+        pa = jnp.take(a, entries[:, 0], axis=0)
+        pb = jnp.take(b, entries[:, 1], axis=0)
+        prod = jax.lax.dot_general(
+            pa, pb, (((2,), (1,)), ((0,), (0,))),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=acc_dtype,
+        )
+        c = c + jax.ops.segment_sum(
+            prod, entries[:, 2], num_segments=cap_c,
+            indices_are_sorted=True,
+        )
+        if s > 1:
+            shift_a = tuple(((j + 1) % s, j) for j in range(s))
+            shift_b = tuple(((i + 1) % s, i) for i in range(s))
+            a = jax.lax.ppermute(a, ("pc",), shift_a)
+            b = jax.lax.ppermute(b, ("pr",), shift_b)
+        return a, b, c
+
+    _, _, c = jax.lax.fori_loop(0, s, tick, (a, b, c))
+    return c
+
+
 def _vcol(k: np.ndarray, kl: int, s: int):
     """k block -> (layer, panel column): the k axis is an image
     distribution of multiplicity kl over the s physical columns
@@ -154,34 +236,7 @@ def _run_sparse_cannon(a_panels, b_panels, stacks, c_init, alpha, beta,
         b = b_p.reshape(b_p.shape[3:])
         st = st.reshape(st.shape[3:])  # (s, s_cap, 3)
         c_in = c_in.reshape(c_in.shape[2:])  # (cap_c, bm, bn)
-        bm, bn = a.shape[1], b.shape[2]
-        c = jnp.zeros((cap_c, bm, bn), acc_dtype)
-        from dbcsr_tpu.parallel.cannon import mark_varying
-
-        c = mark_varying(c, ("kl", "pr", "pc"))
-
-        def tick(t, carry):
-            a, b, c = carry
-            entries = st[t]
-            pa = jnp.take(a, entries[:, 0], axis=0)
-            pb = jnp.take(b, entries[:, 1], axis=0)
-            prod = jax.lax.dot_general(
-                pa, pb, (((2,), (1,)), ((0,), (0,))),
-                precision=jax.lax.Precision.HIGHEST,
-                preferred_element_type=acc_dtype,
-            )
-            c = c + jax.ops.segment_sum(
-                prod, entries[:, 2], num_segments=cap_c,
-                indices_are_sorted=True,
-            )
-            if s > 1:
-                shift_a = tuple(((j + 1) % s, j) for j in range(s))
-                shift_b = tuple(((i + 1) % s, i) for i in range(s))
-                a = jax.lax.ppermute(a, ("pc",), shift_a)
-                b = jax.lax.ppermute(b, ("pr",), shift_b)
-            return a, b, c
-
-        _, _, c = jax.lax.fori_loop(0, s, tick, (a, b, c))
+        c = _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype)
         c = jax.lax.psum(c, "kl")
         c = (alpha * c + beta * c_in.astype(acc_dtype)).astype(c_in.dtype)
         return c.reshape((1, 1) + c.shape)
@@ -242,25 +297,10 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     kl, s = mesh.shape["kl"], mesh.shape["pr"]
     if mesh.shape["pc"] != s:
         raise ValueError("sparse Cannon needs a square ('pr','pc') grid")
-    a = desymmetrize(matrix_a) if matrix_a.matrix_type != NO_SYMMETRY else matrix_a
-    b = desymmetrize(matrix_b) if matrix_b.matrix_type != NO_SYMMETRY else matrix_b
-    for m in (a, b, matrix_c):
-        if m is not None and not m.valid:
-            m.finalize()
-    if matrix_c is not None and matrix_c.matrix_type != NO_SYMMETRY:
-        matrix_c = desymmetrize(matrix_c)
-    if not np.array_equal(a.col_blk_sizes, b.row_blk_sizes):
-        raise ValueError("inner blockings differ")
-    if matrix_c is not None and not (
-        np.array_equal(matrix_c.row_blk_sizes, a.row_blk_sizes)
-        and np.array_equal(matrix_c.col_blk_sizes, b.col_blk_sizes)
-    ):
-        raise ValueError("C blocking incompatible with op(A), op(B)")
     # accumulate in C's dtype when C is given (host-path convention)
-    dtype = np.dtype(matrix_c.dtype) if matrix_c is not None else np.dtype(a.dtype)
-    bm = int(a.row_blk_sizes.max()) if a.nblkrows else 1
-    bk = int(a.col_blk_sizes.max()) if a.nblkcols else 1
-    bn = int(b.col_blk_sizes.max()) if b.nblkcols else 1
+    a, b, matrix_c, dtype, bm, bk, bn = _prepare_operands(
+        matrix_a, matrix_b, matrix_c
+    )
 
     # ---- symbolic product on host (ref dbcsr_mm_csr.F C-index build) ----
     from dbcsr_tpu.mm.multiply import _candidates
@@ -327,23 +367,12 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
 
     # ---- per-(device, tick) stacks ----
     ent_c = np.searchsorted(c_keys, rows_t * shell_c.nblkcols + cols_t)
-    st_a = a_slots[a_ent]
-    st_b = b_slots[b_ent]
-    st_c = c_slots[ent_c]
     group = (((layer * s + i_dev) * s + j_dev) * s) + tick_t
-    order = np.lexsort((st_a, st_c, group))
-    group, st_a, st_b, st_c = group[order], st_a[order], st_b[order], st_c[order]
-    counts = np.bincount(group, minlength=kl * s * s * s)
-    s_cap = bucket_size(max(int(counts.max()), 1))
-    stacks = np.zeros((kl * s * s * s, s_cap, 3), np.int32)
-    stacks[:, :, 2] = cap_c  # pad entries target the dropped segment
-    pos = np.arange(len(group)) - np.repeat(
-        np.concatenate([[0], np.cumsum(counts)])[:-1], counts
+    stacks = _fill_stacks(
+        group, a_slots[a_ent], b_slots[b_ent], c_slots[ent_c],
+        kl * s * s * s, cap_c,
     )
-    stacks[group, pos, 0] = st_a
-    stacks[group, pos, 1] = st_b
-    stacks[group, pos, 2] = st_c
-    stacks = stacks.reshape(kl, s, s, s, s_cap, 3)
+    stacks = stacks.reshape(kl, s, s, s, -1, 3)
 
     # ---- panel data, placed at the skewed start position ----
     a_host = _dense_blocks_host(a, bm, bk)
@@ -436,6 +465,238 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         a_panels.nbytes + b_panels.nbytes + stacks.nbytes + c_init.nbytes,
     )
     out._last_flops = true_flops  # true flop count of this product
+    return out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("s", "cap_c", "acc_name", "mesh_ref"),
+)
+def _run_grouped_cannon(a_panels, b_panels, stacks, c_init, alpha, beta,
+                        *, s, cap_c, acc_name, mesh_ref):
+    """nsplit independent Cannon multiplies, one per 'kl' group, in a
+    single SPMD program.  The short matrix (B) arrives replicated over
+    'kl' (spec without the axis) — the `dbcsr_tas_replicate` analog —
+    and groups write disjoint C slices, so there is no end reduction
+    (the reference's `redistribute_and_sum`, `dbcsr_tas_mm.F:783`,
+    becomes a pure collect)."""
+    mesh = mesh_ref.val
+    acc_dtype = jnp.dtype(acc_name)
+
+    def body(a_p, b_p, st, c_in, alpha, beta):
+        a = a_p.reshape(a_p.shape[3:])  # (cap_a, bm, bk)
+        b = b_p.reshape(b_p.shape[2:])  # (cap_b, bk, bn), replicated on kl
+        st = st.reshape(st.shape[3:])  # (s, s_cap, 3)
+        c_in = c_in.reshape(c_in.shape[3:])  # (cap_c, bm, bn)
+        from dbcsr_tpu.parallel.cannon import mark_varying
+
+        b = mark_varying(b, ("kl",))
+        c = _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype)
+        c = (alpha * c + beta * c_in.astype(acc_dtype)).astype(c_in.dtype)
+        return c.reshape((1, 1, 1) + c.shape)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P("kl", "pr", "pc"),
+            P("pr", "pc"),
+            P("kl", "pr", "pc"),
+            P("kl", "pr", "pc"),
+            P(),
+            P(),
+        ),
+        out_specs=P("kl", "pr", "pc"),
+    )
+    return fn(a_panels, b_panels, stacks, c_init, alpha, beta)
+
+
+def _balanced_groups(weights: np.ndarray, ngroups: int) -> np.ndarray:
+    """Contiguous partition of a block axis into ngroups with ~equal
+    total weight (the reference splits the long dimension contiguously
+    over process groups, `dbcsr_tas_split.F:66-304`)."""
+    n = len(weights)
+    if n == 0:
+        return np.empty(0, np.int64)
+    cum = np.cumsum(weights.astype(np.float64))
+    total = cum[-1] if cum[-1] > 0 else 1.0
+    # group boundary: first index whose cumulative share passes g/ngroups
+    frac = (cum - weights / 2) / total
+    groups = np.minimum((frac * ngroups).astype(np.int64), ngroups - 1)
+    return np.maximum.accumulate(groups)  # enforce monotone (contiguity)
+
+
+def tas_grouped_multiply(
+    alpha,
+    matrix_a: BlockSparseMatrix,
+    matrix_b: BlockSparseMatrix,
+    beta,
+    matrix_c: Optional[BlockSparseMatrix],
+    mesh: Mesh,
+    name: Optional[str] = None,
+    filter_eps: Optional[float] = None,
+) -> BlockSparseMatrix:
+    """Group-parallel tall-and-skinny multiply: C's (long) row dimension
+    is partitioned over the mesh's 'kl' axis into nsplit = kl groups,
+    each group runs an independent s x s sparse Cannon concurrently, and
+    the small matrix B is replicated into every group.
+
+    The TPU-native re-design of `dbcsr_tas_multiply`'s grid split
+    (`dbcsr_tas_mm.F:79-806`, `dbcsr_tas_split.F:304`): the reference
+    splits its MPI grid into row groups, replicates the small matrix
+    per group (`dbcsr_tas_replicate`) and merges with
+    `redistribute_and_sum` (:783); here the 'kl' mesh axis IS the group
+    axis, replication is an unsharded in_spec, and since row groups are
+    disjoint the merge is a pure collect.  A column-long C is handled
+    by the caller via transposition (C^T row-grouped).
+    """
+    with timed("tas_grouped_cannon"):
+        return _tas_grouped_impl(
+            alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name, filter_eps
+        )
+
+
+def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
+                      filter_eps):
+    g, s = mesh.shape["kl"], mesh.shape["pr"]
+    if mesh.shape["pc"] != s:
+        raise ValueError("grouped Cannon needs a square ('pr','pc') grid")
+    a, b, matrix_c, dtype, bm, bk, bn = _prepare_operands(
+        matrix_a, matrix_b, matrix_c
+    )
+
+    from dbcsr_tpu.mm.multiply import _candidates
+
+    shell_c = matrix_c if matrix_c is not None else BlockSparseMatrix(
+        name or f"{a.name}*{b.name}", a.row_blk_sizes, b.col_blk_sizes, dtype
+    )
+    rows_t, cols_t, a_ent, b_ent = _candidates(a, b, shell_c, filter_eps,
+                                               *(None,) * 6)
+    k_of_a = (a.keys % a.nblkcols).astype(np.int64)
+    k_t = k_of_a[a_ent]
+    true_flops = int(
+        2 * np.sum(
+            a.row_blk_sizes[rows_t].astype(np.int64)
+            * b.col_blk_sizes[cols_t]
+            * a.col_blk_sizes[k_t]
+        )
+    )
+
+    # ---- group + in-group maps ----
+    # balance groups by actual per-row work (candidate count), the
+    # analog of the reference's nnz-driven split estimation (:1427)
+    row_work = np.bincount(rows_t, minlength=a.nblkrows).astype(np.float64) + 1.0
+    row_group = _balanced_groups(row_work, g)
+    rdist_in = _panel_slots(row_group) % s  # round-robin rows within a group
+    cdist = np.arange(b.nblkcols, dtype=np.int64) % s
+    k_col = np.arange(a.nblkcols, dtype=np.int64) % s  # no k images: one layer
+
+    i_dev = rdist_in[rows_t]
+    j_dev = cdist[cols_t]
+    grp = row_group[rows_t]
+    kc = k_col[k_t]
+    tick_t = (kc - i_dev - j_dev) % s
+
+    # ---- panels ----
+    ar, ac = a.entry_coords()
+    a_panel = (row_group[ar] * s + rdist_in[ar]) * s + k_col[ac]  # (grp, i, kc)
+    a_slots = _panel_slots(a_panel)
+    cap_a = max(int(np.bincount(a_panel, minlength=g * s * s).max()), 1) if a.nblks else 1
+
+    br, bc = b.entry_coords()
+    b_panel = k_col[br] * s + cdist[bc]  # (kr, j) — replicated over groups
+    b_slots = _panel_slots(b_panel)
+    cap_b = max(int(np.bincount(b_panel, minlength=s * s).max()), 1) if b.nblks else 1
+
+    old_keys = matrix_c.keys if matrix_c is not None else np.empty(0, np.int64)
+    prod_keys = np.unique(rows_t * shell_c.nblkcols + cols_t)
+    c_keys = np.union1d(old_keys, prod_keys)
+    c_rows = (c_keys // shell_c.nblkcols).astype(np.int64)
+    c_cols = (c_keys % shell_c.nblkcols).astype(np.int64)
+    c_panel = (row_group[c_rows] * s + rdist_in[c_rows]) * s + cdist[c_cols]
+    c_slots = _panel_slots(c_panel)
+    cap_c = max(int(np.bincount(c_panel, minlength=g * s * s).max()), 1) if len(c_keys) else 1
+
+    # ---- per-(group, device, tick) stacks ----
+    ent_c = np.searchsorted(c_keys, rows_t * shell_c.nblkcols + cols_t)
+    group_id = (((grp * s + i_dev) * s + j_dev) * s) + tick_t
+    stacks = _fill_stacks(
+        group_id, a_slots[a_ent], b_slots[b_ent], c_slots[ent_c],
+        g * s * s * s, cap_c,
+    )
+    stacks = stacks.reshape(g, s, s, s, -1, 3)
+
+    # ---- panel data at skewed start positions ----
+    a_host = _dense_blocks_host(a, bm, bk)
+    a_panels = np.zeros((g, s, s, cap_a, bm, bk), dtype)
+    agr, ai_, akc = a_panel // (s * s), (a_panel // s) % s, a_panel % s
+    aj0 = (akc - ai_) % s
+    a_panels[agr, ai_, aj0, a_slots] = a_host
+
+    b_host = _dense_blocks_host(b, bk, bn)
+    b_panels = np.zeros((s, s, cap_b, bk, bn), dtype)
+    bkr, bj = b_panel // s, b_panel % s
+    bi0 = (bkr - bj) % s
+    b_panels[bi0, bj, b_slots] = b_host
+
+    c_init = np.zeros((g, s, s, cap_c, bm, bn), dtype)
+    if matrix_c is not None and matrix_c.nblks and beta != 0:
+        c_host = _dense_blocks_host(matrix_c, bm, bn)
+        pos_old = np.searchsorted(c_keys, old_keys)
+        c_init[
+            row_group[c_rows[pos_old]], rdist_in[c_rows[pos_old]],
+            cdist[c_cols[pos_old]], c_slots[pos_old],
+        ] = c_host
+
+    dev = lambda arr, spec: jax.device_put(arr, NamedSharding(mesh, spec))
+    acc_name = "float32" if dtype.name == "bfloat16" else dtype.name
+    c_out = _run_grouped_cannon(
+        dev(a_panels, P("kl", "pr", "pc")),
+        dev(b_panels, P("pr", "pc")),
+        dev(stacks, P("kl", "pr", "pc")),
+        dev(c_init, P("kl", "pr", "pc")),
+        jnp.asarray(alpha, dtype), jnp.asarray(beta, dtype),
+        s=s, cap_c=cap_c, acc_name=acc_name,
+        mesh_ref=_HashableMesh(mesh),
+    )
+
+    # ---- collect (groups disjoint: no reduction) ----
+    c_np = np.asarray(c_out)
+    out = BlockSparseMatrix(
+        name or (matrix_c.name if matrix_c is not None else f"{a.name}*{b.name}"),
+        a.row_blk_sizes, b.col_blk_sizes, dtype,
+        dist=matrix_c.dist if matrix_c is not None else None,
+    )
+    rbs, cbs = out.row_blk_sizes, out.col_blk_sizes
+    for e in range(len(c_keys)):
+        r, c = int(c_rows[e]), int(c_cols[e])
+        blk = c_np[row_group[r], rdist_in[r], cdist[c], c_slots[e], : rbs[r], : cbs[c]]
+        out.put_block(r, c, blk)
+    out.finalize()
+    if filter_eps is not None:
+        from dbcsr_tpu.ops.operations import filter_matrix
+
+        filter_matrix(out, filter_eps)
+
+    from dbcsr_tpu.core import stats
+
+    stats.record_stack(bm, bn, bk, len(rows_t))
+    stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
+    ndev = g * s * s
+    itemsize = dtype.itemsize
+    if s > 1:
+        # per-group panels: cap_a is the per-group maximum, cap_b the
+        # replicated short matrix — the traffic the group split saves
+        # shows up directly in these counters (vs the ungrouped psum of
+        # the long C, sparse_multiply_distributed's 'psum' record)
+        stats.record_comm(
+            "ppermute", 2 * s * ndev,
+            s * ndev * (cap_a * bm * bk + cap_b * bk * bn) * itemsize,
+        )
+    stats.record_comm(
+        "host2dev", 4,
+        a_panels.nbytes + b_panels.nbytes + stacks.nbytes + c_init.nbytes,
+    )
+    out._last_flops = true_flops
     return out
 
 

@@ -122,20 +122,22 @@ def tas_multiply(
 
 def _tas_multiply_mesh(transa, transb, alpha, a, b, beta, c, filter_eps,
                        nsplit, long_dim, nblk_k, mesh) -> int:
-    """One distributed sparse Cannon multiply.
+    """Distributed TAS multiply with real group parallelism.
 
-    On the single-controller mesh path the host-side TAS group loop
-    would only repeat full panel assembly + upload per group, so the
-    split collapses to nsplit=1 here: the mesh's 'kl' layer axis
-    already partitions the k space across process groups — the role
-    `dbcsr_tas_split.F:304` gives its grid subgroups — and the
-    symbolic-product limits remain available to callers that chunk
-    explicitly (batched contraction bounds)."""
+    m- or n-long products run `tas_grouped_multiply`: the 'kl' mesh
+    axis carries nsplit concurrent per-group Cannons with the short
+    matrix replicated into each group (ref `dbcsr_tas_mm.F:79-806`,
+    `dbcsr_tas_split.F:304`); a column-long C is handled as C^T with
+    row groups.  k-long products use the engine's 'kl' k-image layers +
+    psum (`sparse_multiply_distributed`), which is the same grid split
+    applied to the contraction dimension (`dbcsr_mm_3d.F:1037`)."""
     from dbcsr_tpu.core.kinds import is_complex
     from dbcsr_tpu.core.matrix import NO_SYMMETRY
-    from dbcsr_tpu.ops.operations import filter_matrix
     from dbcsr_tpu.ops.transformations import new_transposed
-    from dbcsr_tpu.parallel.sparse_dist import sparse_multiply_distributed
+    from dbcsr_tpu.parallel.sparse_dist import (
+        sparse_multiply_distributed,
+        tas_grouped_multiply,
+    )
 
     def _op(m, trans):
         t = trans.upper()
@@ -143,10 +145,28 @@ def _tas_multiply_mesh(transa, transb, alpha, a, b, beta, c, filter_eps,
             return m
         return new_transposed(m, conjugate=(t == "C" and is_complex(m.dtype)))
 
-    acc = sparse_multiply_distributed(
-        alpha, _op(a, transa), _op(b, transb), beta, c, mesh, name=c.name,
-        filter_eps=filter_eps,
-    )
+    a_op, b_op = _op(a, transa), _op(b, transb)
+    grouped = nsplit > 1 and mesh.shape["kl"] > 1 and long_dim in ("m", "n")
+    if grouped and long_dim == "m":
+        acc = tas_grouped_multiply(
+            alpha, a_op, b_op, beta, c, mesh, name=c.name,
+            filter_eps=filter_eps,
+        )
+    elif grouped:
+        # column-long C: C^T = op(B)^T op(A)^T is row-long, group its rows
+        acc_t = tas_grouped_multiply(
+            alpha, new_transposed(b_op), new_transposed(a_op), beta,
+            new_transposed(c), mesh, name=c.name + "^T",
+            filter_eps=filter_eps,
+        )
+        flops_t = getattr(acc_t, "_last_flops", 0)
+        acc = new_transposed(acc_t)
+        acc._last_flops = flops_t
+    else:
+        acc = sparse_multiply_distributed(
+            alpha, a_op, b_op, beta, c, mesh, name=c.name,
+            filter_eps=filter_eps,
+        )
     flops = getattr(acc, "_last_flops", 0)
     # adopt the result structure into the caller's C object, preserving
     # its Distribution and dtype; the product is plain (the sparse path
