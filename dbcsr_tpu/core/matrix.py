@@ -24,6 +24,7 @@ lower-triangle writes onto the stored transpose.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
@@ -75,6 +76,21 @@ def _rezero_pad_rows(data, count):
     return jnp.where(mask, data, jnp.zeros_like(data))
 
 
+@jax.jit
+def _migrate_blocks(dst, src, src_slots, dst_slots):
+    """Device-to-device move of surviving blocks into a rebuilt bin —
+    the no-host-round-trip half of `dbcsr_merge_all`
+    (`dbcsr_work_operations.F:1393`)."""
+    return dst.at[dst_slots].set(jnp.take(src, src_slots, axis=0), mode="drop")
+
+
+@functools.partial(jax.jit, static_argnames=("add",))
+def _scatter_staged(dst, blocks, slots, add: bool):
+    if add:
+        return dst.at[slots].add(blocks, mode="drop")
+    return dst.at[slots].set(blocks, mode="drop")
+
+
 class BlockSparseMatrix:
     """A distributed block-compressed sparse row matrix."""
 
@@ -115,6 +131,8 @@ class BlockSparseMatrix:
         self.valid = True
         # pre-finalize work buffer: (row, col) -> host block
         self._work: Dict[Tuple[int, int], np.ndarray] = {}
+        # batched staging: (keys int64, blocks (N, bm, bn), summation)
+        self._work_batches: List[Tuple[np.ndarray, np.ndarray, bool]] = []
 
     # ---------------------------------------------------------------- shape
     @property
@@ -188,6 +206,93 @@ class BlockSparseMatrix:
             self._work[key] = block
         self.valid = False
 
+    def put_blocks(self, rows, cols, blocks, summation: bool = False) -> None:
+        """Stage many blocks at once — the vectorized assembly path
+        (array-of-blocks analog of the reference's work matrices,
+        `dbcsr_work_operations.F:674`; merged by `finalize` without a
+        host round-trip of existing device data).
+
+        ``blocks`` is an (N, bm, bn) array (uniform shape) or a list of
+        2-D arrays; the data is snapshotted (caller may reuse buffers).
+        Staged batches become visible at `finalize`; they are applied
+        after any single `put_block` stagings, in call order, with
+        ``summation=True`` batches adding to whatever value the block
+        has at merge time.  Duplicates within one call are pre-reduced:
+        summed when ``summation``, last-write-wins otherwise.
+        """
+        self._work_batches.extend(
+            self._make_batches(rows, cols, blocks, summation)
+        )
+        self.valid = False
+
+    def _make_batches(self, rows, cols, blocks, summation: bool):
+        """Canonicalize (symmetry fold), validate, group by block shape,
+        and pre-reduce duplicates; returns [(keys, (N,bm,bn) array,
+        summation)] staging batches."""
+        rows = np.ascontiguousarray(rows, np.int64)
+        cols = np.ascontiguousarray(cols, np.int64)
+        if len(rows) != len(cols):
+            raise ValueError("rows/cols length mismatch")
+        if len(rows) == 0:
+            return []
+        if rows.min() < 0 or rows.max() >= self.nblkrows or cols.min() < 0 or (
+            cols.max() >= self.nblkcols
+        ):
+            raise IndexError("block coordinates out of range")
+        uniform = isinstance(blocks, np.ndarray) and blocks.ndim == 3
+        if not uniform and len(blocks) != len(rows):
+            raise ValueError("blocks length mismatch")
+        # canonicalize BEFORE grouping: folding transposes blocks, which
+        # changes their shape group for rectangular off-diagonal blocks
+        if self.matrix_type != NO_SYMMETRY:
+            fold = rows > cols
+            if fold.any():
+                blocks = [
+                    _fold_block(np.asarray(blocks[i]), self.matrix_type)
+                    if fold[i] else np.asarray(blocks[i])
+                    for i in range(len(rows))
+                ]
+                uniform = False
+                rows, cols = np.where(fold, cols, rows), np.where(fold, rows, cols)
+        if uniform:
+            groups = [(np.arange(len(rows)), np.array(blocks, dtype=self.dtype))]
+        else:
+            shapes = np.array([np.asarray(b).shape for b in blocks], np.int64)
+            code = shapes[:, 0] << 32 | shapes[:, 1]
+            groups = []
+            for u in np.unique(code):
+                idx = np.nonzero(code == u)[0]
+                groups.append(
+                    (idx, np.stack([blocks[i] for i in idx]).astype(self.dtype))
+                )
+        out = []
+        for idx, arr in groups:
+            r, c = rows[idx], cols[idx]
+            bm, bn = arr.shape[1], arr.shape[2]
+            if not (
+                np.all(self.row_blk_sizes[r] == bm)
+                and np.all(self.col_blk_sizes[c] == bn)
+            ):
+                raise ValueError(
+                    f"batch of shape ({bm},{bn}) does not match the blocking "
+                    f"at all its coordinates"
+                )
+            keys = r * self.nblkcols + c
+            if len(np.unique(keys)) != len(keys):
+                if summation:
+                    uniq, inv = np.unique(keys, return_inverse=True)
+                    red = np.zeros((len(uniq), bm, bn), self.dtype)
+                    np.add.at(red, inv, arr)
+                    keys, arr = uniq, red
+                else:
+                    # deterministic last-write-wins (jnp scatter with
+                    # duplicate indices is undefined-order)
+                    uniq, first_rev = np.unique(keys[::-1], return_index=True)
+                    last = len(keys) - 1 - first_rev
+                    keys, arr = uniq, arr[last]
+            out.append((keys, arr, summation))
+        return out
+
     def reserve_block(self, row: int, col: int) -> None:
         """Ref `dbcsr_reserve_block2d`: allocate a zero block."""
         row, col, _ = self._canonicalize(row, col, None)
@@ -206,51 +311,71 @@ class BlockSparseMatrix:
 
     def finalize(self) -> "BlockSparseMatrix":
         """Merge staged blocks into the CSR index (ref `dbcsr_finalize` ->
-        `dbcsr_merge_all`, `dbcsr_work_operations.F:749,1393`)."""
-        if not self._work:
+        `dbcsr_merge_all`, `dbcsr_work_operations.F:749,1393`).
+
+        Existing device data is never round-tripped through host:
+        surviving blocks move bin-to-bin with one device gather/scatter
+        per shape, and only the staged host blocks are uploaded.
+        """
+        if not self._work and not self._work_batches:
             self.valid = True
             return self
-        new_keys = np.array(
-            [r * self.nblkcols + c for (r, c) in self._work], dtype=np.int64
+        nbc = self.nblkcols
+        if self._work:
+            # single-put stagings become a leading replace batch (keys
+            # are already canonical; dict semantics were last-wins)
+            self._work_batches = self._make_batches(
+                np.array([r for (r, _) in self._work], np.int64),
+                np.array([c for (_, c) in self._work], np.int64),
+                [blk for blk in self._work.values()],
+                False,
+            ) + self._work_batches
+            self._work.clear()
+        merged = np.union1d(
+            self.keys, np.concatenate([k for (k, _, _) in self._work_batches])
         )
-        merged = np.union1d(self.keys, new_keys)
-        # host copies of surviving old blocks
-        old_blocks = self._fetch_entry_blocks()
-        blocks: Dict[int, np.ndarray] = dict(zip(self.keys.tolist(), old_blocks))
-        for (r, c), blk in self._work.items():
-            blocks[r * self.nblkcols + c] = blk
-        self._work.clear()
-        self._set_structure(merged, [blocks[k] for k in merged.tolist()])
-        self.valid = True
-        return self
-
-    def _set_structure(self, keys: np.ndarray, host_blocks) -> None:
-        """Rebuild index + device bins from sorted keys and host blocks."""
-        keys = np.ascontiguousarray(keys, np.int64)
-        n = len(keys)
-        rows = (keys // self.nblkcols).astype(np.int64)
-        cols = (keys % self.nblkcols).astype(np.int64)
-        self.keys = keys
-        self.row_ptr = np.zeros(self.nblkrows + 1, np.int64)
-        self.row_ptr[1:] = np.cumsum(np.bincount(rows, minlength=self.nblkrows))
-        bin_ids, slots, shapes = _bin_entries(
+        rows = (merged // nbc).astype(np.int64)
+        cols = (merged % nbc).astype(np.int64)
+        nb, nsl, shapes = _bin_entries(
             self.row_blk_sizes, self.col_blk_sizes, rows, cols
         )
-        self.ent_bin = bin_ids
-        self.ent_slot = slots
-        self.bins = []
-        self._shape_to_bin = {}
-        for b, (bm, bn) in enumerate(shapes):
-            mask = bin_ids == b
-            count = int(mask.sum())
-            cap = bucket_size(count)
-            host = np.zeros((cap, bm, bn), self.dtype)
-            if host_blocks is not None:
-                idx = np.nonzero(mask)[0]
-                for e in idx:
-                    host[slots[e]] = host_blocks[e]
-            self.bins.append(_Bin((int(bm), int(bn)), jnp.asarray(host), count))
-            self._shape_to_bin[(int(bm), int(bn))] = b
+        shape_to_bin = {(int(bm), int(bn)): i for i, (bm, bn) in enumerate(shapes)}
+        counts = np.bincount(nb, minlength=len(shapes))
+        data_arrs = [
+            jnp.zeros((bucket_size(int(counts[i])), int(bm), int(bn)), self.dtype)
+            for i, (bm, bn) in enumerate(shapes)
+        ]
+        # 1) surviving old blocks: device-to-device migration per shape
+        if len(self.keys):
+            pos_old = np.searchsorted(merged, self.keys)
+            new_bin_of_old = nb[pos_old]
+            for b in range(len(shapes)):
+                old_sel = np.nonzero(new_bin_of_old == b)[0]
+                if not len(old_sel):
+                    continue
+                src = self.bins[self.ent_bin[old_sel[0]]]
+                data_arrs[b] = _migrate_blocks(
+                    data_arrs[b],
+                    src.data,
+                    jnp.asarray(self.ent_slot[old_sel]),
+                    jnp.asarray(nsl[pos_old[old_sel]]),
+                )
+        # 2) staged batches in call order (a batch is shape-uniform ->
+        #    exactly one bin; single puts were prepended as a batch)
+        for keys_b, arr, summation in self._work_batches:
+            b = shape_to_bin[(arr.shape[1], arr.shape[2])]
+            slots = nsl[np.searchsorted(merged, keys_b)]
+            data_arrs[b] = _scatter_staged(
+                data_arrs[b], jnp.asarray(arr), jnp.asarray(slots), bool(summation)
+            )
+        bins = [
+            _Bin((int(bm), int(bn)), data_arrs[i], int(counts[i]))
+            for i, (bm, bn) in enumerate(shapes)
+        ]
+        self._work.clear()
+        self._work_batches.clear()
+        self.set_structure_from_device(merged, bins, binning=(nb, nsl, shapes))
+        return self
 
     def set_structure_from_device(
         self, keys: np.ndarray, bins: List[_Bin], binning=None
@@ -274,6 +399,7 @@ class BlockSparseMatrix:
         self.bins = [by_shape[(int(bm), int(bn))] for (bm, bn) in shapes]
         self._shape_to_bin = {b.shape: i for i, b in enumerate(self.bins)}
         self._work.clear()
+        self._work_batches.clear()
         self.valid = True
 
     # --------------------------------------------------------------- access
@@ -315,13 +441,6 @@ class BlockSparseMatrix:
                 self.ent_slot[e]
             ]
 
-    def _fetch_entry_blocks(self) -> List[np.ndarray]:
-        """Host copies of all finalized blocks, key-ordered."""
-        host_bins = [np.asarray(b.data[: b.count]) if b.count else None for b in self.bins]
-        return [
-            host_bins[self.ent_bin[e]][self.ent_slot[e]] for e in range(self.nblks)
-        ]
-
     def block_norms(self) -> np.ndarray:
         """Frobenius norm per finalized entry, key-ordered (device compute)."""
         from dbcsr_tpu.acc.smm import block_norms as _bn
@@ -352,6 +471,7 @@ class BlockSparseMatrix:
         m.bins = [_Bin(b.shape, b.data, b.count) for b in self.bins]
         m._shape_to_bin = dict(self._shape_to_bin)
         m._work = {k: v.copy() for k, v in self._work.items()}
+        m._work_batches = [(k.copy(), a.copy(), s) for (k, a, s) in self._work_batches]
         m.valid = self.valid
         return m
 

@@ -85,14 +85,26 @@ def distribute(
     rq, cq = _pad_counts(mesh, role)
     nbr_pad = ceil_div(matrix.nblkrows, rq) * rq
     nbc_pad = ceil_div(matrix.nblkcols, cq) * cq
-    host = np.zeros((nbr_pad * bm, nbc_pad * bn), dtype=np.dtype(matrix.dtype))
-    for r, c, blk in matrix.iterate_blocks():
-        host[r * bm : r * bm + blk.shape[0], c * bn : c * bn + blk.shape[1]] = blk
-        if matrix.matrix_type != "N" and r != c:
-            from dbcsr_tpu.core.matrix import _fold_block
-
-            tb = _fold_block(blk, matrix.matrix_type)
-            host[c * bm : c * bm + tb.shape[0], r * bn : r * bn + tb.shape[1]] = tb
+    grid4 = np.zeros((nbr_pad, nbc_pad, bm, bn), dtype=np.dtype(matrix.dtype))
+    rows, cols = matrix.entry_coords()
+    for b_id, bb in enumerate(matrix.bins):
+        sel = np.nonzero(matrix.ent_bin == b_id)[0]
+        if not len(sel):
+            continue
+        blks = np.asarray(bb.data[: bb.count])[matrix.ent_slot[sel]]
+        r_s, c_s = rows[sel], cols[sel]
+        bmb, bnb = bb.shape
+        grid4[r_s, c_s, :bmb, :bnb] = blks
+        if matrix.matrix_type != "N":
+            off = r_s != c_s
+            if off.any():
+                tb = np.swapaxes(blks[off], 1, 2)
+                if matrix.matrix_type == "A":
+                    tb = -tb
+                elif matrix.matrix_type == "H":
+                    tb = tb.conj()
+                grid4[c_s[off], r_s[off], :bnb, :bmb] = tb
+    host = grid4.transpose(0, 2, 1, 3).reshape(nbr_pad * bm, nbc_pad * bn)
     data = jax.device_put(host, NamedSharding(mesh, _ROLE_SPECS[role]))
     return DistMatrix(
         data=data,
@@ -111,17 +123,27 @@ def distribute(
 
 def collect(dm: DistMatrix, drop_zero_blocks: bool = True) -> BlockSparseMatrix:
     """Gather the distributed matrix back into a host-indexed
-    BlockSparseMatrix, carving against the original blocking."""
+    BlockSparseMatrix, carving against the original blocking
+    (vectorized: one reshape + per-shape fancy-indexed extraction
+    instead of an O(nblkrows * nblkcols) Python loop)."""
+    from dbcsr_tpu.parallel.sparse_dist import _adopt_panels
+
     host = np.asarray(dm.data)
+    nbr, nbc = dm.nblkrows, dm.nblkcols
+    grid = (
+        host.reshape(dm.nbr_pad, dm.bm, dm.nbc_pad, dm.bn)
+        .transpose(0, 2, 1, 3)[:nbr, :nbc]
+    )
+    if drop_zero_blocks:
+        # padding beyond each block's true (rs, cs) extent is zero by
+        # construction, so the padded any() is exact
+        mask = grid.reshape(nbr, nbc, -1).any(axis=2)
+    else:
+        mask = np.ones((nbr, nbc), bool)
+    rows, cols = np.nonzero(mask)
+    keys = rows * nbc + cols  # row-major nonzero order: already sorted
     out = BlockSparseMatrix(dm.name, dm.row_blk_sizes, dm.col_blk_sizes, dm.dtype)
-    for r in range(dm.nblkrows):
-        rs = dm.row_blk_sizes[r]
-        for c in range(dm.nblkcols):
-            cs = dm.col_blk_sizes[c]
-            blk = host[r * dm.bm : r * dm.bm + rs, c * dm.bn : c * dm.bn + cs]
-            if not drop_zero_blocks or np.any(blk != 0):
-                out.put_block(r, c, blk)
-    return out.finalize()
+    return _adopt_panels(out, keys.astype(np.int64), grid[rows, cols])
 
 
 def replicate(matrix: BlockSparseMatrix, mesh: Mesh, name: Optional[str] = None) -> DistMatrix:

@@ -47,13 +47,39 @@ from dbcsr_tpu.ops.transformations import desymmetrize
 from dbcsr_tpu.utils.rounding import bucket_size
 
 
+def _adopt_panels(out: BlockSparseMatrix, keys: np.ndarray,
+                  blocks: np.ndarray) -> BlockSparseMatrix:
+    """Vectorized collection: carve (N, BM, BN) padded panel blocks into
+    `out`'s shape bins directly (replaces the per-entry put_block loop;
+    the collect half of `dbcsr_merge_all`,
+    `dbcsr_work_operations.F:1393`)."""
+    from dbcsr_tpu.core.matrix import _Bin, _bin_entries
+
+    rows = (keys // out.nblkcols).astype(np.int64)
+    cols = (keys % out.nblkcols).astype(np.int64)
+    nb, nsl, shapes = _bin_entries(out.row_blk_sizes, out.col_blk_sizes, rows, cols)
+    bins = []
+    for b, (bm, bn) in enumerate(shapes):
+        sel = np.nonzero(nb == b)[0]
+        cap = bucket_size(len(sel))
+        data = np.zeros((cap, int(bm), int(bn)), blocks.dtype)
+        data[nsl[sel]] = blocks[sel, : int(bm), : int(bn)]
+        bins.append(_Bin((int(bm), int(bn)), jnp.asarray(data), len(sel)))
+    out.set_structure_from_device(keys, bins, binning=(nb, nsl, shapes))
+    return out
+
+
 def _dense_blocks_host(matrix: BlockSparseMatrix, bm: int, bn: int) -> np.ndarray:
-    """(nblks, bm, bn) zero-padded host copies of all blocks, key order."""
+    """(nblks, bm, bn) zero-padded host copies of all blocks, key order
+    (one device fetch + one vectorized scatter per shape bin)."""
+    if not matrix.valid:
+        raise RuntimeError("finalize() before panel assembly")
     out = np.zeros((matrix.nblks, bm, bn), np.dtype(matrix.dtype))
-    e = 0
-    for _, _, blk in matrix.iterate_blocks():
-        out[e, : blk.shape[0], : blk.shape[1]] = blk
-        e += 1
+    for b_id, b in enumerate(matrix.bins):
+        sel = np.nonzero(matrix.ent_bin == b_id)[0]
+        if len(sel):
+            host = np.asarray(b.data[: b.count])
+            out[sel, : b.shape[0], : b.shape[1]] = host[matrix.ent_slot[sel]]
     return out
 
 
@@ -426,12 +452,7 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         a.row_blk_sizes, b.col_blk_sizes, dtype,
         dist=out_dist,
     )
-    rbs, cbs = out.row_blk_sizes, out.col_blk_sizes
-    for e in range(len(c_keys)):
-        r, c = int(c_rows[e]), int(c_cols[e])
-        blk = c_np[rdist[r], cdist[c], c_slots[e], : rbs[r], : cbs[c]]
-        out.put_block(r, c, blk)
-    out.finalize()
+    _adopt_panels(out, c_keys, c_np[rdist[c_rows], cdist[c_cols], c_slots])
     if filter_eps is not None and not retain_sparsity:
         # final ||C|| >= eps pass (ref multrec_filtering,
         # dbcsr_mm_multrec.F:694-748) — shared criterion with the
@@ -666,12 +687,10 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         a.row_blk_sizes, b.col_blk_sizes, dtype,
         dist=matrix_c.dist if matrix_c is not None else None,
     )
-    rbs, cbs = out.row_blk_sizes, out.col_blk_sizes
-    for e in range(len(c_keys)):
-        r, c = int(c_rows[e]), int(c_cols[e])
-        blk = c_np[row_group[r], rdist_in[r], cdist[c], c_slots[e], : rbs[r], : cbs[c]]
-        out.put_block(r, c, blk)
-    out.finalize()
+    _adopt_panels(
+        out, c_keys,
+        c_np[row_group[c_rows], rdist_in[c_rows], cdist[c_cols], c_slots],
+    )
     if filter_eps is not None:
         from dbcsr_tpu.ops.operations import filter_matrix
 

@@ -116,3 +116,162 @@ def test_reserve_block():
     m.reserve_block(1, 1)
     m.finalize()
     np.testing.assert_array_equal(m.get_block(1, 1), np.zeros((3, 3)))
+
+
+def test_put_blocks_batched_matches_loop():
+    """Array-of-blocks staging == per-block staging (vectorized
+    assembly, ref dbcsr_work_operations.F work matrices)."""
+    from dbcsr_tpu.core.matrix import BlockSparseMatrix
+
+    rng = np.random.default_rng(60)
+    rbs = rng.choice([3, 5], 20).astype(np.int32)
+    n = 60
+    rows = rng.integers(0, 20, n)
+    cols = rng.integers(0, 20, n)
+    blocks = [rng.standard_normal((rbs[r], rbs[c])) for r, c in zip(rows, cols)]
+
+    m1 = BlockSparseMatrix("loop", rbs, rbs)
+    for r, c, b in zip(rows, cols, blocks):
+        m1.put_block(int(r), int(c), b)
+    m1.finalize()
+
+    m2 = BlockSparseMatrix("batch", rbs, rbs)
+    m2.put_blocks(rows, cols, blocks)
+    m2.finalize()
+
+    np.testing.assert_array_equal(m1.keys, m2.keys)
+    from dbcsr_tpu.ops.test_methods import to_dense
+
+    # duplicates: dict is last-wins; list batch grouped by shape keeps
+    # last written per shape group — compare via fresh dedup
+    np.testing.assert_allclose(to_dense(m1), to_dense(m2), atol=0)
+
+
+def test_put_blocks_summation_accumulates():
+    from dbcsr_tpu.core.matrix import BlockSparseMatrix
+    from dbcsr_tpu.ops.test_methods import to_dense
+
+    rbs = np.asarray([4, 4, 4], np.int32)
+    m = BlockSparseMatrix("s", rbs, rbs)
+    rows = np.array([0, 1, 0])
+    cols = np.array([1, 2, 1])
+    blocks = np.ones((3, 4, 4))
+    m.put_blocks(rows, cols, blocks, summation=True)
+    m.finalize()
+    assert np.allclose(m.get_block(0, 1), 2.0)  # duplicate pre-reduced
+    # summation on top of finalized data
+    m.put_blocks(np.array([0]), np.array([1]), np.ones((1, 4, 4)), summation=True)
+    m.finalize()
+    assert np.allclose(m.get_block(0, 1), 3.0)
+
+
+def test_finalize_merges_without_host_refetch():
+    """Incremental put_block on a large finalized matrix must migrate
+    existing blocks device-to-device (correctness check: values
+    preserved across repeated merges)."""
+    from dbcsr_tpu.core.matrix import BlockSparseMatrix
+    from dbcsr_tpu.ops.test_methods import to_dense
+
+    rng = np.random.default_rng(61)
+    nb = 30
+    rbs = np.full(nb, 3, np.int32)
+    m = BlockSparseMatrix("inc", rbs, rbs)
+    rows = rng.integers(0, nb, 200)
+    cols = rng.integers(0, nb, 200)
+    m.put_blocks(rows, cols, rng.standard_normal((200, 3, 3)))
+    m.finalize()
+    ref = to_dense(m).copy()
+    newb = rng.standard_normal((3, 3))
+    m.put_block(5, 7, newb)
+    m.finalize()
+    got = to_dense(m)
+    ref[5 * 3 : 6 * 3, 7 * 3 : 8 * 3] = newb
+    np.testing.assert_allclose(got, ref, atol=0)
+
+
+def test_assembly_microbench_1e5_blocks():
+    """1e5-block assembly through the batched path (the VERDICT
+    milestone); also times the old per-block dict path on a slice to
+    document the speedup."""
+    import time
+
+    from dbcsr_tpu.core.matrix import BlockSparseMatrix
+
+    rng = np.random.default_rng(62)
+    nb = 400  # 400x400 block grid
+    rbs = np.full(nb, 4, np.int32)
+    n = 100_000
+    keys = rng.choice(nb * nb, size=n, replace=False).astype(np.int64)
+    rows, cols = keys // nb, keys % nb
+    blocks = rng.standard_normal((n, 4, 4))
+
+    t0 = time.perf_counter()
+    m = BlockSparseMatrix("bench", rbs, rbs)
+    m.put_blocks(rows, cols, blocks)
+    m.finalize()
+    batched_s = time.perf_counter() - t0
+    assert m.nblks == n
+
+    # per-block path on 5k blocks, extrapolated
+    t0 = time.perf_counter()
+    m2 = BlockSparseMatrix("bench2", rbs, rbs)
+    for i in range(5000):
+        m2.put_block(int(rows[i]), int(cols[i]), blocks[i])
+    m2.finalize()
+    loop_s = (time.perf_counter() - t0) * (n / 5000)
+    print(f"\nassembly 1e5 blocks: batched {batched_s:.3f}s, "
+          f"per-block (extrapolated) {loop_s:.3f}s, x{loop_s / batched_s:.1f}")
+    assert batched_s * 3 < loop_s  # conservative CI-safe bound
+
+
+def test_put_blocks_symmetric_rectangular_fold():
+    """Lower-triangle staging on a SYMMETRIC matrix with non-square
+    off-diagonal blocks must fold (transpose) correctly."""
+    from dbcsr_tpu.core.matrix import SYMMETRIC, BlockSparseMatrix
+    from dbcsr_tpu.ops.test_methods import to_dense
+
+    rbs = np.asarray([3, 5], np.int32)
+    m = BlockSparseMatrix("sym", rbs, rbs, matrix_type=SYMMETRIC)
+    blk = np.arange(15.0).reshape(5, 3)
+    m.put_blocks(np.array([1]), np.array([0]), [blk])
+    m.finalize()
+    np.testing.assert_array_equal(m.get_block(0, 1), blk.T)
+    d = to_dense(m)
+    np.testing.assert_array_equal(d, d.T)
+
+
+def test_put_blocks_replace_duplicates_last_wins():
+    from dbcsr_tpu.core.matrix import BlockSparseMatrix
+
+    rbs = np.asarray([2, 2], np.int32)
+    m = BlockSparseMatrix("dup", rbs, rbs)
+    a_blk = np.full((2, 2), 1.0)
+    b_blk = np.full((2, 2), 7.0)
+    m.put_blocks(np.array([0, 0]), np.array([1, 1]), np.stack([a_blk, b_blk]))
+    m.finalize()
+    np.testing.assert_array_equal(m.get_block(0, 1), b_blk)
+
+
+def test_put_blocks_snapshots_caller_buffer():
+    from dbcsr_tpu.core.matrix import BlockSparseMatrix
+
+    rbs = np.asarray([2], np.int32)
+    m = BlockSparseMatrix("snap", rbs, rbs)
+    buf = np.ones((1, 2, 2))
+    m.put_blocks(np.array([0]), np.array([0]), buf)
+    buf[:] = -5.0  # caller reuses the buffer before finalize
+    m.finalize()
+    np.testing.assert_array_equal(m.get_block(0, 0), np.ones((2, 2)))
+
+
+def test_unfinalized_panel_assembly_rejected():
+    from dbcsr_tpu.core.matrix import BlockSparseMatrix
+    from dbcsr_tpu.parallel.sparse_dist import _dense_blocks_host
+
+    rbs = np.asarray([2], np.int32)
+    m = BlockSparseMatrix("uf", rbs, rbs)
+    m.put_block(0, 0, np.ones((2, 2)))
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="finalize"):
+        _dense_blocks_host(m, 2, 2)
