@@ -4,14 +4,29 @@ Replicates `tests/dbcsr_performance_driver.F` +
 `dbcsr_performance_multiply.F`: parse a `.perf` input (same format as
 `tests/input.perf` in the reference), build random block-sparse
 matrices, run nrep multiplies, report per-repeat time and mean/std
-GFLOP/s plus a checksum.
+GFLOP/s plus checksums.
 
-Usage:  python -m dbcsr_tpu.perf.driver tests/inputs/test_square_sparse.perf
+Grid handling (ref `dbcsr_performance_driver.F:47-56` mp_cart_create):
+``npcols > 0`` selects the process-grid columns.  On the device mesh
+this maps to a ('kl','pr','pc') mesh with pr = pc = npcols and any
+excess device factor becoming 2.5D k-layers (`kl`), the analog of
+NUM_LAYERS_3D; ``use_rma=T`` (the reference's one-sided 3D algorithm,
+`dbcsr_mm_3d.F:1136`) prefers a layered kl>1 mesh.  npcols == 0 with
+one device runs the single-chip engine.
+
+Checksum verification (ref `dbcsr_performance_multiply.F:584-675`):
+when the input's ``check`` flag is set, checksum(C_out) and the
+position-dependent checksum are compared against the recorded reference
+values with the reference's relative-difference formula, and a
+`PerfChecksumError` is raised on mismatch.
+
+Usage:  python -m dbcsr_tpu.perf.driver tests/inputs/test_square_sparse.perf [ndevices]
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 import sys
 import time
 from typing import List, Optional, Tuple
@@ -55,6 +70,12 @@ class PerfConfig:
     check: bool = False
     check_threshold: float = 0.0
     check_refs: Tuple[float, float] = (0.0, 0.0)
+
+
+class PerfChecksumError(RuntimeError):
+    """checksum(C_out) disagrees with the input file's reference value
+    (ref: dbcsr_abort 'Wrong Checksums. Test failed!',
+    `dbcsr_performance_multiply.F:673-675`)."""
 
 
 def _fortran_bool(tok: str) -> bool:
@@ -127,9 +148,41 @@ def _element_to_block_limits(lim_lo, lim_hi, offsets) -> Tuple[Optional[int], Op
     return lo, hi
 
 
-def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True):
+def _mesh_for(cfg: PerfConfig, n_devices: int):
+    """Device mesh honoring npcols/use_rma (see module docstring); None
+    means run the single-chip engine."""
+    if n_devices <= 1 and cfg.npcols <= 1:
+        return None
+    from dbcsr_tpu.parallel import make_grid
+
+    if cfg.npcols > 0:
+        s = cfg.npcols
+        if n_devices % (s * s):
+            raise ValueError(
+                f"npcols={s} needs a device count divisible by {s * s}, "
+                f"have {n_devices}"
+            )
+        kl = n_devices // (s * s)
+        if kl == 1 and s == 1:
+            return None  # 1x1 grid: single-chip engine
+        import jax
+
+        devices = jax.devices()[: kl * s * s]
+        from jax.sharding import Mesh
+
+        return Mesh(np.asarray(devices).reshape(kl, s, s),
+                    axis_names=("kl", "pr", "pc"))
+    return make_grid(n_devices, layers=2 if cfg.use_rma and n_devices >= 8 else None)
+
+
+def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
+             n_devices: Optional[int] = None):
     """Run the configured multiply nrep times; returns a result dict
-    (ref `perf_multiply`, `dbcsr_performance_multiply.F:452-515`)."""
+    (ref `perf_multiply`, `dbcsr_performance_multiply.F:452-515`).
+
+    ``n_devices`` > 1 (or npcols > 1 in the input) runs on the device
+    mesh via the distributed sparse Cannon; default is single-chip.
+    """
     dtype = dtype_of(cfg.data_type)
     rng = np.random.default_rng(seed)
     m_sizes = expand_block_sizes(cfg.m, cfg.m_sizes)
@@ -155,17 +208,53 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True):
     fc, lc = _element_to_block_limits(cfg.limits[2], cfg.limits[3], noff)
     fk, lk = _element_to_block_limits(cfg.limits[4], cfg.limits[5], koff)
 
+    if n_devices is None:
+        n_devices = int(os.environ.get("DBCSR_TPU_PERF_DEVICES", "1"))
+    mesh = _mesh_for(cfg, n_devices)
+
+    chksum_a = matrix_checksum(a)
+    chksum_b = matrix_checksum(b)
+    chksum_c_in = matrix_checksum(c)
+
     times, flops_list = [], []
     for _ in range(cfg.nrep):
         c_run = c.copy()
         _block_until_ready(c_run)
         t0 = time.perf_counter()
-        flops = multiply(
-            cfg.transa, cfg.transb, cfg.alpha, a, b, cfg.beta, c_run,
-            retain_sparsity=cfg.retain_sparsity,
-            first_row=fr, last_row=lr, first_col=fc, last_col=lc,
-            first_k=fk, last_k=lk,
-        )
+        if mesh is not None:
+            from dbcsr_tpu.parallel.sparse_dist import sparse_multiply_distributed
+
+            if (cfg.transa, cfg.transb) != ("N", "N") or cfg.symm_a != "N" \
+                    or cfg.symm_b != "N" or cfg.symm_c != "N":
+                from dbcsr_tpu.ops.transformations import desymmetrize, new_transposed
+                from dbcsr_tpu.core.kinds import is_complex as _is_cplx
+                from dbcsr_tpu.core.matrix import NO_SYMMETRY
+
+                def _op(mat, tr):
+                    m_ = desymmetrize(mat) if mat.matrix_type != NO_SYMMETRY else mat
+                    if tr == "T":
+                        return new_transposed(m_)
+                    if tr == "C":
+                        return new_transposed(m_, conjugate=_is_cplx(m_.dtype))
+                    return m_
+
+                a_eff, b_eff = _op(a, cfg.transa), _op(b, cfg.transb)
+            else:
+                a_eff, b_eff = a, b
+            c_run = sparse_multiply_distributed(
+                cfg.alpha, a_eff, b_eff, cfg.beta, c_run, mesh,
+                retain_sparsity=cfg.retain_sparsity,
+                first_row=fr, last_row=lr, first_col=fc, last_col=lc,
+                first_k=fk, last_k=lk,
+            )
+            flops = int(getattr(c_run, "_last_flops", 0))
+        else:
+            flops = multiply(
+                cfg.transa, cfg.transb, cfg.alpha, a, b, cfg.beta, c_run,
+                retain_sparsity=cfg.retain_sparsity,
+                first_row=fr, last_row=lr, first_col=fc, last_col=lc,
+                first_k=fk, last_k=lk,
+            )
         _block_until_ready(c_run)
         times.append(time.perf_counter() - t0)
         flops_list.append(flops)
@@ -180,18 +269,49 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True):
         "gflops_best": float(np.max(gflops)),
         "checksum": cs,
         "checksum_pos": cs_pos,
+        "checksum_a": chksum_a,
+        "checksum_b": chksum_b,
+        "checksum_c_in": chksum_c_in,
         "device": str(jax.devices()[0]),
+        "grid": dict(mesh.shape) if mesh is not None else {"pr": 1, "pc": 1},
     }
     if verbose:
         print(f" matrix sizes M/N/K          {cfg.m} {cfg.n} {cfg.k}")
         print(f" sparsities A/B/C            {cfg.sparsity_a} {cfg.sparsity_b} {cfg.sparsity_c}")
         print(f" device                      {result['device']}")
+        print(f" grid (kl x pr x pc)         {result['grid']}")
         print(f" flops per multiply          {result['flops']:,}")
         print(f" time per multiply           {[f'{t:.4f}' for t in times]}")
         print(f" perf total                  {result['gflops_mean']:.2f} +/- "
               f"{result['gflops_std']:.2f} GFLOP/s (best {result['gflops_best']:.2f})")
-        print(f" checksum                    {cs:.15e}")
+        print(f" checksum(A)                 {chksum_a:.15e}")
+        print(f" checksum(B)                 {chksum_b:.15e}")
+        print(f" checksum(C_in)              {chksum_c_in:.15e}")
+        print(f" checksum(C_out)             {cs:.15e}")
+        print(f" checksum(C_out) POS         {cs_pos:.15e}")
+    if cfg.check:
+        _verify_checksums(cfg, cs, cs_pos, verbose)
     return result
+
+
+def _verify_checksums(cfg: PerfConfig, cs: float, cs_pos: float, verbose: bool) -> None:
+    """The reference's relative-difference acceptance
+    (`dbcsr_performance_multiply.F:656-675`)."""
+    th = cfg.check_threshold
+    errs = []
+    for name, got, ref in (("checksum(C_out)", cs, cfg.check_refs[0]),
+                           ("checksum(C_out) POS", cs_pos, cfg.check_refs[1])):
+        # sign-safe version of the reference's ABS(got/MAX(ref, th) - 1):
+        # the POS checksum can legitimately be negative here (normal-
+        # distributed data), which the reference formula cannot handle
+        rel_diff = abs(got - ref) / max(abs(ref), th)
+        if rel_diff > th:
+            errs.append(f"Wrong {name}: got {got:.15e}, ref {ref:.15e}, "
+                        f"rel_diff {rel_diff:.3e} > threshold {th:.1e}")
+    if errs:
+        raise PerfChecksumError("; ".join(errs))
+    if verbose:
+        print(" checksums OK (within threshold)")
 
 
 def _block_until_ready(matrix: BlockSparseMatrix) -> None:
@@ -206,7 +326,13 @@ def main(argv=None):
         print(__doc__)
         return 1
     cfg = parse_perf_file(argv[0])
-    run_perf(cfg)
+    n_devices = int(argv[1]) if len(argv) > 1 else None
+    try:
+        run_perf(cfg, n_devices=n_devices)
+    except PerfChecksumError as exc:
+        print(f" {exc}")
+        print(" Wrong Checksums. Test failed!")
+        return 1
     return 0
 
 
