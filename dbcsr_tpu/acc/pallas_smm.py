@@ -94,12 +94,15 @@ def build_grouped_stack(c_idx: np.ndarray, a_idx: np.ndarray, b_idx: np.ndarray,
     return ai2.reshape(nsteps, r_grp), bi2.reshape(nsteps, r_grp), ci2, r_grp
 
 
-def _a_map(s, ai, bi, ci, *, r):
-    return (ai[s, r], 0, 0)
+# ai/bi arrive FLAT (nsteps*R,) — a 2D (nsteps, R) scalar-prefetch array
+# would be lane-padded to (nsteps, 128) in SMEM (1 MB budget) and blow
+# the allocation 128/R-fold; 1D arrays are tiled densely
+def _a_map(s, ai, bi, ci, *, r, r_grp):
+    return (ai[s * r_grp + r], 0, 0)
 
 
-def _b_map(s, ai, bi, ci, *, r):
-    return (bi[s, r], 0, 0)
+def _b_map(s, ai, bi, ci, *, r, r_grp):
+    return (bi[s * r_grp + r], 0, 0)
 
 
 def _c_map(s, ai, bi, ci):
@@ -119,11 +122,16 @@ def _smm_kernel(ai_ref, bi_ref, ci_ref, *refs, r_grp):
     first = jnp.logical_or(s == 0, cur != prev)
     contrib = jnp.zeros(acc_ref.shape, jnp.float32)
     for r in range(r_grp):
+        # HIGHEST: true-f32 MXU passes for f32 inputs (default would be
+        # one bf16 pass, ~1e-3 relative error — caught by the
+        # validate_kernels gate on real hardware); bf16 inputs stay
+        # single-pass with f32 accumulation either way
         contrib = contrib + jax.lax.dot_general(
             a_refs[r][0],
             b_refs[r][0],
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )
     contrib = alpha_ref[0, 0] * contrib
 
@@ -144,6 +152,7 @@ def _smm_kernel(ai_ref, bi_ref, ci_ref, *refs, r_grp):
     donate_argnums=(0,),
 )
 def _pallas_process(c_data, a_data, b_data, ai2, bi2, ci2, alpha, *, r_grp, interpret):
+    """One launch: ai2/bi2 flat (nsteps*R,), ci2 (nsteps,), all int32."""
     nsteps = ci2.shape[0]
     m, k = a_data.shape[1:]
     n = b_data.shape[2]
@@ -152,11 +161,11 @@ def _pallas_process(c_data, a_data, b_data, ai2, bi2, ci2, alpha, *, r_grp, inte
         grid=(nsteps,),
         in_specs=[
             *[
-                pl.BlockSpec((1, m, k), functools.partial(_a_map, r=r))
+                pl.BlockSpec((1, m, k), functools.partial(_a_map, r=r, r_grp=r_grp))
                 for r in range(r_grp)
             ],
             *[
-                pl.BlockSpec((1, k, n), functools.partial(_b_map, r=r))
+                pl.BlockSpec((1, k, n), functools.partial(_b_map, r=r, r_grp=r_grp))
                 for r in range(r_grp)
             ],
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -181,6 +190,14 @@ def _pallas_process(c_data, a_data, b_data, ai2, bi2, ci2, alpha, *, r_grp, inte
         alpha,
         c_data,
     )
+
+
+# per-launch cap on stack entries (ai+bi+ci int32 must fit the ~1 MB
+# SMEM scalar-prefetch budget with headroom); longer stacks are chopped
+# into sequential launches — C runs spanning a boundary continue
+# correctly because the aliased C block already holds the partial sum
+# and the next launch's first-step reload adds to it
+_MAX_ENTRIES_PER_LAUNCH = 32768
 
 
 def process_stack_pallas(
@@ -215,20 +232,57 @@ def process_stack_pallas(
         np.asarray(c_idx), np.asarray(a_idx), np.asarray(b_idx),
         a_pad_row, b_pad_row, grouping=grouping,
     )
-    from dbcsr_tpu.utils.rounding import bucket_size
-
-    # bucket the step count so jit shapes recur; padding steps repeat the
-    # final C block with all-zero-block entries (exact no-ops)
-    cap = bucket_size(ai2.shape[0])
-    if cap > ai2.shape[0]:
-        pad = cap - ai2.shape[0]
-        ai2 = np.concatenate([ai2, np.full((pad, r_grp), a_pad_row, np.int32)])
-        bi2 = np.concatenate([bi2, np.full((pad, r_grp), b_pad_row, np.int32)])
-        ci2 = np.concatenate([ci2, np.full(pad, ci2[-1], np.int32)])
+    launches = prepare_launches(ai2, bi2, ci2, r_grp, a_pad_row, b_pad_row)
     alpha_arr = jnp.asarray([[alpha]], dtype=jnp.float32)
     interpret = jax.devices()[0].platform != "tpu"
-    return _pallas_process(
-        c_data, a_data, b_data,
-        jnp.asarray(ai2), jnp.asarray(bi2), jnp.asarray(ci2),
-        alpha_arr, r_grp=r_grp, interpret=interpret,
-    )
+    for a_c, b_c, c_c in launches:
+        # Mosaic fails to legalize scalar-prefetch index maps traced under
+        # jax_enable_x64 (i64 SMEM index loads); the kernel only touches
+        # f32/bf16 data and i32 indices, so trace with x64 off.
+        with jax.enable_x64(False):
+            c_data = _pallas_process(
+                c_data, a_data, b_data,
+                jnp.asarray(a_c), jnp.asarray(b_c), jnp.asarray(c_c),
+                alpha_arr, r_grp=r_grp, interpret=interpret,
+            )
+    return c_data
+
+
+def prepare_launches(ai2, bi2, ci2, r_grp: int, a_pad_row: int, b_pad_row: int):
+    """Chop a grouped stack into SMEM-sized launches.
+
+    Returns [(ai_flat (csteps*R,), bi_flat, ci (csteps,)), ...].  Chunk
+    boundaries are pulled back to the start of the current C run so a
+    block's accumulation stays within one launch (a mid-run split would
+    round the f32 accumulator to the output dtype at the boundary —
+    harmless for f32, a precision leak for bf16); a single run longer
+    than the cap is split anyway.  Step counts are bucketed so jit
+    shapes recur; padding steps repeat the chunk's final C block with
+    zero-block entries (exact no-ops)."""
+    from dbcsr_tpu.utils.rounding import bucket_size
+
+    csteps_max = max(1, _MAX_ENTRIES_PER_LAUNCH // r_grp)
+    nsteps_total = ai2.shape[0]
+    out = []
+    s0 = 0
+    while s0 < nsteps_total:
+        s1 = min(s0 + csteps_max, nsteps_total)
+        if s1 < nsteps_total and ci2[s1 - 1] == ci2[s1]:
+            # pull the boundary back to this run's first step
+            run_start = s1 - 1
+            while run_start > s0 and ci2[run_start - 1] == ci2[s1]:
+                run_start -= 1
+            if run_start > s0:
+                s1 = run_start
+        a_c, b_c, c_c = ai2[s0:s1], bi2[s0:s1], ci2[s0:s1]
+        cap = bucket_size(a_c.shape[0])
+        if cap > a_c.shape[0]:
+            pad = cap - a_c.shape[0]
+            a_c = np.concatenate([a_c, np.full((pad, r_grp), a_pad_row, np.int32)])
+            b_c = np.concatenate([b_c, np.full((pad, r_grp), b_pad_row, np.int32)])
+            c_c = np.concatenate([c_c, np.full(pad, c_c[-1], np.int32)])
+        out.append((np.ascontiguousarray(a_c.reshape(-1)),
+                    np.ascontiguousarray(b_c.reshape(-1)),
+                    np.ascontiguousarray(c_c)))
+        s0 = s1
+    return out

@@ -205,18 +205,19 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
     S = len(a_idx)
     if S == 0:
         return c_data
-    if _pallas_supported(cfg, c_data, a_data, b_data):
-        # tuned preference (dbcsr_tpu.acc.params; analog of the per-GPU
-        # parameter table consulted by libsmm_acc.cpp:227-249) —
-        # resolved once here for both the driver choice and grouping
-        from dbcsr_tpu.acc import params as params_mod
+    # tuned preference (dbcsr_tpu.acc.params; analog of the per-GPU
+    # parameter table consulted by libsmm_acc.cpp:227-249) —
+    # resolved once here for the driver choice, grouping, and the
+    # flat-gather layout decision
+    from dbcsr_tpu.acc import params as params_mod
 
-        tuned = params_mod.lookup(
-            a_data.shape[1], b_data.shape[2], a_data.shape[2], c_data.dtype
-        )
+    tuned = params_mod.lookup(
+        a_data.shape[1], b_data.shape[2], a_data.shape[2], c_data.dtype
+    )
+    tuned_driver = tuned.get("driver") if tuned else None
+    if _pallas_supported(cfg, c_data, a_data, b_data):
         prefer_xla = (
-            cfg.mm_driver == "auto" and tuned is not None
-            and tuned.get("driver") == "xla"
+            cfg.mm_driver == "auto" and tuned_driver in ("xla", "xla_flat")
         )
         if not prefer_xla:
             from dbcsr_tpu.acc.pallas_smm import process_stack_pallas
@@ -262,7 +263,10 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
     ai = jnp.asarray(ai.reshape(nchunks, chunk))
     bi = jnp.asarray(bi.reshape(nchunks, chunk))
     ci = jnp.asarray(ci.reshape(nchunks, chunk))
-    if cfg.flat_gather:
+    use_flat = cfg.flat_gather or (
+        cfg.mm_driver == "auto" and tuned_driver == "xla_flat"
+    )
+    if use_flat:
         return _process_stack_xla_flat(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
     return _process_stack_xla(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
 

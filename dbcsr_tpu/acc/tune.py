@@ -41,8 +41,17 @@ def tune_smm(m: int, n: int, k: int, dtype_enum: int = 1,
     import jax
     import jax.numpy as jnp
 
+    # f64 must tune as true f64; scoped so a f32-only host application
+    # calling tune_smm() keeps its global x64 setting
+    with jax.enable_x64(True):
+        return _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed,
+                             jax, jnp)
+
+
+def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
+
     from dbcsr_tpu.acc import pallas_smm
-    from dbcsr_tpu.acc.smm import _process_stack_xla
+    from dbcsr_tpu.acc.smm import _process_stack_xla, _process_stack_xla_flat
     from dbcsr_tpu.utils.rounding import bucket_size
 
     dtype = dtype_of(dtype_enum)
@@ -79,6 +88,19 @@ def tune_smm(m: int, n: int, k: int, dtype_enum: int = 1,
     candidates.append({"driver": "xla", "grouping": None, "gflops": flops / t / 1e9})
     out(f"  xla: {flops / t / 1e9:.1f} GFLOP/s")
 
+    # flat-gather layout variant (lane-packed (N, m*k) rows; see
+    # _process_stack_xla_flat) — the main alternative for dtypes the
+    # Pallas kernel doesn't take (f64/complex)
+    def run_xla_flat():
+        return _process_stack_xla_flat(
+            jnp.zeros((nc, m, n), dtype), a, b, *xla_args,
+            jnp.asarray(1.0, dtype),
+        )
+
+    t = _time_config(run_xla_flat, nrep)
+    candidates.append({"driver": "xla_flat", "grouping": None, "gflops": flops / t / 1e9})
+    out(f"  xla_flat: {flops / t / 1e9:.1f} GFLOP/s")
+
     if pallas_smm.supports(jnp.zeros((1, m, n), dtype), a, b):
         zero_a, zero_b = na - 1, nb - 1
         a = a.at[zero_a].set(0)
@@ -87,21 +109,27 @@ def tune_smm(m: int, n: int, k: int, dtype_enum: int = 1,
             ai2, bi2, ci2, _ = pallas_smm.build_grouped_stack(
                 ci, ai, bi, zero_a, zero_b, grouping=r
             )
-            cap = bucket_size(ai2.shape[0])
-            if cap > ai2.shape[0]:
-                pad = cap - ai2.shape[0]
-                ai2 = np.concatenate([ai2, np.full((pad, r), zero_a, np.int32)])
-                bi2 = np.concatenate([bi2, np.full((pad, r), zero_b, np.int32)])
-                ci2 = np.concatenate([ci2, np.full(pad, ci2[-1], np.int32)])
-            dai2, dbi2, dci2 = map(jnp.asarray, (ai2, bi2, ci2))
+            # time exactly the launch sequence dispatch would run
+            # (shared prep: flatten, SMEM chunking, bucket padding)
+            launches = [
+                tuple(map(jnp.asarray, lc))
+                for lc in pallas_smm.prepare_launches(ai2, bi2, ci2, r,
+                                                      zero_a, zero_b)
+            ]
             alpha = jnp.asarray([[1.0]], jnp.float32)
             interpret = jax.devices()[0].platform != "tpu"
 
-            def run_pallas(r=r, dai2=dai2, dbi2=dbi2, dci2=dci2):
-                return pallas_smm._pallas_process(
-                    jnp.zeros((nc, m, n), dtype), a, b, dai2, dbi2, dci2,
-                    alpha, r_grp=r, interpret=interpret,
-                )
+            def run_pallas(r=r, launches=launches):
+                # x64 off during trace: see process_stack_pallas (Mosaic
+                # cannot legalize i64 scalar-prefetch index loads)
+                c = jnp.zeros((nc, m, n), dtype)
+                with jax.enable_x64(False):
+                    for dai2, dbi2, dci2 in launches:
+                        c = pallas_smm._pallas_process(
+                            c, a, b, dai2, dbi2, dci2,
+                            alpha, r_grp=r, interpret=interpret,
+                        )
+                return c
 
             try:
                 t = _time_config(run_pallas, nrep)

@@ -168,6 +168,11 @@ def _mesh_for(cfg: PerfConfig, n_devices: int):
         import jax
 
         devices = jax.devices()[: kl * s * s]
+        if len(devices) < kl * s * s:
+            raise ValueError(
+                f"grid kl={kl} x {s}x{s} needs {kl * s * s} devices, "
+                f"have {len(devices)}"
+            )
         from jax.sharding import Mesh
 
         return Mesh(np.asarray(devices).reshape(kl, s, s),

@@ -113,6 +113,28 @@ def test_flat_gather_matches_default():
     np.testing.assert_allclose(flat, base, rtol=1e-13, atol=1e-13)
 
 
+def test_tuned_xla_flat_entry_drives_dispatch(tmp_path, monkeypatch):
+    """A tuned driver='xla_flat' entry in the params table must route
+    the stack through the flat-gather path (and produce identical
+    results) without any config toggles — the per-shape analog of the
+    parameter-table dispatch in libsmm_acc.cpp:227-249."""
+    from dbcsr_tpu.acc import params as params_mod
+
+    rng = np.random.default_rng(12)
+    a, b, c, ai, bi, ci = _random_stack(rng, 9, 9, 6, 250, 7, 6, 5, np.float64)
+    base = np.asarray(process_stack(c, a, b, ai, bi, ci, alpha=1.5))
+
+    monkeypatch.setenv("DBCSR_TPU_PARAMS_DIR", str(tmp_path))
+    params_mod._cache.clear()
+    params_mod.save_entry({"m": 7, "n": 5, "k": 6, "dtype": "float64",
+                           "driver": "xla_flat", "grouping": None, "gflops": 1.0})
+    try:
+        flat = np.asarray(process_stack(c, a, b, ai, bi, ci, alpha=1.5))
+    finally:
+        params_mod._cache.clear()
+    np.testing.assert_allclose(flat, base, rtol=1e-13, atol=1e-13)
+
+
 def test_validate_kernels_catches_corrupted_kernel(monkeypatch):
     """Ref: libsmm_acc validates each JIT'd kernel against a CPU
     checksum and hard-exits on mismatch (`libsmm_acc.cpp:81-85,216`).
