@@ -78,6 +78,12 @@ def main():
         "checksum": res["checksum"],
         "device": res["device"],
         "device_fallback": fallback,
+        # timing forces real device completion via a data-dependent
+        # 8-byte fetch per rep (driver._force_completion): on the axon
+        # tunnel, block_until_ready alone can return before the work
+        # runs, inflating GFLOP/s ~80x (the round-1 "101 GFLOP/s" and
+        # early round-2 "103.7/147.9" numbers were that illusion)
+        "sync": "forced-fetch",
     }
     print(json.dumps(out))
 

@@ -293,6 +293,47 @@ class BlockSparseMatrix:
             out.append((keys, arr, summation))
         return out
 
+    def stage_device_blocks(self, rows, cols, blocks, summation: bool = False) -> None:
+        """Stage an (N, bm, bn) DEVICE array of uniform-shape blocks
+        without a host round-trip — the device-side sibling of
+        `put_blocks` (used by the tensor reshape path, ref
+        `dbcsr_t_reshape`'s buffered block alltoall,
+        `dbcsr_tensor_reshape.F:67,288`).  The batch merges at
+        `finalize` via the same device gather/scatter as host batches.
+
+        Caller contract: (row, col) pairs are unique within the batch
+        (jnp scatter with duplicates is undefined-order), and the
+        matrix has no symmetry (device blocks are not host-foldable).
+        """
+        if self.matrix_type != NO_SYMMETRY:
+            raise NotImplementedError(
+                "stage_device_blocks requires a non-symmetric matrix"
+            )
+        rows = np.ascontiguousarray(rows, np.int64)
+        cols = np.ascontiguousarray(cols, np.int64)
+        if len(rows) != len(cols) or len(rows) != blocks.shape[0]:
+            raise ValueError("rows/cols/blocks length mismatch")
+        if len(rows) == 0:
+            return
+        if rows.min() < 0 or rows.max() >= self.nblkrows or cols.min() < 0 or (
+            cols.max() >= self.nblkcols
+        ):
+            raise IndexError("block coordinates out of range")
+        bm, bn = int(blocks.shape[1]), int(blocks.shape[2])
+        if not (
+            np.all(self.row_blk_sizes[rows] == bm)
+            and np.all(self.col_blk_sizes[cols] == bn)
+        ):
+            raise ValueError(
+                f"batch of shape ({bm},{bn}) does not match the blocking "
+                f"at all its coordinates"
+            )
+        keys = rows * self.nblkcols + cols
+        if blocks.dtype != np.dtype(self.dtype):
+            blocks = blocks.astype(self.dtype)
+        self._work_batches.append((keys, blocks, summation))
+        self.valid = False
+
     def reserve_block(self, row: int, col: int) -> None:
         """Ref `dbcsr_reserve_block2d`: allocate a zero block."""
         row, col, _ = self._canonicalize(row, col, None)

@@ -23,6 +23,10 @@ from dbcsr_tpu.tas.base import TASMatrix
 from dbcsr_tpu.tas.split import choose_nsplit, estimate_split_factor
 from dbcsr_tpu.utils.rounding import ceil_div
 
+# ref default_nsplit_accept_ratio (`dbcsr_tas_split.F:57`): a cached
+# batch split survives while within this factor of the current optimum
+_NSPLIT_ACCEPT_RATIO = 3.0
+
 
 def _unwrap(x: Union[TASMatrix, BlockSparseMatrix]) -> BlockSparseMatrix:
     return x.matrix if isinstance(x, TASMatrix) else x
@@ -67,26 +71,46 @@ def tas_multiply(
 
     # batched-MM state machine (ref dbcsr_tas_mm.F:1595-1692): defer
     # filtering to the batch finalize, reuse the split decision
+    explicit_nsplit = nsplit is not None
     batch = getattr(c, "_tas_batched_state", None)
     if batch is not None:
         if filter_eps is not None:
             batch["filter_eps"] = filter_eps
         filter_eps = None
-        if nsplit is None:
+        if not explicit_nsplit:
             nsplit = batch.get("nsplit")
 
     with timed("tas_multiply"):
+        def _fresh_opt() -> int:
+            sf = estimate_split_factor(
+                m_full, n_full, k_full, a.nnz, b.nnz, c.nnz
+            )
+            long_blks = max(c.nblkrows, c.nblkcols, nblk_k)
+            return choose_nsplit(sf, ngroups_max, long_blks)
+
         if nsplit is None:
             for t in (matrix_a, matrix_b, matrix_c):
                 if isinstance(t, TASMatrix) and t.nsplit:
                     nsplit = t.nsplit
                     break
         if nsplit is None:
-            sf = estimate_split_factor(m_full, n_full, k_full, a.nnz, b.nnz, c.nnz)
-            long_blks = max(c.nblkrows, c.nblkcols, nblk_k)
-            nsplit = choose_nsplit(sf, ngroups_max, long_blks)
-        if batch is not None and batch.get("nsplit") is None:
-            batch["nsplit"] = nsplit  # reuse the split for the whole batch
+            nsplit = _fresh_opt()
+        if batch is not None:
+            if explicit_nsplit or batch.get("nsplit") is None:
+                batch["nsplit"] = nsplit  # (re)set the batch's split
+            else:
+                # split re-optimization between batches (the
+                # single-controller analog of the batched pgrid
+                # re-optimization, `dbcsr_tensor.F:1964-2186`): keep the
+                # cached split while it stays within the reference's
+                # acceptance window of the current-sparsity optimum
+                # (default_nsplit_accept_ratio = 3,
+                # `dbcsr_tas_split.F:57,229-230`), else re-split
+                opt = _fresh_opt()
+                ratio = _NSPLIT_ACCEPT_RATIO
+                if not (opt / ratio <= nsplit <= opt * ratio):
+                    batch["nsplit"] = nsplit = opt
+                    batch["resplit_count"] = batch.get("resplit_count", 0) + 1
 
         dims = {"m": m_full, "n": n_full, "k": k_full}
         long_dim = max(dims, key=dims.get)

@@ -10,14 +10,36 @@ result back.  `contract_a[i]` is contracted against `contract_b[i]`;
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dbcsr_tpu.core.timings import timed
 from dbcsr_tpu.ops.operations import scale
 from dbcsr_tpu.tas.mm import tas_multiply
 from dbcsr_tpu.tensor.types import BlockSparseTensor
+
+
+@functools.partial(jax.jit, static_argnames=("src_shape", "comb", "dst_shape"))
+def _remap_rows(bin_data, slots, *, src_shape, comb, dst_shape):
+    """Gather + per-block nd transpose + reshape, all on device: the
+    block-movement kernel of the reshape path (ref the buffered block
+    alltoall in `dbcsr_tensor_reshape.F:288`; here the 'communication'
+    is one fused device gather/permute)."""
+    x = jnp.take(bin_data, slots, axis=0).reshape((slots.shape[0],) + src_shape)
+    y = x.transpose((0,) + tuple(1 + i for i in comb))
+    return y.reshape((slots.shape[0],) + dst_shape)
+
+
+def _flat_multi(nd_idx: np.ndarray, dims: Sequence[int], nblks) -> np.ndarray:
+    """Vectorized mixed-radix linearization (C-order over `dims`)."""
+    f = np.zeros(len(nd_idx), np.int64)
+    for d in dims:
+        f = f * nblks[d] + nd_idx[:, d]
+    return f
 
 
 def remap(
@@ -27,15 +49,51 @@ def remap(
     name: Optional[str] = None,
 ) -> BlockSparseTensor:
     """Same tensor, different nd->2d mapping (ref `dbcsr_t_remap`,
-    `dbcsr_tensor.F:1604`)."""
+    `dbcsr_tensor.F:1604`).
+
+    Fully device-side: blocks are grouped by nd shape, gathered,
+    permuted and re-laid-out in one jitted op per shape group, then
+    staged into the output matrix without any host round-trip of block
+    data (the reference moves blocks with a buffered MPI alltoall,
+    `dbcsr_tensor_reshape.F:67,288`; the single-controller analog is
+    device gather/scatter)."""
     row_dims, col_dims = tuple(row_dims), tuple(col_dims)
     if (row_dims, col_dims) == (t.row_dims, t.col_dims):
         return t
+    t.finalize()
     out = BlockSparseTensor(
         name or t.name, t.blk_sizes, row_dims, col_dims, t.dtype
     )
-    for idx, blk in t.iterate_blocks():
-        out.put_block(idx, blk)
+    mat = t.matrix
+    n = mat.nblks
+    if n == 0:
+        return out.finalize()
+    nd_idx = t.entry_multi_coords()
+    nblks = t.nblks_per_dim
+    shp = np.empty((n, t.ndim), np.int64)
+    for d in range(t.ndim):
+        shp[:, d] = t.blk_sizes[d][nd_idx[:, d]]
+    _, ginv = np.unique(shp, axis=0, return_inverse=True)
+    old_perm = t.row_dims + t.col_dims
+    new_perm = row_dims + col_dims
+    comb = tuple(old_perm.index(d) for d in new_perm)
+    new_rows = _flat_multi(nd_idx, row_dims, nblks)
+    new_cols = _flat_multi(nd_idx, col_dims, nblks)
+    for g in range(ginv.max() + 1):
+        sel = np.nonzero(ginv == g)[0]
+        s = shp[sel[0]]
+        # one nd shape + one mapping -> one matrix shape -> one source bin
+        bid = mat.ent_bin[sel[0]]
+        src_shape = tuple(int(s[d]) for d in old_perm)
+        dst_shape = (
+            int(np.prod([s[d] for d in row_dims], dtype=np.int64)),
+            int(np.prod([s[d] for d in col_dims], dtype=np.int64)),
+        )
+        dev = _remap_rows(
+            mat.bins[bid].data, jnp.asarray(mat.ent_slot[sel]),
+            src_shape=src_shape, comb=comb, dst_shape=dst_shape,
+        )
+        out.matrix.stage_device_blocks(new_rows[sel], new_cols[sel], dev)
     return out.finalize()
 
 
@@ -43,11 +101,28 @@ def tensor_copy(
     dest: BlockSparseTensor, src: BlockSparseTensor, summation: bool = False
 ) -> BlockSparseTensor:
     """Copy blocks between same-shape tensors in any mappings
-    (ref `dbcsr_t_copy` -> `dbcsr_t_reshape`, `dbcsr_tensor_reshape.F:67`)."""
+    (ref `dbcsr_t_copy` -> `dbcsr_t_reshape`, `dbcsr_tensor_reshape.F:67`).
+
+    Device-side: src is remapped into dest's mapping (one fused
+    gather/permute per shape group), then its bins are staged into
+    dest's matrix and merged by the batched finalize — no host
+    round-trip of block data."""
     if dest.nblks_per_dim != src.nblks_per_dim:
         raise ValueError("tensor shapes differ")
-    for idx, blk in src.iterate_blocks():
-        dest.put_block(idx, blk, summation=summation)
+    src2 = remap(src, dest.row_dims, dest.col_dims)
+    src2.finalize()
+    mat = src2.matrix
+    nbc = mat.nblkcols
+    for b_id, b in enumerate(mat.bins):
+        if b.count == 0:
+            continue
+        sel = np.nonzero(mat.ent_bin == b_id)[0]
+        keys_by_slot = np.empty(b.count, np.int64)
+        keys_by_slot[mat.ent_slot[sel]] = mat.keys[sel]
+        dest.matrix.stage_device_blocks(
+            keys_by_slot // nbc, keys_by_slot % nbc,
+            b.data[: b.count], summation=summation,
+        )
     return dest.finalize()
 
 

@@ -52,6 +52,44 @@ def test_tensor_copy_between_mappings():
     np.testing.assert_array_equal(dst.to_dense(), src.to_dense())
 
 
+def test_tensor_copy_summation_and_preserved_blocks():
+    """summation adds into overlapping dest blocks; blocks only in dest
+    survive an overwrite copy (device-side merge semantics match the
+    old per-block path)."""
+    sizes = [[2, 2], [3], [2, 4]]
+    src = _rand_tensor("s", sizes, occ=0.6, row_dims=(0, 1), col_dims=(2,), seed=7)
+    base = _rand_tensor("d", sizes, occ=0.6, row_dims=(2,), col_dims=(1, 0), seed=8)
+
+    d_sum = create_tensor("ds", sizes, (2,), (1, 0))
+    tensor_copy(d_sum, base)
+    tensor_copy(d_sum, src, summation=True)
+    np.testing.assert_allclose(d_sum.to_dense(), base.to_dense() + src.to_dense(),
+                               rtol=1e-13, atol=1e-13)
+
+    d_ow = create_tensor("do", sizes, (2,), (1, 0))
+    tensor_copy(d_ow, base)
+    tensor_copy(d_ow, src)
+    want = base.to_dense().copy()
+    # src blocks overwrite; dest-only blocks survive
+    src_keys = set(map(tuple, np.asarray(src.block_indices())))
+    offs = [np.concatenate([[0], np.cumsum(s)]) for s in src.blk_sizes]
+    for idx, blk in src.iterate_blocks():
+        sl = tuple(slice(offs[d][idx[d]], offs[d][idx[d]] + blk.shape[d])
+                   for d in range(src.ndim))
+        want[sl] = blk
+    np.testing.assert_allclose(d_ow.to_dense(), want, rtol=1e-13, atol=1e-13)
+
+
+def test_rank4_remap_roundtrip():
+    """rank-4 remap across disjoint mappings is an exact bijection."""
+    sizes = [[2, 3], [2], [3, 2], [2, 2]]
+    t0 = _rand_tensor("t4", sizes, occ=0.5, row_dims=(0, 1), col_dims=(2, 3), seed=9)
+    t1 = remap(t0, (3, 1), (0, 2))
+    t2 = remap(t1, (0, 1), (2, 3))
+    np.testing.assert_array_equal(t0.to_dense(), t1.to_dense())
+    np.testing.assert_array_equal(t0.to_dense(), t2.to_dense())
+
+
 def test_contract_rank3_with_matrix():
     """T(i,j,k) * M(k,l) -> C(i,j,l)  (3-center integral pattern)."""
     si, sj, sk, sl = [2, 3], [3, 2], [4, 2], [2, 2]
@@ -208,4 +246,32 @@ def test_tas_batched_mm_state_machine():
         for rep in range(3):
             tas_multiply("N", "N", 1.0, a, b, 1.0, c, filter_eps=1e-12)
             want += to_dense(a) @ to_dense(b)
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-10, atol=1e-12)
+
+
+def test_tas_batched_split_reoptimizes_on_sparsity_change():
+    """The cached batch split is re-chosen when it leaves the
+    acceptance window of the current-sparsity optimum (the analog of
+    the batched pgrid re-optimization, `dbcsr_tensor.F:1964-2186`;
+    window = default_nsplit_accept_ratio, `dbcsr_tas_split.F:57`)."""
+    from dbcsr_tpu.ops.test_methods import make_random_matrix, to_dense
+    from dbcsr_tpu.tas import batched_mm, tas_multiply
+
+    rng = np.random.default_rng(43)
+    rbs = [3] * 64  # long m: optimum nsplit >> 1
+    cbs = [4, 4]
+    a = make_random_matrix("A", rbs, cbs, occupation=0.9, rng=rng)
+    b = make_random_matrix("B", cbs, cbs, occupation=1.0, rng=rng)
+    c = make_random_matrix("C", rbs, cbs, occupation=0.0, rng=rng)
+    want = np.zeros((sum(rbs), sum(cbs)))
+    with batched_mm(c, nsplit=1):  # deliberately stale split
+        state = c._tas_batched_state
+        tas_multiply("N", "N", 1.0, a, b, 1.0, c)
+        want += to_dense(a) @ to_dense(b)
+        assert state["nsplit"] > 1, "stale nsplit=1 should have been re-chosen"
+        assert state.get("resplit_count", 0) == 1
+        tas_multiply("N", "N", 1.0, a, b, 1.0, c)
+        want += to_dense(a) @ to_dense(b)
+        # second call: cached split now optimal, no further re-split
+        assert state.get("resplit_count", 0) == 1
     np.testing.assert_allclose(to_dense(c), want, rtol=1e-10, atol=1e-12)
