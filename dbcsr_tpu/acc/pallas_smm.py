@@ -40,8 +40,6 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _SUPPORTED = (np.dtype(np.float32), np.dtype(jnp.bfloat16))
-# blocks bigger than this blow the VMEM budget for 2*R in-flight panels
-_MAX_DIM = 256
 
 
 def supports(c_data, a_data, b_data) -> bool:
@@ -51,8 +49,14 @@ def supports(c_data, a_data, b_data) -> bool:
         return False
     if jnp.dtype(b_data.dtype) != jnp.dtype(c_data.dtype):
         return False
+    from dbcsr_tpu.core.config import get_config
+
+    # blocks bigger than max_kernel_dim blow the VMEM budget for 2*R
+    # in-flight panels and take the XLA dot path instead (the role of
+    # the reference's max_kernel_dim=80 cuBLAS fallback,
+    # `libsmm_acc.cpp:227-249`)
     dims = a_data.shape[1:] + b_data.shape[1:] + c_data.shape[1:]
-    return max(dims) <= _MAX_DIM
+    return max(dims) <= get_config().max_kernel_dim
 
 
 def _choose_grouping(run_lengths: np.ndarray) -> int:

@@ -41,12 +41,28 @@ class Config:
     flat_gather: bool = False
     # keep per-(m,n,k) flop statistics (ref STATISTICS block)
     keep_stats: bool = True
+    # largest block dim the fused Pallas kernel handles; bigger blocks
+    # take the XLA dot path (ref max_kernel_dim=80 with cuBLAS-loop
+    # fallback, dbcsr_config.F:177, libsmm_acc.cpp:227-249)
+    max_kernel_dim: int = 256
+    # multiplier on the TAS split-factor estimate
+    # (ref TAS_SPLIT_FACTOR, dbcsr_config.F:170)
+    tas_split_factor: float = 1.0
+    # default 2.5D k-layer count for auto-built meshes
+    # (ref NUM_LAYERS_3D, dbcsr_config.F:152); 0/None = largest square
+    num_layers_3d: int = 0
 
     def validate(self) -> None:
         if self.mm_driver not in ("auto", "xla", "pallas", "dense"):
             raise ValueError(f"unknown mm_driver {self.mm_driver!r}")
         if self.mm_stack_size <= 0:
             raise ValueError("mm_stack_size must be positive")
+        if self.max_kernel_dim <= 0:
+            raise ValueError("max_kernel_dim must be positive")
+        if self.tas_split_factor <= 0:
+            raise ValueError("tas_split_factor must be positive")
+        if self.num_layers_3d < 0:
+            raise ValueError("num_layers_3d must be >= 0")
 
 
 _cfg = Config()
@@ -77,12 +93,17 @@ def get_config() -> Config:
 
 
 def set_config(**kwargs) -> None:
-    """Programmatic config update (ref `dbcsr_set_config`)."""
-    for k, v in kwargs.items():
+    """Programmatic config update (ref `dbcsr_set_config`).
+
+    Validates on a candidate copy first: a rejected update must leave
+    the live config untouched."""
+    for k in kwargs:
         if not hasattr(_cfg, k):
             raise ValueError(f"unknown config key {k!r}")
+    candidate = dataclasses.replace(_cfg, **kwargs)
+    candidate.validate()
+    for k, v in kwargs.items():
         setattr(_cfg, k, v)
-    _cfg.validate()
 
 
 def print_config(out=print) -> None:

@@ -225,6 +225,22 @@ class BlockSparseMatrix:
         )
         self.valid = False
 
+    def _validate_coords(self, rows: np.ndarray, cols: np.ndarray) -> None:
+        if rows.min() < 0 or rows.max() >= self.nblkrows or cols.min() < 0 or (
+            cols.max() >= self.nblkcols
+        ):
+            raise IndexError("block coordinates out of range")
+
+    def _validate_batch_shape(self, rows, cols, bm: int, bn: int) -> None:
+        if not (
+            np.all(self.row_blk_sizes[rows] == bm)
+            and np.all(self.col_blk_sizes[cols] == bn)
+        ):
+            raise ValueError(
+                f"batch of shape ({bm},{bn}) does not match the blocking "
+                f"at all its coordinates"
+            )
+
     def _make_batches(self, rows, cols, blocks, summation: bool):
         """Canonicalize (symmetry fold), validate, group by block shape,
         and pre-reduce duplicates; returns [(keys, (N,bm,bn) array,
@@ -235,10 +251,7 @@ class BlockSparseMatrix:
             raise ValueError("rows/cols length mismatch")
         if len(rows) == 0:
             return []
-        if rows.min() < 0 or rows.max() >= self.nblkrows or cols.min() < 0 or (
-            cols.max() >= self.nblkcols
-        ):
-            raise IndexError("block coordinates out of range")
+        self._validate_coords(rows, cols)
         uniform = isinstance(blocks, np.ndarray) and blocks.ndim == 3
         if not uniform and len(blocks) != len(rows):
             raise ValueError("blocks length mismatch")
@@ -269,14 +282,7 @@ class BlockSparseMatrix:
         for idx, arr in groups:
             r, c = rows[idx], cols[idx]
             bm, bn = arr.shape[1], arr.shape[2]
-            if not (
-                np.all(self.row_blk_sizes[r] == bm)
-                and np.all(self.col_blk_sizes[c] == bn)
-            ):
-                raise ValueError(
-                    f"batch of shape ({bm},{bn}) does not match the blocking "
-                    f"at all its coordinates"
-                )
+            self._validate_batch_shape(r, c, bm, bn)
             keys = r * self.nblkcols + c
             if len(np.unique(keys)) != len(keys):
                 if summation:
@@ -315,19 +321,8 @@ class BlockSparseMatrix:
             raise ValueError("rows/cols/blocks length mismatch")
         if len(rows) == 0:
             return
-        if rows.min() < 0 or rows.max() >= self.nblkrows or cols.min() < 0 or (
-            cols.max() >= self.nblkcols
-        ):
-            raise IndexError("block coordinates out of range")
-        bm, bn = int(blocks.shape[1]), int(blocks.shape[2])
-        if not (
-            np.all(self.row_blk_sizes[rows] == bm)
-            and np.all(self.col_blk_sizes[cols] == bn)
-        ):
-            raise ValueError(
-                f"batch of shape ({bm},{bn}) does not match the blocking "
-                f"at all its coordinates"
-            )
+        self._validate_coords(rows, cols)
+        self._validate_batch_shape(rows, cols, int(blocks.shape[1]), int(blocks.shape[2]))
         keys = rows * self.nblkcols + cols
         if blocks.dtype != np.dtype(self.dtype):
             blocks = blocks.astype(self.dtype)

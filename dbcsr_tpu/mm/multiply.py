@@ -174,11 +174,6 @@ def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits) -> bool:
         return False
     if c.matrix_type != NO_SYMMETRY:
         return False
-    # uniform blocking in every dimension (the reference re-blocks matrices
-    # to a dense blocking instead; round-1 scope: already-uniform only)
-    for m in (a, b, c):
-        if len(np.unique(m.row_blk_sizes)) > 1 or len(np.unique(m.col_blk_sizes)) > 1:
-            return False
     if cfg.mm_dense is True or cfg.mm_driver == "dense":
         return True
     th = cfg.dense_occ_threshold
@@ -206,10 +201,101 @@ def _dense_product_to_blocks(ad, bd, c_blocks, c_rows, c_cols, alpha, beta, nbr,
     return out.reshape(nbr * nbc, bm, bn)
 
 
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("bm", "bn"))
+def _scatter_bin_to_canvas(canvas, blocks, row_off, col_off, bm: int, bn: int):
+    """Scatter an (N, bm, bn) bin onto a dense (M, K) canvas at element
+    offsets — the make_dense data movement, on device."""
+    r_idx = row_off[:, None, None] + jnp.arange(bm)[None, :, None]
+    c_idx = col_off[:, None, None] + jnp.arange(bn)[None, None, :]
+    return canvas.at[r_idx, c_idx].set(blocks)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn"))
+def _gather_bin_from_canvas(canvas, row_off, col_off, bm: int, bn: int):
+    """Inverse carve: (N, bm, bn) patches from a dense canvas."""
+    r_idx = row_off[:, None, None] + jnp.arange(bm)[None, :, None]
+    c_idx = col_off[:, None, None] + jnp.arange(bn)[None, None, :]
+    return canvas[r_idx, c_idx]
+
+
+def _to_dense_device(m: BlockSparseMatrix):
+    """Densify a (possibly non-uniformly blocked) matrix on device."""
+    canvas = jnp.zeros((m.nfullrows, m.nfullcols), m.dtype)
+    if m.nblks == 0:
+        return canvas
+    rows, cols = m.entry_coords()
+    roff = m.row_blk_offsets[rows]
+    coff = m.col_blk_offsets[cols]
+    for b_id, b in enumerate(m.bins):
+        if b.count == 0:
+            continue
+        sel = np.nonzero(m.ent_bin == b_id)[0]
+        ro = np.empty(b.count, np.int64)
+        co = np.empty(b.count, np.int64)
+        ro[m.ent_slot[sel]] = roff[sel]
+        co[m.ent_slot[sel]] = coff[sel]
+        canvas = _scatter_bin_to_canvas(
+            canvas, b.data[: b.count], jnp.asarray(ro), jnp.asarray(co),
+            bm=b.shape[0], bn=b.shape[1],
+        )
+    return canvas
+
+
+def _dense_multiply_general(a, b, c, alpha, beta) -> int:
+    """Dense mode for arbitrary (non-uniform) blockings: densify on
+    device, one MXU matmul, carve C back into its own full blocking
+    (the `dbcsr_make_dense`/`dbcsr_make_undense` re-blocking pair,
+    `dbcsr_mm.F:593-617`, generalized to one flat dense canvas)."""
+    ad = _to_dense_device(a)
+    bd = _to_dense_device(b)
+    acc = ad.dtype
+    cd = jax.lax.dot_general(
+        ad, bd, (((1,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=acc,
+    )
+    alpha_dev = jnp.asarray(alpha, dtype=c.dtype)
+    beta_dev = jnp.asarray(beta, dtype=c.dtype)
+    cd = alpha_dev * cd
+    if beta != 0 and c.nblks:
+        cd = cd + beta_dev * _to_dense_device(c)
+    # carve into C's full pattern, bin by bin
+    nbr, nbc = c.nblkrows, c.nblkcols
+    new_keys = np.arange(nbr * nbc, dtype=np.int64)
+    rows = new_keys // nbc
+    cols = new_keys % nbc
+    nb, nsl, shapes = _bin_entries(c.row_blk_sizes, c.col_blk_sizes, rows, cols)
+    roff = c.row_blk_offsets[rows]
+    coff = c.col_blk_offsets[cols]
+    bins = []
+    for b_id, (bm, bn) in enumerate(shapes):
+        sel = np.nonzero(nb == b_id)[0]
+        count = len(sel)
+        ro = np.empty(count, np.int64)
+        co = np.empty(count, np.int64)
+        ro[nsl[sel]] = roff[sel]
+        co[nsl[sel]] = coff[sel]
+        data = _gather_bin_from_canvas(
+            cd, jnp.asarray(ro), jnp.asarray(co), bm=int(bm), bn=int(bn)
+        )
+        cap = bucket_size(count)
+        if cap > count:
+            data = jnp.concatenate(
+                [data, jnp.zeros((cap - count, int(bm), int(bn)), data.dtype)]
+            )
+        bins.append(_Bin((int(bm), int(bn)), data, count))
+    c.set_structure_from_device(new_keys, bins, binning=(nb, nsl, shapes))
+    flops = 2 * c.nfullrows * c.nfullcols * a.nfullcols
+    stats.record_multiply(flops)
+    return flops
+
+
 def _dense_multiply(a, b, c, alpha, beta) -> int:
     """Dense-mode path: scatter blocks to dense, one MXU matmul, carve C
     back into a full block pattern (ref `dbcsr_make_dense` +
     `use_dense_mult`, `dbcsr_mm.F:593-617,770-810`)."""
+    for m in (a, b, c):
+        if len(np.unique(m.row_blk_sizes)) > 1 or len(np.unique(m.col_blk_sizes)) > 1:
+            return _dense_multiply_general(a, b, c, alpha, beta)
     bm = int(c.row_blk_sizes[0])
     bn = int(c.col_blk_sizes[0])
     bk = int(a.col_blk_sizes[0])

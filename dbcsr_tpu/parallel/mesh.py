@@ -19,7 +19,15 @@ from jax.sharding import Mesh
 
 def grid_shape(n_devices: int, layers: Optional[int] = None) -> Tuple[int, int]:
     """Pick (kl, s) with kl * s * s == n_devices, preferring the largest
-    square grid (fewest layers)."""
+    square grid (fewest layers).  ``layers=None`` consults the
+    NUM_LAYERS_3D analog (`config.num_layers_3d`, ref
+    `dbcsr_config.F:152`) before auto-choosing."""
+    if layers is None:
+        from dbcsr_tpu.core.config import get_config
+
+        cfg_layers = get_config().num_layers_3d
+        if cfg_layers and cfg_layers > 1:
+            layers = cfg_layers
     if layers is not None:
         s2, rem = divmod(n_devices, layers)
         s = int(round(np.sqrt(s2)))

@@ -82,9 +82,11 @@ def tas_multiply(
 
     with timed("tas_multiply"):
         def _fresh_opt() -> int:
+            from dbcsr_tpu.core.config import get_config
+
             sf = estimate_split_factor(
                 m_full, n_full, k_full, a.nnz, b.nnz, c.nnz
-            )
+            ) * get_config().tas_split_factor  # ref TAS_SPLIT_FACTOR knob
             long_blks = max(c.nblkrows, c.nblkcols, nblk_k)
             return choose_nsplit(sf, ngroups_max, long_blks)
 
@@ -105,12 +107,23 @@ def tas_multiply(
                 # cached split while it stays within the reference's
                 # acceptance window of the current-sparsity optimum
                 # (default_nsplit_accept_ratio = 3,
-                # `dbcsr_tas_split.F:57,229-230`), else re-split
-                opt = _fresh_opt()
+                # `dbcsr_tas_split.F:57,229-230`), else re-split.
+                # nnz reads are O(nblks) host work, so the optimum is
+                # only recomputed when the O(1) block-count triple
+                # drifted beyond the acceptance ratio since last checked
                 ratio = _NSPLIT_ACCEPT_RATIO
-                if not (opt / ratio <= nsplit <= opt * ratio):
-                    batch["nsplit"] = nsplit = opt
-                    batch["resplit_count"] = batch.get("resplit_count", 0) + 1
+                cnt_now = (a.nblks, b.nblks, c.nblks)
+                cnt_ref = batch.get("nblks_checked")
+                drifted = cnt_ref is None or any(
+                    now > ratio * max(ref, 1) or now * ratio < ref
+                    for now, ref in zip(cnt_now, cnt_ref)
+                )
+                if drifted:
+                    batch["nblks_checked"] = cnt_now
+                    opt = _fresh_opt()
+                    if not (opt / ratio <= nsplit <= opt * ratio):
+                        batch["nsplit"] = nsplit = opt
+                        batch["resplit_count"] = batch.get("resplit_count", 0) + 1
 
         dims = {"m": m_full, "n": n_full, "k": k_full}
         long_dim = max(dims, key=dims.get)

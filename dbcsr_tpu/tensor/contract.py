@@ -109,6 +109,12 @@ def tensor_copy(
     round-trip of block data."""
     if dest.nblks_per_dim != src.nblks_per_dim:
         raise ValueError("tensor shapes differ")
+    for d in range(src.ndim):
+        # per-dim block sizes must match, not just counts: different
+        # blockings can flatten to identical matrix block shapes and
+        # would otherwise copy with silently reinterpreted data
+        if not np.array_equal(dest.blk_sizes[d], src.blk_sizes[d]):
+            raise ValueError(f"tensor dim {d} blockings differ")
     src2 = remap(src, dest.row_dims, dest.col_dims)
     src2.finalize()
     mat = src2.matrix

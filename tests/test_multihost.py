@@ -1,0 +1,85 @@
+"""Multi-host runtime tests (single-process semantics + a real 1-process
+world join over the coordinator service).
+
+Ref `mp_world_init`/`mp_world_finalize` (`dbcsr_mpiwrap.F:596`) and the
+serial-stub fallback (`dbcsr_mpiwrap.F:130-150`).
+"""
+
+import numpy as np
+
+from dbcsr_tpu.parallel import multihost
+
+
+def test_serial_stub_semantics():
+    assert multihost.process_count() == 1
+    assert multihost.process_id() == 0
+    assert multihost.is_coordinator()
+
+
+def test_multihost_grid_single_process_runs_cannon():
+    """make_multihost_grid == make_grid single-host; the resulting mesh
+    drives the flagship sparse Cannon."""
+    mesh = multihost.make_multihost_grid()
+    assert tuple(mesh.axis_names) == ("kl", "pr", "pc")
+    assert int(np.prod(list(mesh.shape.values()))) == 8
+
+    from dbcsr_tpu.ops.test_methods import make_random_matrix, to_dense
+    from dbcsr_tpu.parallel.sparse_dist import sparse_multiply_distributed
+
+    rng = np.random.default_rng(5)
+    sizes = [3] * 8
+    a = make_random_matrix("A", sizes, sizes, occupation=0.5, rng=rng)
+    b = make_random_matrix("B", sizes, sizes, occupation=0.5, rng=rng)
+    c = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh)
+    np.testing.assert_allclose(
+        to_dense(c), to_dense(a) @ to_dense(b), rtol=1e-12, atol=1e-12
+    )
+
+
+def test_auto_join_without_cluster_returns_false():
+    """No cluster env to auto-detect -> serial-stub semantics (ref
+    `!defined(__parallel)` stubs, dbcsr_mpiwrap.F:130-150).  The JAX
+    backend is already initialized by this suite, which initialize()
+    correctly refuses — either way the contract is: return False, stay
+    single-process, don't raise."""
+    assert multihost.init_multihost() is False
+    assert multihost.process_count() == 1
+
+
+def test_explicit_join_failure_propagates(monkeypatch):
+    """An explicit coordinator spec must NOT degrade silently: a failed
+    join raises (the multiply would otherwise run on a fraction of the
+    data)."""
+    import jax
+    import pytest
+
+    def boom(**kw):
+        raise RuntimeError("no coordinator")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    with pytest.raises(RuntimeError, match="no coordinator"):
+        multihost.init_multihost(
+            coordinator_address="localhost:1", num_processes=2, process_id=0
+        )
+
+
+def test_multihost_layout_falls_back_with_warning(monkeypatch):
+    """Multi-process path: when mesh_utils cannot build an ICI-aware
+    layout, enumeration order is used and the DCN-crossing risk is
+    warned about."""
+    import warnings
+
+    import jax
+    from jax.experimental import mesh_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    def no_mesh(shape, devices=None):
+        raise ValueError("unsupported topology")
+
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", no_mesh)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        mesh = multihost.make_multihost_grid()
+    assert tuple(mesh.axis_names) == ("kl", "pr", "pc")
+    assert any("DCN" in str(x.message) for x in w)

@@ -240,6 +240,44 @@ def test_dense_mode_matches_sparse_path():
                                rtol=1e-12, atol=1e-12)
 
 
+def test_dense_mode_nonuniform_blocking_matches_sparse_path():
+    """Non-uniform blockings now take the general make_dense path
+    (densify -> one matmul -> carve back into the original blocking,
+    ref dbcsr_make_dense/undense, dbcsr_mm.F:593-617)."""
+    from dbcsr_tpu.core.config import set_config
+
+    rbs, cbs, kbs = [3, 5, 2, 4], [4, 2, 5], [2, 6, 3]
+    a = _rand("a", rbs, kbs, 1.0, seed=60)
+    b = _rand("b", kbs, cbs, 1.0, seed=61)
+    c_dense = _rand("c", rbs, cbs, 0.5, seed=62)
+    c_sparse = c_dense.copy()
+    set_config(mm_dense=True)
+    try:
+        multiply("N", "N", 1.5, a, b, 0.5, c_dense)
+    finally:
+        set_config(mm_dense=None)
+    set_config(mm_dense=False)
+    try:
+        multiply("N", "N", 1.5, a, b, 0.5, c_sparse)
+    finally:
+        set_config(mm_dense=None)
+    # dense mode leaves a full pattern; values must agree everywhere
+    np.testing.assert_allclose(to_dense(c_dense), to_dense(c_sparse),
+                               rtol=1e-12, atol=1e-12)
+    assert c_dense.nblks == len(rbs) * len(cbs)
+
+
+def test_dense_mode_nonuniform_auto_at_full_occupancy():
+    """occ=1 non-uniform matrices take dense mode automatically."""
+    rbs, kbs = [3, 5, 4], [2, 6]
+    a = _rand("a", rbs, kbs, 1.0, seed=63)
+    b = _rand("b", kbs, rbs, 1.0, seed=64)
+    c = create("c", rbs, rbs)
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    want = np.asarray(to_dense(a)) @ np.asarray(to_dense(b))
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-12, atol=1e-12)
+
+
 def test_dense_mode_not_used_with_filter():
     """filter_eps forces the sparse path even at occ=1."""
     rbs = [4] * 4

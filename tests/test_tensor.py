@@ -80,6 +80,17 @@ def test_tensor_copy_summation_and_preserved_blocks():
     np.testing.assert_allclose(d_ow.to_dense(), want, rtol=1e-13, atol=1e-13)
 
 
+def test_tensor_copy_rejects_mismatched_blockings():
+    """Per-dim blockings that flatten to the same matrix block shape
+    must still be rejected (data would be silently reinterpreted)."""
+    src = create_tensor("s", [[2], [3]], (0, 1), ())
+    src.put_block((0, 0), np.arange(6.0).reshape(2, 3))
+    src.finalize()
+    dst = create_tensor("d", [[3], [2]], (0, 1), ())
+    with pytest.raises(ValueError, match="blockings differ"):
+        tensor_copy(dst, src)
+
+
 def test_rank4_remap_roundtrip():
     """rank-4 remap across disjoint mappings is an exact bijection."""
     sizes = [[2, 3], [2], [3, 2], [2, 2]]
