@@ -49,7 +49,9 @@ class Config:
     # (ref TAS_SPLIT_FACTOR, dbcsr_config.F:170)
     tas_split_factor: float = 1.0
     # default 2.5D k-layer count for auto-built meshes
-    # (ref NUM_LAYERS_3D, dbcsr_config.F:152); 0/None = largest square
+    # (ref NUM_LAYERS_3D, dbcsr_config.F:152); 0 = auto (largest square),
+    # any value >= 1 is honored exactly (1 forces a 2D grid and raises
+    # when the device count is not a square)
     num_layers_3d: int = 0
 
     def validate(self) -> None:

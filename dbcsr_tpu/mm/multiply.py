@@ -204,10 +204,13 @@ def _dense_product_to_blocks(ad, bd, c_blocks, c_rows, c_cols, alpha, beta, nbr,
 @functools.partial(jax.jit, donate_argnums=0, static_argnames=("bm", "bn"))
 def _scatter_bin_to_canvas(canvas, blocks, row_off, col_off, bm: int, bn: int):
     """Scatter an (N, bm, bn) bin onto a dense (M, K) canvas at element
-    offsets — the make_dense data movement, on device."""
+    offsets — the make_dense data movement, on device.  Slots whose
+    offsets are out of range are dropped (callers pass the bin's FULL
+    bucket-padded buffer with out-of-range offsets for dead slots, so
+    the jit shape is the stable bucket capacity, not the live count)."""
     r_idx = row_off[:, None, None] + jnp.arange(bm)[None, :, None]
     c_idx = col_off[:, None, None] + jnp.arange(bn)[None, None, :]
-    return canvas.at[r_idx, c_idx].set(blocks)
+    return canvas.at[r_idx, c_idx].set(blocks, mode="drop")
 
 
 @functools.partial(jax.jit, static_argnames=("bm", "bn"))
@@ -230,12 +233,15 @@ def _to_dense_device(m: BlockSparseMatrix):
         if b.count == 0:
             continue
         sel = np.nonzero(m.ent_bin == b_id)[0]
-        ro = np.empty(b.count, np.int64)
-        co = np.empty(b.count, np.int64)
+        cap = b.data.shape[0]
+        # dead (bucket-padding) slots get out-of-range offsets -> dropped;
+        # the full-capacity buffer keeps the jit shape stable across counts
+        ro = np.full(cap, m.nfullrows, np.int64)
+        co = np.full(cap, m.nfullcols, np.int64)
         ro[m.ent_slot[sel]] = roff[sel]
         co[m.ent_slot[sel]] = coff[sel]
         canvas = _scatter_bin_to_canvas(
-            canvas, b.data[: b.count], jnp.asarray(ro), jnp.asarray(co),
+            canvas, b.data, jnp.asarray(ro), jnp.asarray(co),
             bm=b.shape[0], bn=b.shape[1],
         )
     return canvas

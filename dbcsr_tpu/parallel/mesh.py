@@ -26,7 +26,9 @@ def grid_shape(n_devices: int, layers: Optional[int] = None) -> Tuple[int, int]:
         from dbcsr_tpu.core.config import get_config
 
         cfg_layers = get_config().num_layers_3d
-        if cfg_layers and cfg_layers > 1:
+        if cfg_layers >= 1:
+            # honored like an explicit argument, incl. 1 = "force a 2D
+            # grid" (raises when n_devices is not a square)
             layers = cfg_layers
     if layers is not None:
         s2, rem = divmod(n_devices, layers)

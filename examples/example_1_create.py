@@ -1,0 +1,41 @@
+"""Create a block-sparse matrix, put/reserve blocks, iterate.
+
+Analog of `dbcsr_example_1.F` (matrix creation on a 2D grid): here the
+"process grid" is implicit — the host index is global and device data
+lives in per-shape bins; a `Distribution` can be attached for the mesh
+engine (see example_3).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from dbcsr_tpu import create, init_lib
+
+
+def main():
+    init_lib()
+    # 4x4 block grid with mixed block sizes (ref: row_blk_sizes=(/2,3,5,2/))
+    row_sizes = [2, 3, 5, 2]
+    col_sizes = [3, 2, 4, 3]
+    m = create("matrix a", row_sizes, col_sizes)
+
+    rng = np.random.default_rng(0)
+    # put the blocks of a checkerboard pattern
+    for i in range(4):
+        for j in range(4):
+            if (i + j) % 2 == 0:
+                m.put_block(i, j, rng.standard_normal((row_sizes[i], col_sizes[j])))
+    m.reserve_block(1, 2)  # allocate a zero block (ref dbcsr_reserve_block2d)
+    m.finalize()
+
+    print(m)
+    for i, j, blk in m.iterate_blocks():
+        print(f"  block ({i},{j}) shape {blk.shape} |.|={np.linalg.norm(blk):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Rank-3 tensor contraction: (13|2) x (54|21) = (3|45).
+
+Analog of `dbcsr_tensor_example_2.cpp`: tensor1(i,j,k) stored with
+mapping rows=(0,2)|cols=(1,), tensor2(k,l,m) with rows=(3,4)|cols=(1,0)
+(0-based per-tensor dims), contracted over (i,j) to give
+tensor3(k,l,m) = sum_ij t1(i,j,k) t2(k... ) — concretely here:
+
+    t3[k,l,m] = sum_ij t1[i,j,k] * t2[l,m,j,i]   (rank-4 t2 variant
+    collapsed to the reference's index pattern with a rank-3 t2)
+
+We use the reference's published index pattern (13|2)x(54|21)=(3|45):
+t1 dims (1,3|2) -> a rank-3 tensor contracted with t2 over dims (1,2),
+result mapped (3|45).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from dbcsr_tpu import init_lib
+from dbcsr_tpu.tensor import contract, create_tensor
+
+
+def fill_random(t, occ, seed):
+    rng = np.random.default_rng(seed)
+    nblks = t.nblks_per_dim
+    for idx in np.ndindex(*nblks):
+        if rng.random() < occ:
+            t.put_block(idx, rng.standard_normal(t.block_shape(idx)))
+    return t.finalize()
+
+
+def main():
+    init_lib()
+    si, sj, sk, sl, sm = [2, 3], [3, 2], [4, 2], [2, 2], [3, 1]
+    # tensor1(i,j,k): mapping (1,3|2) = rows (i,k) cols (j)
+    t1 = create_tensor("t1", [si, sj, sk], row_dims=(0, 2), col_dims=(1,))
+    # tensor2(j,i,l,m) ~ (54|21): rows (l,m) cols (j,i)
+    t2 = create_tensor("t2", [sj, si, sl, sm], row_dims=(2, 3), col_dims=(0, 1))
+    # tensor3(k,l,m): mapping (3|45) = rows (k) cols (l,m)
+    t3 = create_tensor("t3", [sk, sl, sm], row_dims=(0,), col_dims=(1, 2))
+    fill_random(t1, 0.6, seed=10)
+    fill_random(t2, 0.6, seed=11)
+    t3.finalize()
+
+    # t3[k,l,m] = sum_ij t1[i,j,k] t2[j,i,l,m]
+    flops = contract(
+        1.0, t1, t2, 0.0, t3,
+        contract_a=(0, 1), notcontract_a=(2,),
+        contract_b=(1, 0), notcontract_b=(2, 3),
+        map_1=(0,), map_2=(1, 2),
+    )
+    want = np.einsum("ijk,jilm->klm", t1.to_dense(), t2.to_dense())
+    err = np.abs(t3.to_dense() - want).max()
+    print(f"contract (13|2)x(54|21)=(3|45): {flops:,} flops, max|err| {err:.2e}")
+    assert err < 1e-12
+
+
+if __name__ == "__main__":
+    main()

@@ -98,3 +98,11 @@ def test_num_layers_3d_shapes_default_grid():
     finally:
         set_config(num_layers_3d=0)
     assert grid_shape(8, layers=2) == (2, 2)  # explicit wins
+    # num_layers_3d=1 is honored (forces a 2D grid), not treated as auto
+    set_config(num_layers_3d=1)
+    try:
+        assert grid_shape(4) == (1, 2)
+        with pytest.raises(ValueError):
+            grid_shape(8)  # 8 devices cannot form a 1-layer square grid
+    finally:
+        set_config(num_layers_3d=0)

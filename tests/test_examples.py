@@ -1,0 +1,31 @@
+"""The examples must stay runnable (ref `examples/` + WITH_EXAMPLES CI)."""
+
+import os
+import runpy
+
+import pytest
+
+EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"
+)
+
+
+@pytest.mark.parametrize("name", [
+    "example_1_create.py",
+    "example_2_set.py",
+    "example_3_multiply.py",
+    "tensor_example_contract.py",
+])
+def test_example_runs(name, capsys):
+    runpy.run_path(os.path.join(EXAMPLES, name), run_name="__main__")
+    assert capsys.readouterr().out  # printed something
+
+
+def test_example_3_engines_agree(capsys):
+    """Single-chip and mesh runs print identical checksums."""
+    runpy.run_path(os.path.join(EXAMPLES, "example_3_multiply.py"),
+                   run_name="__main__")
+    out = capsys.readouterr().out
+    sums = [ln.split("checksum")[1].strip() for ln in out.splitlines()
+            if "checksum" in ln]
+    assert len(sums) == 2 and sums[0] == sums[1]
