@@ -52,6 +52,20 @@ def _scatter_scaled(dst, src, src_slots, dst_slots, beta):
     return dst.at[dst_slots].set(beta * jnp.take(src, src_slots, axis=0), mode="drop")
 
 
+@jax.jit
+def _scatter_scaled_window(dst, src, src_slots, dst_slots, beta, rl, rh, cl, ch):
+    """Scatter blocks applying beta only to the in-window element range
+    (rl..rh, cl..ch per block, inclusive) — straddling blocks of a
+    windowed-beta multiply (ref: the windowed dgemm touches only the
+    limited submatrix, `dbcsr_test_multiply.F:631-633`)."""
+    from dbcsr_tpu.ops.operations import window_mask
+
+    blk = jnp.take(src, src_slots, axis=0)
+    mask = window_mask(blk.shape[1], blk.shape[2], rl, rh, cl, ch)
+    factor = jnp.where(mask, beta, jnp.ones((), dst.dtype))
+    return dst.at[dst_slots].set(blk * factor, mode="drop")
+
+
 def _effective(matrix: BlockSparseMatrix, trans: str) -> BlockSparseMatrix:
     """Resolve op(X): desymmetrize + transpose/conjugate as needed
     (ref transpose wrappers at `dbcsr_mm.F:521-582`)."""
@@ -82,12 +96,23 @@ def multiply(
     last_col: Optional[int] = None,
     first_k: Optional[int] = None,
     last_k: Optional[int] = None,
+    element_limits=None,
 ) -> int:
     """Multiply two block-sparse matrices; returns the true flop count.
 
     The optional first/last row/col/k limits restrict the product to a
-    block-index submatrix (0-based, inclusive), mirroring the
-    `dbcsr_multiply` limit arguments.
+    block-index submatrix (0-based, inclusive).  ``element_limits``
+    instead gives the reference `dbcsr_multiply` limit arguments at
+    ELEMENT granularity — a 6-tuple (first_row, last_row, first_col,
+    last_col, first_k, last_k) of 0-based inclusive element indices
+    (None entries = open): limits that don't align with block
+    boundaries are honored exactly, by cropping op(A)/op(B) at element
+    level (ref `dbcsr_crop_matrix` inside `make_m2s`,
+    `dbcsr_mm_cannon.F:194-220`).
+
+    With limits, beta scales C only INSIDE the limited window — C
+    elements outside keep their old values, like the reference's
+    windowed dgemm (`dbcsr_test_multiply.F:631-633`).
     """
     with timed("multiply"):
         for m in (matrix_a, matrix_b, matrix_c):
@@ -110,6 +135,23 @@ def multiply(
             raise ValueError("C row blocking != op(A) row blocking")
         if not np.array_equal(c.col_blk_sizes, b.col_blk_sizes):
             raise ValueError("C col blocking != op(B) col blocking")
+
+        beta_window = None
+        if element_limits is not None:
+            if any(x is not None for x in (first_row, last_row, first_col,
+                                           last_col, first_k, last_k)):
+                raise ValueError("give block-index OR element limits, not both")
+            (a, b, (first_row, last_row, first_col, last_col, first_k, last_k),
+             beta_window) = _apply_element_limits(a, b, c, element_limits)
+        elif any(x is not None for x in (first_row, last_row, first_col, last_col)):
+            # windowed beta semantics for block limits too
+            roff, coff = c.row_blk_offsets, c.col_blk_offsets
+            beta_window = (
+                int(roff[first_row]) if first_row is not None else 0,
+                int(roff[last_row + 1]) - 1 if last_row is not None else c.nfullrows - 1,
+                int(coff[first_col]) if first_col is not None else 0,
+                int(coff[last_col + 1]) - 1 if last_col is not None else c.nfullcols - 1,
+            )
 
         no_limits = all(
             x is None for x in (first_row, last_row, first_col, last_col, first_k, last_k)
@@ -136,7 +178,7 @@ def multiply(
                 new_keys = np.union1d(old_keys, np.unique(cand_keys))
 
         with timed("multiply_c_assemble"):
-            _rebuild_c(c, new_keys, beta)
+            _rebuild_c(c, new_keys, beta, beta_window=beta_window)
 
         with timed("multiply_stacks"):
             flops = _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha)
@@ -340,6 +382,53 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
     return flops
 
 
+def _apply_element_limits(a, b, c, element_limits):
+    """Resolve element-granular limits (ref `dbcsr_multiply`'s full-
+    index limit args).  Block-aligned limits reduce to block-index
+    limits; unaligned ones additionally crop op(A)/op(B) at element
+    level (ref `dbcsr_crop_matrix` in `make_m2s`,
+    `dbcsr_mm_cannon.F:194-220`) so partial boundary blocks contribute
+    only their in-window elements.
+
+    Returns (a, b, block_limits, beta_window)."""
+    if len(element_limits) != 6:
+        raise ValueError("element_limits must be a 6-tuple")
+    fr, lr, fc, lc, fk, lk = element_limits
+    fr = 0 if fr is None else int(fr)
+    lr = c.nfullrows - 1 if lr is None else int(lr)
+    fc = 0 if fc is None else int(fc)
+    lc = c.nfullcols - 1 if lc is None else int(lc)
+    fk = 0 if fk is None else int(fk)
+    lk = a.nfullcols - 1 if lk is None else int(lk)
+    if not (0 <= fr <= lr < c.nfullrows and 0 <= fc <= lc < c.nfullcols
+            and 0 <= fk <= lk < a.nfullcols):
+        raise ValueError(f"element limits out of range: {element_limits}")
+
+    def axis(lo, hi, off, n_el):
+        b0 = int(np.searchsorted(off, lo, side="right") - 1)
+        b1 = int(np.searchsorted(off, hi, side="right") - 1)
+        aligned = off[b0] == lo and off[b1 + 1] - 1 == hi
+        full = lo == 0 and hi == n_el - 1
+        return b0, b1, aligned, full
+
+    rb0, rb1, r_al, r_full = axis(fr, lr, c.row_blk_offsets, c.nfullrows)
+    cb0, cb1, c_al, c_full = axis(fc, lc, c.col_blk_offsets, c.nfullcols)
+    kb0, kb1, k_al, k_full = axis(fk, lk, a.col_blk_offsets, a.nfullcols)
+
+    if not (r_al and c_al and k_al):
+        from dbcsr_tpu.ops.operations import crop_matrix
+
+        a = crop_matrix(a, row_bounds=(fr, lr), col_bounds=(fk, lk))
+        b = crop_matrix(b, row_bounds=(fk, lk), col_bounds=(fc, lc))
+    block_limits = (
+        None if r_full else rb0, None if r_full else rb1,
+        None if c_full else cb0, None if c_full else cb1,
+        None if k_full else kb0, None if k_full else kb1,
+    )
+    beta_window = None if (r_full and c_full) else (fr, lr, fc, lc)
+    return a, b, block_limits, beta_window
+
+
 def _candidates(a, b, c, filter_eps, fr, lr, fc, lc, fk, lk):
     """Symbolic product: all (i, k, j) triples as parallel arrays
     (a_ent indexes op(A) entries, b_ent op(B) entries).  Uses the native
@@ -422,8 +511,13 @@ def _candidates_numpy(a, b, c, na2, nb2, row_eps, fr, lr, fc, lc, fk, lk):
     return i, j, a_ent, b_ent
 
 
-def _rebuild_c(c: BlockSparseMatrix, new_keys: np.ndarray, beta) -> None:
-    """Re-structure C on the (possibly grown) pattern with data beta-scaled."""
+def _rebuild_c(c: BlockSparseMatrix, new_keys: np.ndarray, beta,
+               beta_window=None) -> None:
+    """Re-structure C on the (possibly grown) pattern with data
+    beta-scaled.  With ``beta_window`` = (r0, r1, c0, c1) inclusive
+    element bounds, beta applies only inside the window: old blocks
+    fully outside are copied unscaled, straddling blocks get an
+    element-masked scale (reference windowed-dgemm semantics)."""
     old_keys = c.keys
     old_bins = c.bins
     old_ent_bin = c.ent_bin
@@ -432,22 +526,66 @@ def _rebuild_c(c: BlockSparseMatrix, new_keys: np.ndarray, beta) -> None:
     cols = (new_keys % c.nblkcols).astype(np.int64)
     nb, nsl, shapes = _bin_entries(c.row_blk_sizes, c.col_blk_sizes, rows, cols)
     beta_dev = jnp.asarray(beta, dtype=c.dtype)
+    one_dev = jnp.asarray(1.0, dtype=c.dtype)
     pos_old = np.searchsorted(new_keys, old_keys)  # old keys ⊆ new keys
+
+    n_old = len(old_keys)
+    if beta_window is None or beta == 1 or n_old == 0:
+        cls_inside = np.ones(n_old, bool)
+        cls_strad = np.zeros(n_old, bool)
+        blk_r0 = blk_c0 = None
+    else:
+        r0, r1, c0w, c1w = beta_window
+        orows = (old_keys // c.nblkcols).astype(np.int64)
+        ocols = (old_keys % c.nblkcols).astype(np.int64)
+        roff, coff = c.row_blk_offsets, c.col_blk_offsets
+        blk_r0, blk_r1 = roff[orows], roff[orows + 1] - 1
+        blk_c0, blk_c1 = coff[ocols], coff[ocols + 1] - 1
+        overlap = (blk_r1 >= r0) & (blk_r0 <= r1) & (blk_c1 >= c0w) & (blk_c0 <= c1w)
+        cls_inside = (
+            overlap & (blk_r0 >= r0) & (blk_r1 <= r1)
+            & (blk_c0 >= c0w) & (blk_c1 <= c1w)
+        )
+        cls_strad = overlap & ~cls_inside
+
     bins = []
     for b_id, (bm, bn) in enumerate(shapes):
         count = int((nb == b_id).sum())
         cap = bucket_size(count)
         data = jnp.zeros((cap, bm, bn), c.dtype)
-        sel = np.nonzero((nb[pos_old] == b_id) if len(old_keys) else [])[0]
-        if len(sel) and beta != 0:
+        in_bin = (nb[pos_old] == b_id) if n_old else np.zeros(0, bool)
+
+        def scatter(sel_mask, factor):
+            nonlocal data
+            sel = np.nonzero(sel_mask)[0]
+            if not len(sel):
+                return
             src_bin = old_bins[old_ent_bin[sel[0]]]
             data = _scatter_scaled(
-                data,
-                src_bin.data,
-                jnp.asarray(old_ent_slot[sel]),
-                jnp.asarray(nsl[pos_old[sel]]),
-                beta_dev,
+                data, src_bin.data,
+                jnp.asarray(old_ent_slot[sel]), jnp.asarray(nsl[pos_old[sel]]),
+                factor,
             )
+
+        if beta != 0:
+            scatter(in_bin & cls_inside, beta_dev)
+        if beta_window is not None and beta != 1:
+            scatter(in_bin & ~cls_inside & ~cls_strad, one_dev)
+            sel = np.nonzero(in_bin & cls_strad)[0]
+            if len(sel):
+                r0, r1, c0w, c1w = beta_window
+                rl = np.maximum(r0 - blk_r0[sel], 0)
+                rh = np.minimum(r1 - blk_r0[sel], bm - 1)
+                cl = np.maximum(c0w - blk_c0[sel], 0)
+                ch = np.minimum(c1w - blk_c0[sel], bn - 1)
+                src_bin = old_bins[old_ent_bin[sel[0]]]
+                data = _scatter_scaled_window(
+                    data, src_bin.data,
+                    jnp.asarray(old_ent_slot[sel]), jnp.asarray(nsl[pos_old[sel]]),
+                    beta_dev,
+                    jnp.asarray(rl), jnp.asarray(rh),
+                    jnp.asarray(cl), jnp.asarray(ch),
+                )
         bins.append(_Bin((bm, bn), data, count))
     c.set_structure_from_device(new_keys, bins, binning=(nb, nsl, shapes))
 

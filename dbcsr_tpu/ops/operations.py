@@ -450,19 +450,25 @@ def triu(matrix: BlockSparseMatrix) -> BlockSparseMatrix:
     return matrix
 
 
-@jax.jit
-def _mask_block_range(data, slots, r_lo, r_hi, c_lo, c_hi):
-    """Keep only elements with block-local row in [r_lo, r_hi] and col in
-    [c_lo, c_hi] (per selected block); zero the rest."""
-    bm, bn = data.shape[1], data.shape[2]
+def window_mask(bm: int, bn: int, r_lo, r_hi, c_lo, c_hi):
+    """(N, bm, bn) bool mask of block-local element windows: True where
+    row in [r_lo, r_hi] and col in [c_lo, c_hi] (per block).  Shared by
+    the crop op and the multiply engine's windowed-beta scatter."""
     ri = jnp.arange(bm)[None, :, None]
     ci = jnp.arange(bn)[None, None, :]
-    keep = (
+    return (
         (ri >= r_lo[:, None, None])
         & (ri <= r_hi[:, None, None])
         & (ci >= c_lo[:, None, None])
         & (ci <= c_hi[:, None, None])
     )
+
+
+@jax.jit
+def _mask_block_range(data, slots, r_lo, r_hi, c_lo, c_hi):
+    """Keep only elements with block-local row in [r_lo, r_hi] and col in
+    [c_lo, c_hi] (per selected block); zero the rest."""
+    keep = window_mask(data.shape[1], data.shape[2], r_lo, r_hi, c_lo, c_hi)
     blocks = jnp.take(data, slots, axis=0)
     return data.at[slots].set(jnp.where(keep, blocks, jnp.zeros_like(blocks)))
 

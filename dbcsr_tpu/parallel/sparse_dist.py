@@ -252,19 +252,24 @@ def _resolve_maps(a, b, matrix_c, s: int, kl: int):
 @functools.partial(
     jax.jit, static_argnames=("s", "cap_c", "acc_name", "mesh_ref"),
 )
-def _run_sparse_cannon(a_panels, b_panels, stacks, c_init, alpha, beta,
+def _run_sparse_cannon(a_panels, b_panels, stacks, c_init, alpha, beta_fac,
                        *, s, cap_c, acc_name, mesh_ref):
+    """``beta_fac`` is a per-C-slot (s, s, cap_c) factor: scalar beta
+    everywhere normally; with block limits, 1.0 for blocks outside the
+    limited window so they keep their old values (windowed-beta
+    semantics shared with the single-chip engine)."""
     mesh = mesh_ref.val
     acc_dtype = jnp.dtype(acc_name)
 
-    def body(a_p, b_p, st, c_in, alpha, beta):
+    def body(a_p, b_p, st, c_in, alpha, beta_fac):
         a = a_p.reshape(a_p.shape[3:])  # (cap_a, bm, bk)
         b = b_p.reshape(b_p.shape[3:])
         st = st.reshape(st.shape[3:])  # (s, s_cap, 3)
         c_in = c_in.reshape(c_in.shape[2:])  # (cap_c, bm, bn)
+        fac = beta_fac.reshape(beta_fac.shape[2:])[:, None, None]  # (cap_c,1,1)
         c = _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype)
         c = jax.lax.psum(c, "kl")
-        c = (alpha * c + beta * c_in.astype(acc_dtype)).astype(c_in.dtype)
+        c = (alpha * c + fac * c_in.astype(acc_dtype)).astype(c_in.dtype)
         return c.reshape((1, 1) + c.shape)
 
     fn = jax.shard_map(
@@ -276,11 +281,11 @@ def _run_sparse_cannon(a_panels, b_panels, stacks, c_init, alpha, beta,
             P("kl", "pr", "pc"),
             P("pr", "pc"),
             P(),
-            P(),
+            P("pr", "pc"),
         ),
         out_specs=P("pr", "pc"),
     )
-    return fn(a_panels, b_panels, stacks, c_init, alpha, beta)
+    return fn(a_panels, b_panels, stacks, c_init, alpha, beta_fac)
 
 
 def sparse_multiply_distributed(
@@ -413,11 +418,32 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     bi0 = (bkr - bj) % s  # device row initially holding panel (kr, j)
     b_panels[bl, bi0, bj, b_slots] = b_host
 
+    # windowed-beta semantics (shared with the single-chip engine): C
+    # blocks outside the row/col limit window keep their old values
+    fr_l, lr_l, fc_l, lc_l = limits[0], limits[1], limits[2], limits[3]
+    has_window = any(x is not None for x in (fr_l, lr_l, fc_l, lc_l))
+    inside = np.ones(len(c_keys), bool)
+    if has_window:
+        if fr_l is not None:
+            inside &= c_rows >= fr_l
+        if lr_l is not None:
+            inside &= c_rows <= lr_l
+        if fc_l is not None:
+            inside &= c_cols >= fc_l
+        if lc_l is not None:
+            inside &= c_cols <= lc_l
+
     c_init = np.zeros((s, s, cap_c, bm, bn), dtype)
-    if matrix_c is not None and matrix_c.nblks and beta != 0:
+    keep_old = beta != 0 or (has_window and not inside.all())
+    if matrix_c is not None and matrix_c.nblks and keep_old:
         c_host = _dense_blocks_host(matrix_c, bm, bn)
         pos_old = np.searchsorted(c_keys, old_keys)
         c_init[rdist[c_rows[pos_old]], cdist[c_cols[pos_old]], c_slots[pos_old]] = c_host
+
+    beta_fac = np.full((s, s, cap_c), beta, dtype)
+    if has_window:
+        out_sel = np.nonzero(~inside)[0]
+        beta_fac[rdist[c_rows[out_sel]], cdist[c_cols[out_sel]], c_slots[out_sel]] = 1.0
 
     # ---- run on the mesh ----
     dev = lambda arr, spec: jax.device_put(arr, NamedSharding(mesh, spec))
@@ -429,7 +455,7 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         dev(b_panels, P("kl", "pr", "pc")),
         dev(stacks, P("kl", "pr", "pc")),
         dev(c_init, P("pr", "pc")),
-        jnp.asarray(alpha, dtype), jnp.asarray(beta, dtype),
+        jnp.asarray(alpha, dtype), dev(beta_fac, P("pr", "pc")),
         s=s, cap_c=cap_c, acc_name=acc_name,
         mesh_ref=_HashableMesh(mesh),
     )

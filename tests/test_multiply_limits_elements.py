@@ -1,0 +1,143 @@
+"""Element-granular multiply limits: the reference unittest1 cases.
+
+Ref `dbcsr_unittest1.F:95-293` ("multiply_ALPHA", "multiply_BETA",
+"multiply_LIMITS_*"): 1-based ELEMENT limits that do not align with
+block boundaries, complex alpha/beta, retain_sparsity — verified
+against the windowed-dgemm oracle (`dbcsr_test_multiply.F:631-633`):
+only the limited element submatrix is touched; outside it C keeps its
+old values (no beta scaling).
+"""
+
+import numpy as np
+import pytest
+
+from dbcsr_tpu.core.matrix import create
+from dbcsr_tpu.mm.multiply import multiply
+from dbcsr_tpu.ops.test_methods import make_random_matrix, to_dense
+from dbcsr_tpu.perf.driver import expand_block_sizes
+
+
+def _mk(name, rbs, cbs, occ, seed, dtype):
+    return make_random_matrix(name, rbs, cbs, dtype=dtype, occupation=occ,
+                              rng=np.random.default_rng(seed))
+
+
+def _run_case(sizes, sparsities, alpha, beta, bs_m, bs_n, bs_k, limits,
+              retain_sparsity, dtype=np.complex128, seed=100):
+    """limits: 1-based inclusive element limits (reference convention)."""
+    m_el, n_el, k_el = sizes
+    rbs = expand_block_sizes(m_el, bs_m)
+    cbs = expand_block_sizes(n_el, bs_n)
+    kbs = expand_block_sizes(k_el, bs_k)
+    a = _mk("a", rbs, kbs, 1.0 - sparsities[0], seed, dtype)
+    b = _mk("b", kbs, cbs, 1.0 - sparsities[1], seed + 1, dtype)
+    c = _mk("c", rbs, cbs, 1.0 - sparsities[2], seed + 2, dtype)
+    da, db, dc = to_dense(a), to_dense(b), to_dense(c)
+    pattern = dc != 0  # element-level pattern of C's stored blocks
+    for i, j, blk in c.iterate_blocks():
+        ro = int(np.concatenate([[0], np.cumsum(rbs)])[i])
+        co = int(np.concatenate([[0], np.cumsum(cbs)])[j])
+        pattern[ro:ro + blk.shape[0], co:co + blk.shape[1]] = True
+
+    fr, lr, fc, lc, fk, lk = (x - 1 for x in limits)  # 0-based
+    multiply("N", "N", alpha, a, b, beta, c,
+             retain_sparsity=retain_sparsity,
+             element_limits=(fr, lr, fc, lc, fk, lk))
+
+    want = dc.copy()
+    sub = (alpha * (da[fr:lr + 1, fk:lk + 1] @ db[fk:lk + 1, fc:lc + 1])
+           + beta * dc[fr:lr + 1, fc:lc + 1])
+    want[fr:lr + 1, fc:lc + 1] = sub
+    if retain_sparsity:
+        want[~pattern] = 0  # ref dbcsr_impose_sparsity
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-11, atol=1e-11)
+
+
+def test_multiply_alpha():
+    """ref multiply_ALPHA: complex alpha, unaligned limits, retain."""
+    _run_case((20, 20, 20), (0.5, 0.5, 0.5), alpha=complex(-3, -4), beta=0.0,
+              bs_m=[(1, 1), (1, 4)], bs_n=[(1, 1), (1, 4)], bs_k=[(1, 1), (1, 4)],
+              limits=(2, 6, 3, 7, 6, 7), retain_sparsity=True)
+
+
+def test_multiply_beta():
+    """ref multiply_BETA: complex beta applies ONLY inside the window."""
+    _run_case((20, 20, 20), (0.5, 0.5, 0.5), alpha=1.0, beta=complex(3, -2),
+              bs_m=[(1, 1), (1, 4)], bs_n=[(1, 1), (1, 4)], bs_k=[(1, 1), (1, 4)],
+              limits=(2, 6, 3, 7, 6, 7), retain_sparsity=True)
+
+
+@pytest.mark.parametrize("limits", [
+    (1, 50, 1, 20, 1, 50),    # LIMITS_COL_1 (block-aligned? 20 with bs {1,2}…)
+    (1, 50, 9, 18, 1, 50),    # LIMITS_COL_2
+    (1, 50, 1, 50, 9, 18),    # LIMITS_K_2
+    (9, 18, 11, 20, 1, 50),   # LIMITS_MIX_1
+    (1, 50, 9, 10, 11, 20),   # LIMITS_MIX_2
+    (11, 20, 11, 20, 13, 18), # LIMITS_MIX_4
+])
+def test_multiply_limits_dense_f64(limits):
+    _run_case((50, 50, 50), (0.0, 0.0, 0.0), alpha=1.0, beta=0.0,
+              bs_m=[(1, 1), (1, 2)], bs_n=[(1, 1), (1, 2)], bs_k=[(1, 1), (1, 2)],
+              limits=limits, retain_sparsity=False, dtype=np.float64)
+
+
+@pytest.mark.parametrize("limits", [
+    (1, 50, 9, 18, 1, 50),    # LIMITS_COL_3
+    (11, 20, 11, 20, 13, 18), # LIMITS_MIX_5
+])
+def test_multiply_limits_sparse_retain(limits):
+    _run_case((50, 50, 50), (0.5, 0.5, 0.5), alpha=1.0, beta=0.0,
+              bs_m=[(1, 1), (1, 2)], bs_n=[(1, 1), (1, 2)], bs_k=[(1, 1), (1, 2)],
+              limits=limits, retain_sparsity=True, dtype=np.float64)
+
+
+def test_multiply_limits_rect():
+    """ref LIMITS_COL_4 / K_4: rectangular shapes."""
+    _run_case((25, 50, 75), (0.5, 0.5, 0.5), alpha=1.0, beta=0.0,
+              bs_m=[(1, 1), (1, 2)], bs_n=[(1, 1), (1, 2)], bs_k=[(1, 1), (1, 2)],
+              limits=(1, 25, 9, 18, 1, 75), retain_sparsity=True,
+              dtype=np.float64)
+
+
+def test_block_and_element_limits_conflict():
+    a = _mk("a", [2, 2], [2, 2], 1.0, 1, np.float64)
+    b = _mk("b", [2, 2], [2, 2], 1.0, 2, np.float64)
+    c = create("c", [2, 2], [2, 2])
+    with pytest.raises(ValueError, match="not both"):
+        multiply("N", "N", 1.0, a, b, 0.0, c, first_row=0,
+                 element_limits=(0, 1, None, None, None, None))
+
+
+def test_windowed_beta_agrees_between_engines():
+    """Single-chip and mesh engines must produce identical results for
+    a limited multiply with beta != 1 (C blocks outside the window keep
+    old values in BOTH engines)."""
+    from dbcsr_tpu.parallel import make_grid
+    from dbcsr_tpu.parallel.sparse_dist import sparse_multiply_distributed
+
+    rbs = [3, 2, 4, 3]
+    a = _mk("a", rbs, rbs, 0.9, 21, np.float64)
+    b = _mk("b", rbs, rbs, 0.9, 22, np.float64)
+    c1 = _mk("c", rbs, rbs, 1.0, 23, np.float64)
+    c2 = c1.copy()
+    kw = dict(first_row=1, last_row=2, first_col=0, last_col=1)
+    multiply("N", "N", 1.5, a, b, 2.0, c1, **kw)
+    mesh = make_grid(4)
+    out = sparse_multiply_distributed(1.5, a, b, 2.0, c2, mesh, **kw)
+    np.testing.assert_allclose(to_dense(out), to_dense(c1),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_windowed_beta_with_block_limits():
+    """Block-index limits also follow windowed-beta semantics: C blocks
+    outside the window keep their exact old values."""
+    rbs = [2, 3, 2]
+    a = _mk("a", rbs, rbs, 1.0, 7, np.float64)
+    b = _mk("b", rbs, rbs, 1.0, 8, np.float64)
+    c = _mk("c", rbs, rbs, 1.0, 9, np.float64)
+    dc = to_dense(c)
+    da, db = to_dense(a), to_dense(b)
+    multiply("N", "N", 1.0, a, b, 2.0, c, first_row=1, last_row=1)
+    want = dc.copy()
+    want[2:5, :] = da[2:5, :] @ db + 2.0 * dc[2:5, :]
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-12, atol=1e-12)
