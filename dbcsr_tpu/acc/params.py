@@ -32,6 +32,10 @@ def _params_dir() -> str:
     )
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
 def device_kind() -> str:
     import jax
 
@@ -73,10 +77,61 @@ def lookup(m: int, n: int, k: int, dtype) -> Optional[Dict]:
         return None
 
 
+# a donor entry only predicts for shapes within this flop-count ratio;
+# farther shapes get no opinion (the default dispatch heuristics apply)
+_PREDICT_MAX_FLOP_RATIO = 16.0
+
+_predict_cache: Dict[tuple, Optional[Dict]] = {}
+
+
+def predict(m: int, n: int, k: int, dtype) -> Optional[Dict]:
+    """Nearest-tuned-entry prediction for an UNTUNED (m, n, k).
+
+    The analog of the reference's predictive-modeling pipeline
+    (`src/acc/libsmm_acc/predict/` — a trained model covers triplets the
+    autotuner never ran): here the tuned table is small and the launch
+    space is {driver, grouping}, so nearest-neighbor in log-flops space
+    within the same dtype — capped at a 16x flop-count ratio, so a lone
+    distant donor can't dictate dispatch globally — is a sound
+    estimator.  Results are memoized (this sits on the multiply hot
+    path).  Returns a copy of the donor entry tagged "predicted_from"."""
+    import numpy as np
+
+    exact = lookup(m, n, k, dtype)
+    if exact is not None:
+        return exact
+    # keyed by the resolved params file so env-redirected tables (tests,
+    # DBCSR_TPU_PARAMS_DIR) never serve stale predictions
+    ck = (params_path(), m, n, k, np.dtype(dtype).name)
+    if ck in _predict_cache:
+        return _predict_cache[ck]
+    try:
+        table = _load()
+    except Exception:
+        return None
+    want_dtype = np.dtype(dtype).name
+    best, best_d = None, None
+    target = np.log(float(m) * n * k)
+    max_d = np.log(_PREDICT_MAX_FLOP_RATIO)
+    for e in table.values():
+        if e["dtype"] != want_dtype:
+            continue
+        d = abs(np.log(float(e["m"]) * e["n"] * e["k"]) - target)
+        if d <= max_d and (best_d is None or d < best_d):
+            best, best_d = e, d
+    out = None
+    if best is not None:
+        out = dict(best)
+        out["predicted_from"] = (best["m"], best["n"], best["k"])
+    _predict_cache[ck] = out
+    return out
+
+
 def save_entry(entry: Dict, kind: Optional[str] = None) -> str:
     """Merge one tuned entry into the device's parameter file."""
     kind = kind or device_kind()
     table = _load(kind)
+    _predict_cache.clear()  # new donors invalidate predictions
     with _lock:
         table[_key(entry["m"], entry["n"], entry["k"], entry["dtype"])] = entry
         os.makedirs(_params_dir(), exist_ok=True)

@@ -206,12 +206,13 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
     if S == 0:
         return c_data
     # tuned preference (dbcsr_tpu.acc.params; analog of the per-GPU
-    # parameter table consulted by libsmm_acc.cpp:227-249) —
-    # resolved once here for the driver choice, grouping, and the
-    # flat-gather layout decision
+    # parameter table consulted by libsmm_acc.cpp:227-249, with
+    # nearest-neighbor prediction for untuned shapes standing in for
+    # the predict/ ML pipeline) — resolved once here for the driver
+    # choice, grouping, and the flat-gather layout decision
     from dbcsr_tpu.acc import params as params_mod
 
-    tuned = params_mod.lookup(
+    tuned = params_mod.predict(
         a_data.shape[1], b_data.shape[2], a_data.shape[2], c_data.dtype
     )
     tuned_driver = tuned.get("driver") if tuned else None

@@ -31,6 +31,28 @@ def test_params_roundtrip(tmp_path, monkeypatch):
     params_mod._cache.clear()
 
 
+def test_predict_falls_back_to_nearest_tuned_entry(tmp_path, monkeypatch):
+    """Untuned (m,n,k) shapes borrow the nearest tuned entry (the
+    predict/ ML-pipeline analog, src/acc/libsmm_acc/predict/)."""
+    monkeypatch.setenv("DBCSR_TPU_PARAMS_DIR", str(tmp_path))
+    params_mod._cache.clear()
+    params_mod.save_entry({"m": 5, "n": 5, "k": 5, "dtype": "float64",
+                           "driver": "xla", "grouping": None, "gflops": 10.0})
+    params_mod.save_entry({"m": 32, "n": 32, "k": 32, "dtype": "float64",
+                           "driver": "xla_flat", "grouping": None, "gflops": 99.0})
+    try:
+        # exact hit has no prediction tag
+        assert "predicted_from" not in params_mod.predict(5, 5, 5, "float64")
+        # 30^3 is nearer 32^3 than 5^3 in log-flops
+        p = params_mod.predict(30, 30, 30, "float64")
+        assert p["driver"] == "xla_flat"
+        assert p["predicted_from"] == (32, 32, 32)
+        # no same-dtype donors -> no prediction
+        assert params_mod.predict(8, 8, 8, "float32") is None
+    finally:
+        params_mod._cache.clear()
+
+
 def test_tune_smm_writes_entry(tmp_path, monkeypatch):
     from dbcsr_tpu.acc.tune import tune_smm
 
