@@ -31,7 +31,15 @@ def _probe_tpu(timeout_s: int) -> bool:
     timeout before committing this process to JAX_PLATFORMS=axon."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         return False
-    code = "import jax; d = jax.devices(); assert d[0].platform != 'cpu'"
+    # real round-trip, not just backend init: the axon tunnel has been
+    # observed in states where devices() answers but any array
+    # create+fetch hangs forever (see PERF_NOTES.md) — such a session
+    # must fall back to CPU rather than wedge the bench
+    code = (
+        "import jax, numpy as np, jax.numpy as jnp; "
+        "assert jax.devices()[0].platform != 'cpu'; "
+        "x = jnp.arange(8.0); assert float(np.asarray(x)[3]) == 3.0"
+    )
     try:
         r = subprocess.run(
             [sys.executable, "-c", code],

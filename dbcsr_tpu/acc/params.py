@@ -13,6 +13,7 @@ Schema per entry: {"m", "n", "k", "dtype", "driver": "pallas"|"xla",
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import re
@@ -21,6 +22,7 @@ from typing import Dict, Optional
 
 _lock = threading.Lock()
 _cache: Dict[str, Dict] = {}
+_table_gen = 0  # bumped by save_entry; guards predict memoization
 
 
 def _params_dir() -> str:
@@ -30,9 +32,6 @@ def _params_dir() -> str:
         "DBCSR_TPU_PARAMS_DIR",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), "params"),
     )
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=1)
@@ -53,10 +52,11 @@ def _key(m: int, n: int, k: int, dtype) -> str:
 
 
 def _load(kind: Optional[str] = None) -> Dict:
-    kind = kind or device_kind()
+    # keyed by the RESOLVED path, so redirecting DBCSR_TPU_PARAMS_DIR
+    # mid-process is honored without manual cache clearing
+    path = params_path(kind or device_kind())
     with _lock:
-        if kind not in _cache:
-            path = params_path(kind)
+        if path not in _cache:
             table = {}
             if os.path.exists(path):
                 try:
@@ -65,8 +65,8 @@ def _load(kind: Optional[str] = None) -> Dict:
                             table[_key(e["m"], e["n"], e["k"], e["dtype"])] = e
                 except (OSError, ValueError, KeyError):
                     table = {}
-            _cache[kind] = table
-        return _cache[kind]
+            _cache[path] = table
+        return _cache[path]
 
 
 def lookup(m: int, n: int, k: int, dtype) -> Optional[Dict]:
@@ -105,6 +105,7 @@ def predict(m: int, n: int, k: int, dtype) -> Optional[Dict]:
     ck = (params_path(), m, n, k, np.dtype(dtype).name)
     if ck in _predict_cache:
         return _predict_cache[ck]
+    gen0 = _table_gen
     try:
         table = _load()
     except Exception:
@@ -123,7 +124,9 @@ def predict(m: int, n: int, k: int, dtype) -> Optional[Dict]:
     if best is not None:
         out = dict(best)
         out["predicted_from"] = (best["m"], best["n"], best["k"])
-    _predict_cache[ck] = out
+    with _lock:
+        if _table_gen == gen0:  # table unchanged while we computed
+            _predict_cache[ck] = out
     return out
 
 
@@ -131,7 +134,6 @@ def save_entry(entry: Dict, kind: Optional[str] = None) -> str:
     """Merge one tuned entry into the device's parameter file."""
     kind = kind or device_kind()
     table = _load(kind)
-    _predict_cache.clear()  # new donors invalidate predictions
     with _lock:
         table[_key(entry["m"], entry["n"], entry["k"], entry["dtype"])] = entry
         os.makedirs(_params_dir(), exist_ok=True)
@@ -139,4 +141,10 @@ def save_entry(entry: Dict, kind: Optional[str] = None) -> str:
         with open(path, "w") as f:
             json.dump(sorted(table.values(), key=lambda e: (e["m"], e["n"], e["k"])),
                       f, indent=1)
+        # after the insert, under the lock: a concurrent predict() must
+        # not be able to re-memoize a pre-insert prediction (the bumped
+        # generation invalidates any in-flight computation)
+        global _table_gen
+        _table_gen += 1
+        _predict_cache.clear()
     return path
