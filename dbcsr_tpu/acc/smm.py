@@ -26,6 +26,7 @@ Key differences from the CUDA design, by intent:
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -186,6 +187,180 @@ def _validate_pallas_kernel(c_data, a_data, b_data, a_idx, b_idx, c_idx,
         )
 
 
+class StackPlan:
+    """A prepared stack: device-resident index arrays + the driver
+    decision, reusable across multiplies that share sparsity patterns
+    (the index arrays depend only on the patterns, not the values).
+    Built by `prepare_stack`, run by `execute_stack`."""
+
+    __slots__ = ("driver", "nseg", "xla_idx", "launches", "r_grp",
+                 "a_pad_row", "b_pad_row", "append_a_pad", "append_b_pad",
+                 "val_idx")
+
+    def __init__(self):
+        self.driver = "xla"
+        self.nseg = 0
+        self.xla_idx = None      # (ai, bi, ci) device (nchunks, chunk)
+        self.launches = None     # pallas: [(ai_flat, bi_flat, ci) device]
+        self.r_grp = 1
+        self.a_pad_row = None
+        self.b_pad_row = None
+        self.append_a_pad = False  # pallas: append a zero row at execute
+        self.append_b_pad = False
+        self.val_idx = None      # host prefix for first-use validation
+
+    def nbytes(self) -> int:
+        """Approximate device bytes pinned by this plan (cache budget)."""
+        total = 0
+        if self.xla_idx is not None:
+            total += sum(int(x.size) * 4 for x in self.xla_idx)
+        if self.launches is not None:
+            for lc in self.launches:
+                total += sum(int(x.size) * 4 for x in lc)
+        return total
+
+
+def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
+                  a_pad_row=None, b_pad_row=None) -> Optional[StackPlan]:
+    """Host side of stack processing: driver selection (tuned table +
+    prediction), grouping/chunking/padding, and upload of the int32
+    index arrays.  Returns None for an empty stack."""
+    cfg = get_config()
+    S = len(a_idx)
+    if S == 0:
+        return None
+    # tuned preference (dbcsr_tpu.acc.params; analog of the per-GPU
+    # parameter table consulted by libsmm_acc.cpp:227-249, with
+    # nearest-neighbor prediction for untuned shapes standing in for
+    # the predict/ ML pipeline) — resolved once here for the driver
+    # choice, grouping, and the flat-gather layout decision
+    from dbcsr_tpu.acc import params as params_mod
+
+    tuned = params_mod.predict(
+        a_data.shape[1], b_data.shape[2], a_data.shape[2], c_data.dtype
+    )
+    tuned_driver = tuned.get("driver") if tuned else None
+    plan = StackPlan()
+    plan.nseg = c_data.shape[0]
+    if _pallas_supported(cfg, c_data, a_data, b_data):
+        prefer_xla = (
+            cfg.mm_driver == "auto" and tuned_driver in ("xla", "xla_flat")
+        )
+        if not prefer_xla:
+            from dbcsr_tpu.acc import pallas_smm
+
+            grouping = None
+            if tuned and tuned.get("driver") == "pallas" and tuned.get("grouping"):
+                grouping = int(tuned["grouping"])
+            # no guaranteed-zero row in the data array: the plan indexes
+            # a virtual row one past the end, appended at execute time
+            # (capacities are pattern-deterministic, so cached plans
+            # remain valid across value changes)
+            if a_pad_row is None:
+                plan.append_a_pad = True
+                a_pad_row = a_data.shape[0]
+            if b_pad_row is None:
+                plan.append_b_pad = True
+                b_pad_row = b_data.shape[0]
+            ai2, bi2, ci2, r_grp = pallas_smm.build_grouped_stack(
+                np.asarray(c_idx), np.asarray(a_idx), np.asarray(b_idx),
+                a_pad_row, b_pad_row, grouping=grouping,
+            )
+            plan.driver = "pallas"
+            plan.r_grp = r_grp
+            plan.a_pad_row = a_pad_row
+            plan.b_pad_row = b_pad_row
+            plan.launches = [
+                tuple(map(jnp.asarray, lc))
+                for lc in pallas_smm.prepare_launches(
+                    ai2, bi2, ci2, r_grp, a_pad_row, b_pad_row
+                )
+            ]
+            if cfg.validate_kernels:
+                s = min(S, _VALIDATE_MAX_ENTRIES)
+                plan.val_idx = (
+                    np.asarray(a_idx[:s], np.int32),
+                    np.asarray(b_idx[:s], np.int32),
+                    np.asarray(c_idx[:s], np.int32),
+                    grouping,
+                )
+            return plan
+    elif cfg.mm_driver == "pallas":
+        import warnings
+
+        warnings.warn(
+            f"mm_driver='pallas' but dtype {jnp.dtype(c_data.dtype)} / block "
+            f"shape unsupported by the Pallas kernel; falling back to XLA path",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+    chunk = max(cfg.mm_stack_size, 1)
+    # pad to a whole number of chunks (bucketed) and reshape to
+    # (nchunks, chunk) so the scan shape reuses the jit cache
+    if S <= chunk:
+        chunk = bucket_size(S)
+        nchunks = 1
+    else:
+        nchunks = bucket_size(-(-S // chunk), minimum=1)
+    ai, bi, ci = pad_stack(a_idx, b_idx, c_idx, nchunks * chunk, plan.nseg)
+    plan.driver = "xla_flat" if (
+        cfg.flat_gather
+        or (cfg.mm_driver == "auto" and tuned_driver == "xla_flat")
+    ) else "xla"
+    plan.xla_idx = (
+        jnp.asarray(ai.reshape(nchunks, chunk)),
+        jnp.asarray(bi.reshape(nchunks, chunk)),
+        jnp.asarray(ci.reshape(nchunks, chunk)),
+    )
+    return plan
+
+
+def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0):
+    """Device side: run a prepared plan against (possibly new) data."""
+    if plan is None:
+        return c_data
+    if plan.driver == "pallas":
+        from dbcsr_tpu.acc.pallas_smm import _pallas_process
+
+        cfg = get_config()
+        if cfg.validate_kernels and plan.val_idx is not None:
+            key = (
+                a_data.shape[1], b_data.shape[2], a_data.shape[2],
+                str(jnp.dtype(c_data.dtype)),
+            )
+            if key not in _validated_kernels:
+                ai, bi, ci, grouping = plan.val_idx
+                _validate_pallas_kernel(
+                    c_data, a_data, b_data, ai, bi, ci,
+                    None if plan.append_a_pad else plan.a_pad_row,
+                    None if plan.append_b_pad else plan.b_pad_row,
+                    grouping,
+                )
+                _validated_kernels.add(key)
+        if plan.append_a_pad:
+            a_data = jnp.concatenate(
+                [a_data, jnp.zeros((1,) + a_data.shape[1:], a_data.dtype)]
+            )
+        if plan.append_b_pad:
+            b_data = jnp.concatenate(
+                [b_data, jnp.zeros((1,) + b_data.shape[1:], b_data.dtype)]
+            )
+        alpha_arr = jnp.asarray([[alpha]], dtype=jnp.float32)
+        interpret = jax.devices()[0].platform != "tpu"
+        with jax.enable_x64(False):
+            for dai, dbi, dci in plan.launches:
+                c_data = _pallas_process(
+                    c_data, a_data, b_data, dai, dbi, dci,
+                    alpha_arr, r_grp=plan.r_grp, interpret=interpret,
+                )
+        return c_data
+    alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)
+    ai, bi, ci = plan.xla_idx
+    if plan.driver == "xla_flat":
+        return _process_stack_xla_flat(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
+    return _process_stack_xla(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
+
+
 def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
                   a_pad_row=None, b_pad_row=None):
     """Process a full (possibly large) stack, chunked to mm_stack_size.
@@ -201,75 +376,9 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
 
     Returns the updated ``c_data`` device array.
     """
-    cfg = get_config()
-    S = len(a_idx)
-    if S == 0:
-        return c_data
-    # tuned preference (dbcsr_tpu.acc.params; analog of the per-GPU
-    # parameter table consulted by libsmm_acc.cpp:227-249, with
-    # nearest-neighbor prediction for untuned shapes standing in for
-    # the predict/ ML pipeline) — resolved once here for the driver
-    # choice, grouping, and the flat-gather layout decision
-    from dbcsr_tpu.acc import params as params_mod
-
-    tuned = params_mod.predict(
-        a_data.shape[1], b_data.shape[2], a_data.shape[2], c_data.dtype
-    )
-    tuned_driver = tuned.get("driver") if tuned else None
-    if _pallas_supported(cfg, c_data, a_data, b_data):
-        prefer_xla = (
-            cfg.mm_driver == "auto" and tuned_driver in ("xla", "xla_flat")
-        )
-        if not prefer_xla:
-            from dbcsr_tpu.acc.pallas_smm import process_stack_pallas
-
-            grouping = None
-            if tuned and tuned.get("driver") == "pallas" and tuned.get("grouping"):
-                grouping = int(tuned["grouping"])
-            if cfg.validate_kernels:
-                key = (
-                    a_data.shape[1], b_data.shape[2], a_data.shape[2],
-                    str(jnp.dtype(c_data.dtype)),
-                )
-                if key not in _validated_kernels:
-                    _validate_pallas_kernel(
-                        c_data, a_data, b_data, a_idx, b_idx, c_idx,
-                        a_pad_row, b_pad_row, grouping,
-                    )
-                    _validated_kernels.add(key)
-            return process_stack_pallas(
-                c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha,
-                a_pad_row=a_pad_row, b_pad_row=b_pad_row, grouping=grouping,
-            )
-    elif cfg.mm_driver == "pallas":
-        import warnings
-
-        warnings.warn(
-            f"mm_driver='pallas' but dtype {jnp.dtype(c_data.dtype)} / block "
-            f"shape unsupported by the Pallas kernel; falling back to XLA path",
-            RuntimeWarning,
-            stacklevel=2,
-        )
-    nseg = c_data.shape[0]
-    alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)
-    chunk = max(cfg.mm_stack_size, 1)
-    # pad to a whole number of chunks (bucketed) and reshape to
-    # (nchunks, chunk) so the scan shape reuses the jit cache
-    if S <= chunk:
-        chunk = bucket_size(S)
-        nchunks = 1
-    else:
-        nchunks = bucket_size(-(-S // chunk), minimum=1)
-    ai, bi, ci = pad_stack(a_idx, b_idx, c_idx, nchunks * chunk, nseg)
-    ai = jnp.asarray(ai.reshape(nchunks, chunk))
-    bi = jnp.asarray(bi.reshape(nchunks, chunk))
-    ci = jnp.asarray(ci.reshape(nchunks, chunk))
-    use_flat = cfg.flat_gather or (
-        cfg.mm_driver == "auto" and tuned_driver == "xla_flat"
-    )
-    if use_flat:
-        return _process_stack_xla_flat(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
-    return _process_stack_xla(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
+    plan = prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
+                         a_pad_row=a_pad_row, b_pad_row=b_pad_row)
+    return execute_stack(c_data, a_data, b_data, plan, alpha)
 
 
 def _pallas_supported(cfg, c_data, a_data, b_data) -> bool:

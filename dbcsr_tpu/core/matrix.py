@@ -491,6 +491,27 @@ class BlockSparseMatrix:
         return out
 
     # ------------------------------------------------------------ structure
+    def pattern_fingerprint(self):
+        """Cheap content hash of the sparsity pattern (keys + the full
+        BLOCKING vectors — same keys under different blockings are
+        different patterns), memoized against the keys array object.
+        Holding the hashed array alive makes the identity check sound
+        (no id reuse).  Used to key plan caches for repeated
+        same-pattern multiplies (SCF-style loops)."""
+        import hashlib
+
+        if getattr(self, "_blk_fp", None) is None:
+            self._blk_fp = hashlib.sha1(
+                self.row_blk_sizes.tobytes() + self.col_blk_sizes.tobytes()
+            ).digest()[:8]
+        if getattr(self, "_fp_keys", None) is not self.keys:
+            self._fp_keys = self.keys
+            self._fp = (
+                self.nblkrows, self.nblkcols, len(self.keys), self._blk_fp,
+                hashlib.sha1(self.keys.tobytes()).digest()[:8],
+            )
+        return self._fp
+
     def copy(self, name: Optional[str] = None) -> "BlockSparseMatrix":
         m = BlockSparseMatrix(
             name or self.name,

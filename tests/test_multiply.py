@@ -221,6 +221,53 @@ def test_multiply_aliased_c_is_b_with_beta():
     np.testing.assert_allclose(to_dense(b), da @ db + 0.5 * db, rtol=1e-12, atol=1e-12)
 
 
+def test_repeated_multiply_reuses_stack_plan():
+    """Same-pattern repeats hit the plan cache (no re-sort/re-upload)
+    and produce bit-identical results; a pattern change misses."""
+    import dbcsr_tpu.mm.multiply as mm
+    from dbcsr_tpu.ops.test_methods import checksum
+
+    mm._plan_cache.clear()
+    rbs = [3, 4, 3]
+    a = _rand("a", rbs, rbs, 0.6, seed=70)
+    b = _rand("b", rbs, rbs, 0.6, seed=71)
+    c0 = _rand("c", rbs, rbs, 0.3, seed=72)
+
+    c1 = c0.copy()
+    multiply("N", "N", 1.0, a, b, 0.5, c1)
+    n_after_first = len(mm._plan_cache)
+    assert n_after_first == 1
+    cs1 = checksum(c1)
+
+    # same patterns, new A values: cache hit, same plan, new result
+    for blk in a.bins:
+        if blk.count:
+            blk.data = blk.data * 1.0  # same values, fresh buffers
+    c2 = c0.copy()
+    multiply("N", "N", 1.0, a, b, 0.5, c2)
+    assert len(mm._plan_cache) == 1  # reused, not re-prepared
+    assert checksum(c2) == cs1  # bit-identical across repeats
+
+    # different A pattern: a fresh plan is prepared
+    a2 = _rand("a2", rbs, rbs, 0.5, seed=73)
+    c3 = c0.copy()
+    multiply("N", "N", 1.0, a2, b, 0.5, c3)
+    assert len(mm._plan_cache) == 2
+
+
+def test_filtered_multiply_not_plan_cached():
+    """filter_eps products depend on values (norms) — never cached."""
+    import dbcsr_tpu.mm.multiply as mm
+
+    mm._plan_cache.clear()
+    rbs = [3, 4]
+    a = _rand("a", rbs, rbs, 1.0, seed=74)
+    b = _rand("b", rbs, rbs, 1.0, seed=75)
+    c = create("c", rbs, rbs)
+    multiply("N", "N", 1.0, a, b, 0.0, c, filter_eps=1e-8)
+    assert len(mm._plan_cache) == 0
+
+
 def test_dense_mode_matches_sparse_path():
     """Uniform-blocked occ=1 goes dense; force sparse and compare."""
     from dbcsr_tpu.core.config import set_config
