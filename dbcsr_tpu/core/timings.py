@@ -60,9 +60,24 @@ def timestop(name: str) -> None:
 
 @contextlib.contextmanager
 def timed(name: str):
+    """Timer + device-profiler range.
+
+    Besides the host timer, each phase is emitted as a
+    `jax.profiler.TraceAnnotation` so xprof/perfetto traces show the
+    engine phases — the NVTX/ROCTX range analog
+    (`src/acc/cuda/dbcsr_cuda_nvtx_cu.cpp`, `dbcsr_cuda_profiling.F`).
+    """
+    try:
+        from jax.profiler import TraceAnnotation
+    except ImportError:  # pragma: no cover - jax always present in practice
+        TraceAnnotation = None
     timeset(name)
     try:
-        yield
+        if TraceAnnotation is None:
+            yield
+        else:
+            with TraceAnnotation(f"dbcsr_tpu:{name}"):
+                yield
     finally:
         timestop(name)
 

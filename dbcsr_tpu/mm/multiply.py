@@ -11,7 +11,9 @@ re-designed TPU-first:
 * Per-thread work matrices + stack flushing (`dbcsr_mm_multrec.F`,
   `dbcsr_mm_sched.F`) collapse into: one parameter stack per
   (m, n, k) shape-bin triple, sorted by C block then A entry, processed
-  by `dbcsr_tpu.acc.process_stack` in mm_stack_size chunks.
+  by the acc layer's prepared stack plans (`dbcsr_tpu.acc.smm.
+  prepare_stack`/`execute_stack`, cached across same-pattern repeats)
+  in mm_stack_size chunks.
 * Accumulation order is fixed by the sort, giving bit-reproducible
   results per run configuration (north-star checksum requirement).
 
@@ -32,7 +34,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dbcsr_tpu.acc.smm import process_stack
 from dbcsr_tpu.core import stats
 from dbcsr_tpu.core.kinds import is_complex
 from dbcsr_tpu.core.matrix import (

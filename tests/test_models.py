@@ -101,3 +101,56 @@ def test_sign_iteration_symmetric_storage_input():
     got = to_dense(x)
     assert np.all(np.isfinite(got))
     np.testing.assert_allclose(got, got.T, atol=1e-10)  # sign(A) symmetric
+
+
+def test_invsqrt_newton_schulz_converges():
+    """Z/sqrt(sf) must converge to S^-1/2 (dense eig oracle)."""
+    from dbcsr_tpu.models.invsqrt import invsqrt_iteration
+    from dbcsr_tpu.ops.test_methods import make_random_matrix
+
+    rng = np.random.default_rng(31)
+    sizes = [3, 4, 2, 3]
+    n = sum(sizes)
+    # SPD matrix: A A^T + n*I, built block-sparse
+    a = make_random_matrix("A", sizes, sizes, occupation=0.7, rng=rng)
+    da = to_dense(a)
+    ds = da @ da.T + n * np.eye(n)
+    from dbcsr_tpu.core.matrix import BlockSparseMatrix
+    from dbcsr_tpu.mm.multiply import multiply
+    from dbcsr_tpu.ops.operations import add_on_diag
+
+    s = BlockSparseMatrix("S", np.asarray(sizes, np.int32),
+                          np.asarray(sizes, np.int32), np.float64)
+    multiply("N", "T", 1.0, a, a, 0.0, s)
+    add_on_diag(s, float(n))
+    np.testing.assert_allclose(to_dense(s), ds, rtol=1e-12, atol=1e-12)
+
+    z, sf, iters = invsqrt_iteration(s, tol=1e-12)
+    got = to_dense(z) / np.sqrt(sf)
+    w, v = np.linalg.eigh(ds)
+    want = v @ np.diag(w ** -0.5) @ v.T
+    assert iters < 30
+    np.testing.assert_allclose(got, want, rtol=1e-8, atol=1e-8)
+    # and (S^-1/2) S (S^-1/2) == I
+    np.testing.assert_allclose(got @ ds @ got, np.eye(n), rtol=1e-8, atol=1e-8)
+
+
+def test_invsqrt_with_filtering_still_accurate():
+    from dbcsr_tpu.models.invsqrt import invsqrt_iteration
+    from dbcsr_tpu.core.matrix import BlockSparseMatrix
+    from dbcsr_tpu.mm.multiply import multiply
+    from dbcsr_tpu.ops.operations import add_on_diag
+    from dbcsr_tpu.ops.test_methods import make_random_matrix
+
+    rng = np.random.default_rng(32)
+    sizes = [3, 3, 3, 3]
+    n = sum(sizes)
+    a = make_random_matrix("A", sizes, sizes, occupation=0.4, rng=rng)
+    s = BlockSparseMatrix("S", np.asarray(sizes, np.int32),
+                          np.asarray(sizes, np.int32), np.float64)
+    multiply("N", "T", 1.0, a, a, 0.0, s)
+    add_on_diag(s, float(n))
+    z, sf, _ = invsqrt_iteration(s, tol=1e-10, filter_eps=1e-13)
+    got = to_dense(z) / np.sqrt(sf)
+    ds = to_dense(s)
+    np.testing.assert_allclose(got @ ds @ got, np.eye(n), rtol=1e-6, atol=1e-6)
