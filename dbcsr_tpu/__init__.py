@@ -29,24 +29,51 @@ from dbcsr_tpu.core.kinds import (
 )
 from dbcsr_tpu.core.config import get_config, set_config, print_config
 from dbcsr_tpu.core.lib import init_lib, finalize_lib, print_statistics
-from dbcsr_tpu.core.dist import ProcessGrid, Distribution, dist_bin
+from dbcsr_tpu.core.dist import (
+    ProcessGrid,
+    Distribution,
+    convert_offsets_to_sizes,
+    convert_sizes_to_offsets,
+    dist_bin,
+)
 from dbcsr_tpu.core.matrix import BlockSparseMatrix, create
 from dbcsr_tpu.mm.multiply import multiply
 from dbcsr_tpu.ops.operations import (
+    FUNC_ARTANH,
+    FUNC_ASIN,
+    FUNC_COS,
+    FUNC_DDSIN,
+    FUNC_DDTANH,
+    FUNC_DSIN,
+    FUNC_DTANH,
+    FUNC_INVERSE,
+    FUNC_INVERSE_SPECIAL,
+    FUNC_SIN,
+    FUNC_SPREAD_FROM_ZERO,
+    FUNC_TANH,
+    FUNC_TRUNCATE,
     add,
     add_on_diag,
+    clear,
+    column_norms,
     copy,
+    copy_into_existing,
     crop_matrix,
     dot,
     filter_matrix,
     frobenius_norm,
     function_of_elements,
     gershgorin_norm,
+    get_block_diag,
     hadamard_product,
     maxabs_norm,
+    reserve_all_blocks,
+    reserve_blocks,
+    reserve_diag_blocks,
     scale,
     scale_by_vector,
     set_diag,
+    set_value,
     get_diag,
     trace,
     triu,
@@ -58,13 +85,31 @@ from dbcsr_tpu.ops.transformations import (
     redistribute,
     submatrix,
 )
-from dbcsr_tpu.ops.csr import complete_redistribute, csr_from_matrix, matrix_from_csr
-from dbcsr_tpu.ops.io import binary_read, binary_write
+from dbcsr_tpu.ops.csr import (
+    CSR_DBCSR_BLKROW_DIST,
+    CSR_EQROW_CEIL_DIST,
+    CSR_EQROW_FLOOR_DIST,
+    CsrMatrix,
+    complete_redistribute,
+    csr_create_from_matrix,
+    csr_from_matrix,
+    csr_print_sparsity,
+    csr_write,
+    matrix_from_csr,
+    to_csr_filter,
+)
+from dbcsr_tpu.ops.io import binary_read, binary_write, print_block_sum, print_matrix
 from dbcsr_tpu.ops.test_methods import (
     checksum,
     from_dense,
     make_random_matrix,
+    reset_randmat_seed,
     to_dense,
 )
+from dbcsr_tpu.ops.tests import TEST_BINARY_IO, TEST_MM, run_tests
+# ref dbcsr_replicate_all (`dbcsr_transformations.F:108`); the paired
+# dbcsr_sum_replicated merge is a lax.psum inside shard_map here (see
+# parallel/dist_matrix.py:replicate docstring)
+from dbcsr_tpu.parallel.dist_matrix import replicate as replicate_all
 
 __version__ = "0.1.0"

@@ -98,6 +98,89 @@ def _process_stack_xla_flat(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
 
 
 @functools.partial(jax.jit, donate_argnums=0)
+def _process_stack_xla_group(c_data, a_data, b_data, ga, gb, gc, alpha):
+    """R-tiled ("k-merged") stack layout: entries sharing a C block are
+    tiled into groups of R0; each group's A blocks concatenate along k
+    into one (m, R0*k) strip, its B blocks into (R0*k, n), and the
+    whole group contracts in ONE dot — k grows R0-fold, and the
+    per-entry segment-sum collapses to a per-group one.
+
+    This is the f64 answer to the MXU-utilization problem the reference
+    solves with kernel `grouping` (`smm_acc_dnt_*.h`: one thread block
+    processes `grouping` stack entries): on TPU, f64 is emulated in
+    split-f32/bf16 passes, so per-entry 23^3 dots run at ~2 GFLOP/s;
+    R0=8 merging measured 3.5x that on the north-star stack (chip,
+    forced-fetch timing — PERF_NOTES.md).
+
+    ``ga``/``gb`` are (nchunks, CH, R0) gather indices, padded with a
+    guaranteed-zero row id; ``gc`` is (nchunks, CH) segment ids with
+    nseg for dead groups (dropped).  Groups of one segment stay in
+    index order -> deterministic accumulation.
+    """
+    nseg, m, n = c_data.shape
+    k = a_data.shape[2]
+    r0 = ga.shape[2]
+
+    def body(c, idx):
+        ia, ib, ic = idx
+        ch = ia.shape[0]
+        ablk = jnp.take(a_data, ia.reshape(-1), axis=0).reshape(ch, r0, m, k)
+        bblk = jnp.take(b_data, ib.reshape(-1), axis=0).reshape(ch, r0, k, n)
+        amat = jnp.swapaxes(ablk, 1, 2).reshape(ch, m, r0 * k)
+        bmat = bblk.reshape(ch, r0 * k, n)
+        acc = _accum_dtype(c.dtype)
+        prod = jax.lax.dot_general(
+            amat, bmat, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=acc,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        prod = (alpha.astype(acc) * prod).astype(c.dtype)
+        return c + jax.ops.segment_sum(
+            prod, ic, num_segments=nseg, indices_are_sorted=True
+        ), None
+
+    c_data, _ = jax.lax.scan(body, c_data, (ga, gb, gc))
+    return c_data
+
+
+def build_group_tiles(c_idx, a_idx, b_idx, r0: int, a_pad: int, b_pad: int,
+                      c_pad: int, chunk_groups: int):
+    """Host side of the grouped layout: split each C segment's entries
+    into runs of ``r0`` (pad the last run with zero-row ids), returning
+    (nchunks, CH, r0) a/b gather arrays + (nchunks, CH) segment ids.
+    ``c_idx`` must be sorted ascending; dead/pad groups carry segment id
+    ``c_pad`` (= nseg), keeping ids sorted and dropped by segment_sum."""
+    s = len(c_idx)
+    seg_starts = np.concatenate([[0], np.nonzero(np.diff(c_idx))[0] + 1])
+    seg_len = np.diff(np.append(seg_starts, s))
+    off_in_seg = np.arange(s) - np.repeat(seg_starts, seg_len)
+    # group index: consecutive per (segment, run-of-r0) in entry order
+    is_new_group = np.ones(s, bool)
+    is_new_group[1:] = (off_in_seg[1:] % r0 == 0) | (c_idx[1:] != c_idx[:-1])
+    gidx = np.cumsum(is_new_group) - 1
+    n_groups = int(gidx[-1]) + 1
+    ga = np.full((n_groups, r0), a_pad, np.int32)
+    gb = np.full((n_groups, r0), b_pad, np.int32)
+    slot = off_in_seg % r0
+    ga[gidx, slot] = a_idx
+    gb[gidx, slot] = b_idx
+    gc = np.empty(n_groups, np.int32)
+    gc[gidx] = c_idx
+    nchunks = bucket_size(-(-n_groups // chunk_groups), minimum=1)
+    total = nchunks * chunk_groups
+    if total > n_groups:
+        pad = total - n_groups
+        ga = np.concatenate([ga, np.full((pad, r0), a_pad, np.int32)])
+        gb = np.concatenate([gb, np.full((pad, r0), b_pad, np.int32)])
+        gc = np.concatenate([gc, np.full(pad, c_pad, np.int32)])
+    return (
+        ga.reshape(nchunks, chunk_groups, r0),
+        gb.reshape(nchunks, chunk_groups, r0),
+        gc.reshape(nchunks, chunk_groups),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=0)
 def _process_stack_xla(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
     """Process a whole stack in one device program.
 
@@ -195,7 +278,7 @@ class StackPlan:
 
     __slots__ = ("driver", "nseg", "xla_idx", "launches", "r_grp",
                  "a_pad_row", "b_pad_row", "append_a_pad", "append_b_pad",
-                 "val_idx")
+                 "val_idx", "group_idx")
 
     def __init__(self):
         self.driver = "xla"
@@ -205,15 +288,18 @@ class StackPlan:
         self.r_grp = 1
         self.a_pad_row = None
         self.b_pad_row = None
-        self.append_a_pad = False  # pallas: append a zero row at execute
+        self.append_a_pad = False  # pallas/group: append a zero row at execute
         self.append_b_pad = False
         self.val_idx = None      # host prefix for first-use validation
+        self.group_idx = None    # xla_group: (ga, gb, gc) device arrays
 
     def nbytes(self) -> int:
         """Approximate device bytes pinned by this plan (cache budget)."""
         total = 0
         if self.xla_idx is not None:
             total += sum(int(x.size) * 4 for x in self.xla_idx)
+        if self.group_idx is not None:
+            total += sum(int(x.size) * 4 for x in self.group_idx)
         if self.launches is not None:
             for lc in self.launches:
                 total += sum(int(x.size) * 4 for x in lc)
@@ -242,6 +328,37 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
     tuned_driver = tuned.get("driver") if tuned else None
     plan = StackPlan()
     plan.nseg = c_data.shape[0]
+    # R-tiled grouped layout (see _process_stack_xla_group): the default
+    # for emulated-f64 dtypes, where the per-entry dot is MXU-starved
+    want_group = cfg.mm_driver == "xla_group" or (
+        cfg.mm_driver == "auto"
+        and (
+            tuned_driver == "xla_group"
+            or (
+                tuned_driver is None
+                and jnp.dtype(c_data.dtype) in (jnp.float64, jnp.complex128)
+                and S >= 2048
+            )
+        )
+    )
+    if want_group:
+        r0 = int(tuned.get("r0", 8)) if tuned else 8
+        if a_pad_row is None:
+            plan.append_a_pad = True
+            a_pad_row = a_data.shape[0]
+        if b_pad_row is None:
+            plan.append_b_pad = True
+            b_pad_row = b_data.shape[0]
+        chunk_groups = max(256, cfg.mm_stack_size // r0)
+        ga, gb, gc = build_group_tiles(
+            np.asarray(c_idx), np.asarray(a_idx), np.asarray(b_idx),
+            r0, a_pad_row, b_pad_row, plan.nseg, chunk_groups,
+        )
+        plan.driver = "xla_group"
+        plan.a_pad_row = a_pad_row
+        plan.b_pad_row = b_pad_row
+        plan.group_idx = (jnp.asarray(ga), jnp.asarray(gb), jnp.asarray(gc))
+        return plan
     if _pallas_supported(cfg, c_data, a_data, b_data):
         prefer_xla = (
             cfg.mm_driver == "auto" and tuned_driver in ("xla", "xla_flat")
@@ -319,6 +436,20 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0):
     """Device side: run a prepared plan against (possibly new) data."""
     if plan is None:
         return c_data
+    if plan.driver == "xla_group":
+        if plan.append_a_pad:
+            a_data = jnp.concatenate(
+                [a_data, jnp.zeros((1,) + a_data.shape[1:], a_data.dtype)]
+            )
+        if plan.append_b_pad:
+            b_data = jnp.concatenate(
+                [b_data, jnp.zeros((1,) + b_data.shape[1:], b_data.dtype)]
+            )
+        ga, gb, gc = plan.group_idx
+        alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)
+        return _process_stack_xla_group(
+            c_data, a_data, b_data, ga, gb, gc, alpha_dev
+        )
     if plan.driver == "pallas":
         from dbcsr_tpu.acc.pallas_smm import _pallas_process
 

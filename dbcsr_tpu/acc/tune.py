@@ -24,13 +24,19 @@ from dbcsr_tpu.core.kinds import dtype_of
 
 
 def _time_config(fn, nrep: int) -> float:
-    import jax
+    """Times include a data-dependent 8-byte fetch of the result —
+    `block_until_ready` alone can return before the device work ran on
+    remote-tunnel backends (the axon illusion, PERF_NOTES.md), which
+    produced the bogus round-2 table this replaces."""
 
-    jax.block_until_ready(fn())  # compile/warm
+    def _fence(x):
+        return float(np.asarray(x.ravel()[0]).real)
+
+    _fence(fn())  # compile/warm
     best = float("inf")
     for _ in range(nrep):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
+        _fence(fn())
         best = min(best, time.perf_counter() - t0)
     return best
 
@@ -100,6 +106,34 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
     t = _time_config(run_xla_flat, nrep)
     candidates.append({"driver": "xla_flat", "grouping": None, "gflops": flops / t / 1e9})
     out(f"  xla_flat: {flops / t / 1e9:.1f} GFLOP/s")
+
+    # R-tiled grouped layout (k-merged dots; see _process_stack_xla_group)
+    from dbcsr_tpu.acc.smm import _process_stack_xla_group, build_group_tiles
+
+    a_padded = jnp.concatenate([a, jnp.zeros((1, m, k), dtype)])
+    b_padded = jnp.concatenate([b, jnp.zeros((1, k, n), dtype)])
+    for r0 in (4, 8, 16):
+        ga, gb, gc = build_group_tiles(
+            ci, ai, bi, r0, na, nb, nc, max(256, 30000 // r0)
+        )
+        grp_args = (jnp.asarray(ga), jnp.asarray(gb), jnp.asarray(gc))
+
+        def run_group(grp_args=grp_args):
+            return _process_stack_xla_group(
+                jnp.zeros((nc, m, n), dtype), a_padded, b_padded, *grp_args,
+                jnp.asarray(1.0, dtype),
+            )
+
+        try:
+            t = _time_config(run_group, nrep)
+        except Exception as exc:
+            out(f"  xla_group r0={r0}: failed ({type(exc).__name__})")
+            continue
+        candidates.append(
+            {"driver": "xla_group", "grouping": None, "r0": r0,
+             "gflops": flops / t / 1e9}
+        )
+        out(f"  xla_group r0={r0}: {flops / t / 1e9:.1f} GFLOP/s")
 
     if pallas_smm.supports(jnp.zeros((1, m, n), dtype), a, b):
         zero_a, zero_b = na - 1, nb - 1

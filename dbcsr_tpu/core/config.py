@@ -19,7 +19,7 @@ import os
 @dataclasses.dataclass
 class Config:
     # --- multiply driver selection (ref MM_DRIVER {auto,matmul,blas,smm,xsmm},
-    #     dbcsr_config.F:34-38) -> here {auto, xla, pallas, dense}
+    #     dbcsr_config.F:34-38) -> here {auto, xla, xla_group, pallas, dense}
     mm_driver: str = "auto"
     # max entries pushed to the device per kernel call before flushing
     # (ref MM_STACK_SIZE: 30000 accel / 1000 CPU, dbcsr_config.F:77-79)
@@ -28,6 +28,11 @@ class Config:
     # (ref MM_DENSE + decision at dbcsr_mm.F:593-617); None = auto
     mm_dense: object = None
     dense_occ_threshold: float = 0.8
+    # TPU cost model for EMULATED dtypes (f64/c128): below the occupancy
+    # threshold, still go dense when dense_flops < ratio * true_flops —
+    # the measured dense:grouped-sparse throughput advantage on a v5e is
+    # ~320x for f64 (PERF_NOTES.md); 0 disables the cost model
+    dense_flop_ratio: float = 250.0
     # use the fused pallas SMM kernel when available (ref: libsmm_acc JIT
     # kernels vs cuBLAS loop)
     use_pallas: bool = True
@@ -55,7 +60,7 @@ class Config:
     num_layers_3d: int = 0
 
     def validate(self) -> None:
-        if self.mm_driver not in ("auto", "xla", "pallas", "dense"):
+        if self.mm_driver not in ("auto", "xla", "xla_group", "pallas", "dense"):
             raise ValueError(f"unknown mm_driver {self.mm_driver!r}")
         if self.mm_stack_size <= 0:
             raise ValueError("mm_stack_size must be positive")

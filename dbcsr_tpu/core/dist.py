@@ -119,3 +119,20 @@ def dist_bin(
         out[i] = b
         heapq.heappush(heap, (load + int(element_sizes[i]), b))
     return out
+
+
+def convert_sizes_to_offsets(sizes) -> np.ndarray:
+    """Block sizes -> start offsets, length n+1 with the total last
+    (ref `convert_sizes_to_offsets`, `src/dist/dbcsr_dist_util.F:140`)."""
+    sizes = np.ascontiguousarray(sizes, np.int64)
+    out = np.empty(len(sizes) + 1, np.int64)
+    out[0] = 0
+    np.cumsum(sizes, out=out[1:])
+    return out
+
+
+def convert_offsets_to_sizes(offsets) -> np.ndarray:
+    """Start offsets (length n+1) -> block sizes
+    (ref `convert_offsets_to_sizes`, `src/dist/dbcsr_dist_util.F:180`)."""
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    return np.diff(offsets)

@@ -175,6 +175,41 @@ class BlockSparseMatrix:
         full = self.nfullrows * self.nfullcols
         return self.nnz / full if full else 0.0
 
+    def setname(self, name: str) -> None:
+        """Ref `dbcsr_setname`."""
+        self.name = str(name)
+
+    @property
+    def valid_index(self) -> bool:
+        """Finalized and consistent (ref `dbcsr_valid_index`)."""
+        return self.valid
+
+    def get_data_size(self) -> int:
+        """Stored elements incl. bucket padding — the data-area size
+        (ref `dbcsr_get_data_size`)."""
+        return int(sum(b.capacity * b.shape[0] * b.shape[1] for b in self.bins))
+
+    def get_info(self) -> dict:
+        """Structure summary (ref `dbcsr_get_info`, `dbcsr_api.F`)."""
+        return {
+            "name": self.name,
+            "matrix_type": self.matrix_type,
+            "data_type": np.dtype(self.dtype).name,
+            "nblkrows_total": self.nblkrows,
+            "nblkcols_total": self.nblkcols,
+            "nfullrows_total": self.nfullrows,
+            "nfullcols_total": self.nfullcols,
+            "nblks": self.nblks,
+            "nze": self.nnz,
+            "data_size": self.get_data_size(),
+            "occupation": self.occupation(),
+            "row_blk_sizes": self.row_blk_sizes.copy(),
+            "col_blk_sizes": self.col_blk_sizes.copy(),
+            "row_blk_offsets": self.row_blk_offsets[:-1].copy(),
+            "col_blk_offsets": self.col_blk_offsets[:-1].copy(),
+            "distribution": self.dist,
+        }
+
     def block_shape(self, row: int, col: int) -> Tuple[int, int]:
         return int(self.row_blk_sizes[row]), int(self.col_blk_sizes[col])
 

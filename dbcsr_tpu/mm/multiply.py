@@ -159,7 +159,9 @@ def multiply(
         )
         if _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits):
             with timed("multiply_dense"):
+                c._mm_algorithm = "dense"
                 return _dense_multiply(a, b, c, alpha, beta)
+        c._mm_algorithm = "stack"
 
         with timed("multiply_index"):
             cand = _candidates(
@@ -229,9 +231,42 @@ def mask_in_sorted(cand_keys: np.ndarray, sorted_keys: np.ndarray) -> np.ndarray
     )
 
 
+def _true_product_flops(a, b) -> int:
+    """Exact flop count of the block-sparse product without enumerating
+    candidate triples: sum_k 2 * W_m(k) * W_n(k) * k_k where W_m(k) is
+    the total row extent of A's stored blocks in block-col k and W_n(k)
+    the total col extent of B's stored blocks in block-row k.  O(nblks)
+    — the 'true flops' of `dbcsr_mm.F:664-667`, computable up front."""
+    if a.nblks == 0 or b.nblks == 0:
+        return 0
+    ar, ac = a.entry_coords()
+    br, bc = b.entry_coords()
+    wa = np.bincount(ac, weights=a.row_blk_sizes[ar].astype(np.float64),
+                     minlength=a.nblkcols)
+    wb = np.bincount(br, weights=b.col_blk_sizes[bc].astype(np.float64),
+                     minlength=b.nblkrows)
+    kk = a.col_blk_sizes.astype(np.float64)
+    return int(round(2.0 * float(np.dot(wa * kk, wb))))
+
+
+# canvases beyond this element count make the dense cost model decline
+# (3 canvases must fit HBM comfortably; 10k^2 f64 = 0.8 GB each)
+_DENSE_MAX_CANVAS = 2 * 10**8
+
+
 def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits) -> bool:
     """Dense-mode decision (ref `dbcsr_mm.F:593-617`): near-full uniformly
-    blocked matrices degrade gracefully to one dense MXU matmul."""
+    blocked matrices degrade gracefully to one dense MXU matmul.
+
+    TPU extension beyond the reference's occupancy gate: for dtypes the
+    chip only EMULATES (f64/c128 run as split-f32/bf16 passes), tiny
+    per-block dots are so MXU-starved that one dense matmul beats the
+    stack path well below occ 0.1 — measured 2.33 TFLOP/s (marketing)
+    dense vs 7.3 GFLOP/s grouped-sparse for the 23^3 north-star config
+    (PERF_NOTES.md).  A flop-ratio cost model decides: go dense when
+    dense_flops < dense_flop_ratio * true_sparse_flops.  The result is
+    identical either way (same product, same final pattern semantics);
+    only time-to-solution changes."""
     from dbcsr_tpu.core.config import get_config
 
     cfg = get_config()
@@ -244,7 +279,32 @@ def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits) -> bool:
     if cfg.mm_dense is True or cfg.mm_driver == "dense":
         return True
     th = cfg.dense_occ_threshold
-    return a.occupation() >= th and b.occupation() >= th
+    if a.occupation() >= th and b.occupation() >= th:
+        return True
+    # emulated-dtype cost model (TPU only).  Guards beyond the flop
+    # ratio: an explicitly forced stack driver wins, and the product's
+    # EXPECTED block fill must be near-full — dense mode stores the full
+    # pattern, which must not silently densify a structurally sparse
+    # C (block-diagonal/banded operands keep the stack path).
+    if cfg.mm_driver != "auto":
+        return False
+    if cfg.dense_flop_ratio <= 0:
+        return False
+    if np.dtype(c.dtype) not in (np.float64, np.complex128):
+        return False
+    if jax.devices()[0].platform != "tpu":
+        return False
+    mm, nn, kk = a.nfullrows, b.nfullcols, a.nfullcols
+    if max(mm * kk, kk * nn, mm * nn) > _DENSE_MAX_CANVAS:
+        return False
+    # expected candidate fill under a random-pattern model:
+    # lambda = E[#contributing k per C block] = nnz_A*nnz_B/(nbr*nbc*nbk)
+    denom = float(a.nblkrows) * b.nblkcols * a.nblkcols
+    lam = float(a.nblks) * b.nblks / denom if denom else 0.0
+    if 1.0 - np.exp(-lam) < 0.5:
+        return False
+    dense_flops = 2.0 * mm * nn * kk
+    return dense_flops < cfg.dense_flop_ratio * _true_product_flops(a, b)
 
 
 @functools.partial(jax.jit, static_argnames=("nbr", "nbc", "bm", "bn"))
@@ -357,9 +417,11 @@ def _dense_multiply_general(a, b, c, alpha, beta) -> int:
             )
         bins.append(_Bin((int(bm), int(bn)), data, count))
     c.set_structure_from_device(new_keys, bins, binning=(nb, nsl, shapes))
-    flops = 2 * c.nfullrows * c.nfullcols * a.nfullcols
-    stats.record_multiply(flops)
-    return flops
+    # marketing flops = the dense work performed; the RETURN value is the
+    # true flops of the sparse product (comparable across algorithms,
+    # ref marketing-vs-true `dbcsr_mm.F:664-667`)
+    stats.record_multiply(2 * c.nfullrows * c.nfullcols * a.nfullcols)
+    return _true_product_flops(a, b)
 
 
 def _dense_multiply(a, b, c, alpha, beta) -> int:
@@ -401,10 +463,9 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
     if pad:
         out = jnp.concatenate([out, jnp.zeros((pad, bm, bn), out.dtype)])
     c.set_structure_from_device(new_keys, [_Bin((bm, bn), out, len(new_keys))])
-    flops = 2 * nbr * bm * nbc * bn * nbk * bk
     stats.record_stack(bm, bn, bk, nbr * nbc * nbk)
-    stats.record_multiply(flops)
-    return flops
+    stats.record_multiply(2 * nbr * bm * nbc * bn * nbk * bk)
+    return _true_product_flops(a, b)
 
 
 def _apply_element_limits(a, b, c, element_limits):

@@ -136,3 +136,138 @@ def complete_redistribute(
     return matrix_from_csr(
         name or matrix.name, indptr, indices, data, new_rbs, new_cbs, dist
     )
+
+
+# ------------------------------------------------------------- csr_type API
+# row-distribution modes for a CSR matrix over processes
+# (ref `dbcsr_csr_conversions.F:70,769-799`)
+CSR_DBCSR_BLKROW_DIST = 1  # whole DBCSR block rows per process
+CSR_EQROW_CEIL_DIST = 2    # ceiling(N/P) rows per process
+CSR_EQROW_FLOOR_DIST = 3   # floor(N/P) rows per process (last takes rest)
+
+
+def csr_eqrow_ceil_dist(nrows: int, nbins: int) -> np.ndarray:
+    """Row -> bin map with ceiling(N/P) rows per bin
+    (ref csr_eqrow_ceil_dist)."""
+    per = -(-nrows // max(nbins, 1))
+    return np.minimum(np.arange(nrows, dtype=np.int64) // max(per, 1),
+                      nbins - 1).astype(np.int32)
+
+
+def csr_eqrow_floor_dist(nrows: int, nbins: int) -> np.ndarray:
+    """Row -> bin map with floor(N/P) rows per bin; the last bin takes
+    the remainder (ref csr_eqrow_floor_dist)."""
+    per = max(nrows // max(nbins, 1), 1)
+    return np.minimum(np.arange(nrows, dtype=np.int64) // per,
+                      nbins - 1).astype(np.int32)
+
+
+def csr_blkrow_dist(matrix: BlockSparseMatrix, nbins: int) -> np.ndarray:
+    """Row -> bin map that never splits a DBCSR block row across bins
+    (ref csr_dbcsr_blkrow_dist): block rows are assigned by cumulative
+    element-row count, balancing rows per bin."""
+    sizes = matrix.row_blk_sizes.astype(np.int64)
+    total = int(sizes.sum())
+    starts = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    blk_bin = np.minimum(starts * nbins // max(total, 1), nbins - 1)
+    return np.repeat(blk_bin, sizes).astype(np.int32)
+
+
+class CsrMatrix:
+    """Element CSR with an optional row distribution — the `csr_type`
+    analog (ref `dbcsr_csr_conversions.F:115-143`)."""
+
+    def __init__(self, nrows, ncols, indptr, indices, data, row_dist=None):
+        self.nrows = int(nrows)
+        self.ncols = int(ncols)
+        self.indptr = np.ascontiguousarray(indptr, np.int64)
+        self.indices = np.ascontiguousarray(indices, np.int64)
+        self.data = np.ascontiguousarray(data)
+        self.row_dist = row_dist
+        self.valid = True
+
+    @property
+    def nze(self) -> int:
+        return len(self.data)
+
+
+def csr_create_from_matrix(
+    matrix: BlockSparseMatrix,
+    nprocs: int = 1,
+    dist_format: int = CSR_EQROW_CEIL_DIST,
+    keep_zeros: bool = False,
+) -> CsrMatrix:
+    """Block-sparse -> `CsrMatrix` with a row distribution in the
+    requested format (ref `dbcsr_csr_create_from_dbcsr`,
+    `dbcsr_csr_conversions.F:762`)."""
+    indptr, indices, data = csr_from_matrix(matrix, keep_zeros=keep_zeros)
+    nrows, ncols = matrix.nfullrows, matrix.nfullcols
+    if dist_format == CSR_EQROW_CEIL_DIST:
+        rd = csr_eqrow_ceil_dist(nrows, nprocs)
+    elif dist_format == CSR_EQROW_FLOOR_DIST:
+        rd = csr_eqrow_floor_dist(nrows, nprocs)
+    elif dist_format == CSR_DBCSR_BLKROW_DIST:
+        rd = csr_blkrow_dist(matrix, nprocs)
+    else:
+        raise ValueError(f"unknown dist_format {dist_format}")
+    return CsrMatrix(nrows, ncols, indptr, indices, data, row_dist=rd)
+
+
+def to_csr_filter(matrix: BlockSparseMatrix, eps: float) -> BlockSparseMatrix:
+    """0/1 sparsity template of ``matrix`` with elements |x| < eps
+    marked 0 — improves CSR sparsity before conversion
+    (ref `dbcsr_to_csr_filter`, `dbcsr_csr_conversions.F:1027`)."""
+    import jax.numpy as jnp
+
+    out = matrix.copy(name="CSR sparsity")
+    if not out.valid:
+        out.finalize()
+    if eps > 0.0:
+        out.map_bin_data(
+            lambda d: jnp.where(jnp.abs(d) < eps, 0.0, 1.0).astype(d.dtype)
+        )
+    else:
+        out.map_bin_data(lambda d: jnp.ones_like(d))
+    return out
+
+
+def csr_write(csr: CsrMatrix, file, upper_triangle: bool = False,
+              threshold: float = 0.0, binary: bool = False) -> None:
+    """Write a CSR matrix: text lines "row col value" (1-based) or a
+    raw binary dump (ref `csr_write`, `dbcsr_csr_conversions.F:1085`)."""
+    if not csr.valid:
+        raise RuntimeError("cannot write an invalid CSR matrix")
+    rows = np.repeat(np.arange(csr.nrows, dtype=np.int64),
+                     np.diff(csr.indptr))
+    cols = csr.indices
+    vals = csr.data
+    keep = np.ones(len(vals), bool)
+    if upper_triangle:
+        keep &= cols >= rows
+    if threshold > 0.0:
+        keep &= np.abs(vals) >= threshold
+    rows, cols, vals = rows[keep], cols[keep], vals[keep]
+    if binary:
+        np.asarray([csr.nrows, csr.ncols, len(vals)], np.int64).tofile(file)
+        rows.tofile(file)
+        cols.tofile(file)
+        vals.tofile(file)
+        return
+    if np.iscomplexobj(vals):
+        for r, c, v in zip(rows, cols, vals):
+            file.write(f"{r + 1} {c + 1} {v.real:.14E} {v.imag:.14E}\n")
+    else:
+        for r, c, v in zip(rows, cols, vals):
+            file.write(f"{r + 1} {c + 1} {v:.14E}\n")
+
+
+def csr_print_sparsity(csr: CsrMatrix, file=None) -> None:
+    """Print CSR non-zero count and percentage
+    (ref `csr_print_sparsity`, `dbcsr_csr_conversions.F:1284`)."""
+    import sys
+
+    out = file or sys.stdout
+    pct = 100.0 * csr.nze / max(csr.nrows * csr.ncols, 1)
+    print(f"{'Number of  CSR non-zero elements:':>48} {csr.nze:>13d}",
+          file=out)
+    print(f"{'Percentage CSR non-zero elements:':>48} {pct:>6.2f}", file=out)

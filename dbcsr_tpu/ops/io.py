@@ -103,3 +103,48 @@ def binary_read(
     m._shape_to_bin = {b.shape: i for i, b in enumerate(bins)}
     m.valid = True
     return m
+
+
+def print_matrix(
+    matrix: BlockSparseMatrix, file=None, nodata: bool = False
+) -> None:
+    """Human-readable dump: header plus every stored block
+    (ref `dbcsr_print`, `src/ops/dbcsr_io.F`)."""
+    import sys
+
+    out = file or sys.stdout
+    info = matrix.get_info()
+    print(
+        f"DBCSR {info['name']!r} {info['nfullrows_total']}x{info['nfullcols_total']} "
+        f"({info['nblkrows_total']}x{info['nblkcols_total']} blocks), "
+        f"type={info['matrix_type']}, dtype={info['data_type']}, "
+        f"{info['nblks']} blocks stored, occ={info['occupation']:.4f}",
+        file=out,
+    )
+    if nodata:
+        return
+    for r, c, blk in matrix.iterate_blocks():
+        print(f" block ({r},{c}) {blk.shape[0]}x{blk.shape[1]}:", file=out)
+        with np.printoptions(precision=6, suppress=True):
+            print(np.array2string(blk, prefix="  "), file=out)
+
+
+def print_block_sum(matrix: BlockSparseMatrix, file=None) -> None:
+    """Print the element sum of each stored block, one line per block —
+    a cheap cross-implementation fingerprint (ref `dbcsr_print_block_sum`,
+    `src/ops/dbcsr_io.F:1081`)."""
+    import sys
+
+    import jax.numpy as jnp
+
+    out = file or sys.stdout
+    sums = np.zeros(matrix.nblks, np.dtype(matrix.dtype))
+    for b_id, b in enumerate(matrix.bins):
+        if b.count == 0:
+            continue
+        mask = matrix.ent_bin == b_id
+        bin_sums = np.asarray(jnp.sum(b.data, axis=(1, 2)))
+        sums[mask] = bin_sums[matrix.ent_slot[mask]]
+    rows, cols = matrix.entry_coords()
+    for e in range(matrix.nblks):
+        print(f"{int(rows[e]) + 1:7d} {int(cols[e]) + 1:7d} {sums[e]:.10E}", file=out)

@@ -17,6 +17,19 @@ from dbcsr_tpu.core.kinds import dtype_of, is_complex
 from dbcsr_tpu.core.matrix import NO_SYMMETRY, BlockSparseMatrix
 
 
+# module-level generator used when no rng is passed; re-seedable like
+# the reference's global random-matrix seed (ref `dbcsr_reset_randmat_seed`)
+_RANDMAT_SEED = 0
+_randmat_rng = np.random.default_rng(_RANDMAT_SEED)
+
+
+def reset_randmat_seed(seed: int = _RANDMAT_SEED) -> None:
+    """Reset the default random-matrix stream (ref
+    `dbcsr_reset_randmat_seed`, `dbcsr_api.F:177`) so runs reproduce."""
+    global _randmat_rng
+    _randmat_rng = np.random.default_rng(seed)
+
+
 def make_random_matrix(
     name: str,
     row_blk_sizes,
@@ -29,7 +42,7 @@ def make_random_matrix(
 ) -> BlockSparseMatrix:
     """Random block-sparse matrix with ~`occupation` block fill
     (ref `dbcsr_make_random_matrix`, `dbcsr_test_methods.F:70`)."""
-    rng = rng or np.random.default_rng(0)
+    rng = rng or _randmat_rng
     m = BlockSparseMatrix(name, row_blk_sizes, col_blk_sizes, dtype, dist, matrix_type)
     dt = dtype_of(dtype)
     nbr, nbc = m.nblkrows, m.nblkcols

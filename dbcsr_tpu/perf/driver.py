@@ -304,6 +304,9 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
         "checksum_c_in": chksum_c_in,
         "device": str(jax.devices()[0]),
         "grid": dict(mesh.shape) if mesh is not None else {"pr": 1, "pc": 1},
+        # which algorithm the engine chose ("dense" = cost-model dense
+        # mode; GFLOP/s above is always TRUE sparse-product flops / time)
+        "algorithm": getattr(c_run, "_mm_algorithm", "mesh"),
     }
     if verbose:
         print(f" matrix sizes M/N/K          {cfg.m} {cfg.n} {cfg.k}")

@@ -1,0 +1,262 @@
+"""Tests for the reference-API parity surface added in round 2:
+block access (set/clear/reserve/copy_into_existing/get_block_diag),
+named element functions, info getters, converters, print helpers, and
+the built-in randomized test driver (ref `dbcsr_api.F:151-305`,
+`dbcsr_tests.F:74`)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import dbcsr_tpu as dt
+from dbcsr_tpu import (
+    checksum,
+    clear,
+    copy_into_existing,
+    create,
+    get_block_diag,
+    make_random_matrix,
+    reserve_all_blocks,
+    reserve_blocks,
+    reserve_diag_blocks,
+    run_tests,
+    set_value,
+    to_dense,
+)
+
+
+def _rand(name, rbs, cbs, occ, seed=0, **kw):
+    return make_random_matrix(name, rbs, cbs, occupation=occ,
+                              rng=np.random.default_rng(seed), **kw)
+
+
+# ---------------------------------------------------------------- set/clear
+def test_set_value_keeps_pattern():
+    m = _rand("m", [2, 3], [3, 2], 0.6, seed=1)
+    keys_before = m.keys.copy()
+    set_value(m, 2.5)
+    assert np.array_equal(m.keys, keys_before)
+    d = to_dense(m)
+    for r, c, blk in m.iterate_blocks():
+        np.testing.assert_allclose(blk, 2.5)
+    # absent blocks stay zero
+    assert np.count_nonzero(d) == m.nnz
+
+
+def test_set_value_zero_is_zero_data():
+    m = _rand("m", [2, 3], [3, 2], 0.6, seed=2)
+    set_value(m, 0.0)
+    assert m.nblks > 0
+    np.testing.assert_allclose(to_dense(m), 0.0)
+
+
+def test_clear_removes_all_blocks():
+    m = _rand("m", [2, 3], [3, 2], 0.8, seed=3)
+    dist = m.dist
+    clear(m)
+    assert m.nblks == 0
+    assert m.valid
+    assert m.dist is dist
+    # still usable
+    m.put_block(0, 0, np.ones((2, 3)))
+    m.finalize()
+    assert m.nblks == 1
+
+
+# ------------------------------------------------------------ block diag
+def test_get_block_diag():
+    m = _rand("m", [2, 3, 4], [2, 3, 4], 1.0, seed=4)
+    d = get_block_diag(m)
+    assert d.nblks == 3
+    for r, c, blk in d.iterate_blocks():
+        assert r == c
+        np.testing.assert_allclose(blk, m.get_block(r, c))
+    # original untouched
+    assert m.nblks == 9
+
+
+# ----------------------------------------------------- copy_into_existing
+def test_copy_into_existing_semantics():
+    a = _rand("a", [2, 3], [3, 2], 0.5, seed=5)
+    b = _rand("b", [2, 3], [3, 2], 0.5, seed=6)
+    b_keys = b.keys.copy()
+    da = to_dense(a)
+    copy_into_existing(b, a)
+    assert np.array_equal(b.keys, b_keys)  # pattern retained
+    for r, c, blk in b.iterate_blocks():
+        src = a.get_block(r, c)
+        if src is None:
+            np.testing.assert_allclose(blk, 0.0)  # zeroed
+        else:
+            np.testing.assert_allclose(blk, src)  # copied
+    del da
+
+
+def test_copy_into_existing_rejects_mismatch():
+    a = _rand("a", [2, 3], [3, 2], 0.5, seed=7)
+    b = _rand("b", [3, 2], [3, 2], 0.5, seed=8)
+    with pytest.raises(ValueError):
+        copy_into_existing(b, a)
+
+
+# ----------------------------------------------------------------- reserve
+def test_reserve_blocks_preserves_and_creates():
+    m = _rand("m", [2, 3], [3, 2], 0.0, seed=9)
+    m.put_block(0, 0, np.full((2, 3), 7.0))
+    m.finalize()
+    reserve_blocks(m, [0, 1], [0, 1])
+    assert m.nblks == 2
+    np.testing.assert_allclose(m.get_block(0, 0), 7.0)  # existing kept
+    np.testing.assert_allclose(m.get_block(1, 1), 0.0)  # new is zero
+
+
+def test_reserve_diag_and_all():
+    m = create("m", [2, 3, 4], [2, 3, 4])
+    reserve_diag_blocks(m)
+    assert m.nblks == 3
+    reserve_all_blocks(m)
+    assert m.nblks == 9
+    s = create("s", [2, 3], [2, 3], matrix_type="S")
+    reserve_all_blocks(s)
+    assert s.nblks == 3  # canonical upper triangle
+
+
+# ------------------------------------------------------------- named funcs
+def test_named_funcs_values():
+    m = _rand("m", [3], [3], 1.0, seed=10)
+    x = to_dense(m).copy()
+    cases = [
+        (dt.FUNC_TANH, 0.1, 2.0, np.tanh(2.0 * x + 0.1)),
+        (dt.FUNC_DTANH, 0.1, 2.0, 2.0 * (1 - np.tanh(2.0 * x + 0.1) ** 2)),
+        (dt.FUNC_SIN, 0.2, 1.5, np.sin(1.5 * x + 0.2)),
+        (dt.FUNC_COS, 0.2, 1.5, np.cos(1.5 * x + 0.2)),
+        (dt.FUNC_DSIN, 0.2, 1.5, 1.5 * np.cos(1.5 * x + 0.2)),
+        (dt.FUNC_DDSIN, 0.2, 1.5, -1.5 ** 2 * np.sin(1.5 * x + 0.2)),
+        (dt.FUNC_TRUNCATE, 0.5, 1.0,
+         np.where(np.abs(x) > 0.5, np.copysign(0.5, x), x)),
+        (dt.FUNC_SPREAD_FROM_ZERO, 0.5, 1.0,
+         np.where(np.abs(x) < 0.5, np.copysign(0.5, x), x)),
+        (dt.FUNC_INVERSE, 0.1, 2.0, 1.0 / (2.0 * x + 0.1)),
+        (dt.FUNC_INVERSE_SPECIAL, 0.3, 1.0, 1.0 / (x + np.copysign(0.3, x))),
+    ]
+    for fn, a0, a1, want in cases:
+        mm = m.copy()
+        dt.function_of_elements(mm, fn, a0=a0, a1=a1)
+        np.testing.assert_allclose(to_dense(mm), want, rtol=1e-12,
+                                   err_msg=str(fn))
+
+
+def test_named_funcs_scaled_domain():
+    m = create("m", [2], [2])
+    m.put_block(0, 0, np.array([[0.2, -0.3], [0.1, 0.4]]))
+    m.finalize()
+    mm = m.copy()
+    dt.function_of_elements(mm, dt.FUNC_ARTANH, a1=1.0)
+    np.testing.assert_allclose(to_dense(mm), np.arctanh(to_dense(m)), rtol=1e-12)
+    mm = m.copy()
+    dt.function_of_elements(mm, dt.FUNC_ASIN)
+    np.testing.assert_allclose(to_dense(mm), np.arcsin(to_dense(m)), rtol=1e-12)
+
+
+def test_named_funcs_domain_errors():
+    m = create("m", [2], [2])
+    m.put_block(0, 0, np.array([[0.5, 2.0], [0.1, 0.4]]))  # |2.0| >= 1
+    m.finalize()
+    with pytest.raises(FloatingPointError):
+        dt.function_of_elements(m.copy(), dt.FUNC_ARTANH)
+    with pytest.raises(FloatingPointError):
+        dt.function_of_elements(m.copy(), dt.FUNC_ASIN)
+    z = create("z", [2], [2])
+    z.put_block(0, 0, np.zeros((2, 2)))
+    z.finalize()
+    with pytest.raises(FloatingPointError):
+        dt.function_of_elements(z, dt.FUNC_INVERSE)  # 1/0
+
+
+def test_named_funcs_callable_still_works():
+    import jax.numpy as jnp
+
+    m = _rand("m", [3], [3], 1.0, seed=11)
+    x = to_dense(m).copy()
+    dt.function_of_elements(m, jnp.exp)
+    np.testing.assert_allclose(to_dense(m), np.exp(x), rtol=1e-12)
+
+
+# ------------------------------------------------------------ info getters
+def test_get_info_and_setname():
+    m = _rand("m", [2, 3], [4, 1], 0.9, seed=12)
+    info = m.get_info()
+    assert info["nblkrows_total"] == 2
+    assert info["nblkcols_total"] == 2
+    assert info["nfullrows_total"] == 5
+    assert info["nfullcols_total"] == 5
+    assert info["nblks"] == m.nblks
+    assert info["nze"] == m.nnz
+    assert info["data_size"] >= m.nnz
+    assert 0 < info["occupation"] <= 1
+    m.setname("renamed")
+    assert m.name == "renamed"
+    assert m.valid_index
+
+
+def test_offsets_sizes_converters():
+    sizes = [2, 3, 4]
+    off = dt.convert_sizes_to_offsets(sizes)
+    np.testing.assert_array_equal(off, [0, 2, 5, 9])
+    np.testing.assert_array_equal(dt.convert_offsets_to_sizes(off), sizes)
+
+
+# ------------------------------------------------------------------ prints
+def test_print_matrix_and_block_sum():
+    m = _rand("m", [2, 3], [3, 2], 1.0, seed=13)
+    buf = io.StringIO()
+    dt.print_matrix(m, file=buf)
+    text = buf.getvalue()
+    assert "block (0,0)" in text and "DBCSR" in text
+    buf = io.StringIO()
+    dt.print_block_sum(m, file=buf)
+    lines = buf.getvalue().strip().splitlines()
+    assert len(lines) == m.nblks
+    got = float(lines[0].split()[2])
+    want = float(np.sum(m.get_block(0, 0)))
+    assert abs(got - want) < 1e-9 * max(1.0, abs(want))
+
+
+# -------------------------------------------------------------- run_tests
+def test_run_tests_mm():
+    out = []
+    cs = run_tests((48, 36, 52), sparsities=(0.4, 0.4, 0.4),
+                   alpha=1.5, beta=0.5, n_loops=2, io=out.append)
+    assert len(cs) == 2 and cs[0] == cs[1]
+    assert out  # produced a report line
+
+
+def test_run_tests_mm_transposed_retain():
+    cs = run_tests((30, 30, 40), trs=(True, True),
+                   sparsities=(0.3, 0.3, 0.5), retain_sparsity=True,
+                   n_loops=1, io=lambda *_: None)
+    assert len(cs) == 1
+
+
+def test_run_tests_binary_io():
+    cs = run_tests((30, 30, 30), test_type=dt.TEST_BINARY_IO, n_loops=2,
+                   io=lambda *_: None)
+    assert len(cs) == 2
+
+
+def test_make_random_block_sizes_covers():
+    from dbcsr_tpu.ops.tests import make_random_block_sizes
+
+    sizes = make_random_block_sizes(100, (1, 13, 2, 5),
+                                    rng=np.random.default_rng(0))
+    assert sizes.sum() == 100
+    assert set(np.unique(sizes)) <= {13, 5} | set(range(1, 14))
+
+
+def test_reset_randmat_seed_reproduces():
+    dt.reset_randmat_seed(7)
+    m1 = make_random_matrix("x", [3, 3], [3, 3], occupation=0.7)
+    dt.reset_randmat_seed(7)
+    m2 = make_random_matrix("x", [3, 3], [3, 3], occupation=0.7)
+    assert checksum(m1) == checksum(m2)

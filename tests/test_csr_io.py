@@ -90,3 +90,66 @@ def test_replicate_on_mesh():
     mesh = make_grid(8)
     dm = replicate(m, mesh)
     np.testing.assert_array_equal(to_dense(collect(dm)), to_dense(m))
+
+
+# ------------------------------------------------- csr_type API (round 2)
+def test_csr_create_from_matrix_dists():
+    from dbcsr_tpu import (
+        CSR_DBCSR_BLKROW_DIST,
+        CSR_EQROW_CEIL_DIST,
+        CSR_EQROW_FLOOR_DIST,
+        csr_create_from_matrix,
+    )
+
+    rng = np.random.default_rng(5)
+    m = make_random_matrix("m", [3, 4, 2], [2, 3, 4], occupation=0.8, rng=rng)
+    for fmt in (CSR_EQROW_CEIL_DIST, CSR_EQROW_FLOOR_DIST,
+                CSR_DBCSR_BLKROW_DIST):
+        csr = csr_create_from_matrix(m, nprocs=3, dist_format=fmt)
+        assert csr.nrows == 9 and csr.ncols == 9
+        assert len(csr.row_dist) == 9
+        assert csr.row_dist.min() >= 0 and csr.row_dist.max() <= 2
+        # contiguous, monotone row blocks per process
+        assert (np.diff(csr.row_dist) >= 0).all()
+    # blkrow: block rows never split (sizes 3,4,2)
+    csr = csr_create_from_matrix(m, nprocs=3,
+                                 dist_format=CSR_DBCSR_BLKROW_DIST)
+    bounds = np.concatenate([[0], np.cumsum([3, 4, 2])])
+    for b0, b1 in zip(bounds[:-1], bounds[1:]):
+        assert len(set(csr.row_dist[b0:b1])) == 1
+
+
+def test_to_csr_filter_template():
+    from dbcsr_tpu import create, to_csr_filter, to_dense
+
+    m = create("m", [2, 2], [2, 2])
+    m.put_block(0, 0, np.array([[1e-8, 0.5], [2.0, 1e-12]]))
+    m.finalize()
+    t = to_csr_filter(m, 1e-6)
+    d = to_dense(t)
+    np.testing.assert_array_equal(d[:2, :2], [[0.0, 1.0], [1.0, 0.0]])
+    t0 = to_csr_filter(m, 0.0)
+    np.testing.assert_array_equal(to_dense(t0)[:2, :2], 1.0)
+
+
+def test_csr_write_and_print_sparsity():
+    import io as _io
+
+    from dbcsr_tpu import csr_create_from_matrix, csr_print_sparsity, csr_write
+
+    rng = np.random.default_rng(6)
+    m = make_random_matrix("m", [2, 3], [3, 2], occupation=1.0, rng=rng)
+    csr = csr_create_from_matrix(m)
+    buf = _io.StringIO()
+    csr_write(csr, buf)
+    lines = buf.getvalue().strip().splitlines()
+    assert len(lines) == csr.nze
+    r, c, v = lines[0].split()
+    assert int(r) >= 1 and int(c) >= 1
+    # threshold + upper triangle filters
+    buf2 = _io.StringIO()
+    csr_write(csr, buf2, upper_triangle=True, threshold=0.5)
+    assert len(buf2.getvalue().splitlines()) <= len(lines)
+    buf3 = _io.StringIO()
+    csr_print_sparsity(csr, buf3)
+    assert "non-zero" in buf3.getvalue()

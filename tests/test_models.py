@@ -154,3 +154,28 @@ def test_invsqrt_with_filtering_still_accurate():
     got = to_dense(z) / np.sqrt(sf)
     ds = to_dense(s)
     np.testing.assert_allclose(got @ ds @ got, np.eye(n), rtol=1e-6, atol=1e-6)
+
+
+def test_invsqrt_step_matches_iteration_formulation():
+    """One public invsqrt_step == one inline iteration step (the two
+    formulations of T must stay in sync)."""
+    from dbcsr_tpu.models.invsqrt import _identity_like, invsqrt_step
+    from dbcsr_tpu.ops.operations import gershgorin_norm, scale
+    from dbcsr_tpu.ops.test_methods import from_dense, make_random_matrix
+
+    rng = np.random.default_rng(31)
+    n = 4
+    rbs = [3] * n
+    a = make_random_matrix("A", rbs, rbs, occupation=0.7, rng=rng)
+    d = to_dense(a)
+    spd = d @ d.T + 0.5 * np.eye(d.shape[0])
+    s = from_dense("S", spd, rbs, rbs)
+    sf = gershgorin_norm(s)
+    y = s.copy("Y")
+    scale(y, 1.0 / sf)
+    z = _identity_like(s)
+    y1, z1 = invsqrt_step(y, z)
+    dy, dz = to_dense(y), to_dense(z)
+    t = (3.0 * np.eye(dy.shape[0]) - dz @ dy) / 2.0
+    np.testing.assert_allclose(to_dense(y1), dy @ t, rtol=1e-11, atol=1e-11)
+    np.testing.assert_allclose(to_dense(z1), t @ dz, rtol=1e-11, atol=1e-11)
