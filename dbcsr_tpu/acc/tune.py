@@ -113,8 +113,9 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
     a_padded = jnp.concatenate([a, jnp.zeros((1, m, k), dtype)])
     b_padded = jnp.concatenate([b, jnp.zeros((1, k, n), dtype)])
     for r0 in (4, 8, 16):
+        # chunking mirrors prepare_stack's production choice
         ga, gb, gc = build_group_tiles(
-            ci, ai, bi, r0, na, nb, nc, max(256, 30000 // r0)
+            ci, ai, bi, r0, na, nb, nc, max(256, stack_size // r0)
         )
         grp_args = (jnp.asarray(ga), jnp.asarray(gb), jnp.asarray(gc))
 

@@ -297,35 +297,63 @@ def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits) -> bool:
     mm, nn, kk = a.nfullrows, b.nfullcols, a.nfullcols
     if max(mm * kk, kk * nn, mm * nn) > _DENSE_MAX_CANVAS:
         return False
-    # expected candidate fill under a random-pattern model:
-    # lambda = E[#contributing k per C block] = nnz_A*nnz_B/(nbr*nbc*nbk)
-    denom = float(a.nblkrows) * b.nblkcols * a.nblkcols
-    lam = float(a.nblks) * b.nblks / denom if denom else 0.0
-    if 1.0 - np.exp(-lam) < 0.5:
+    if _candidate_fill(a, b) < 0.5:
         return False
     dense_flops = 2.0 * mm * nn * kk
     return dense_flops < cfg.dense_flop_ratio * _true_product_flops(a, b)
 
 
+def _candidate_fill(a, b) -> float:
+    """Fraction of C blocks the symbolic product would store.  EXACT
+    (one host float32 boolean matmul over the block grids) when the
+    grid volume allows — structured patterns (triangular, banded) are
+    what the guard exists for, and a random-pattern estimate misses
+    them; beyond ~1e9 grid volume, fall back to the Poisson model."""
+    nbr, nbk, nbc = a.nblkrows, a.nblkcols, b.nblkcols
+    if a.nblks == 0 or b.nblks == 0 or nbr * nbc == 0:
+        return 0.0
+    if float(nbr) * nbk * nbc <= 1e9:
+        ar, ac = a.entry_coords()
+        br, bc = b.entry_coords()
+        ia = np.zeros((nbr, nbk), np.float32)
+        ia[ar, ac] = 1.0
+        ib = np.zeros((nbk, nbc), np.float32)
+        ib[br, bc] = 1.0
+        return float(np.count_nonzero(ia @ ib)) / (nbr * nbc)
+    lam = float(a.nblks) * b.nblks / (float(nbr) * nbc * nbk)
+    return 1.0 - float(np.exp(-lam))
+
+
 @functools.partial(jax.jit, static_argnames=("nbr", "nbc", "bm", "bn"))
 def _blocks_to_dense(data, rows, cols, nbr, nbc, bm, bn):
-    grid = jnp.zeros((nbr, nbc, bm, bn), data.dtype)
-    grid = grid.at[rows, cols].set(data, mode="drop")
-    return grid.transpose(0, 2, 1, 3).reshape(nbr * bm, nbc * bn)
+    """Uniform-blocked scatter to a 2-D canvas via element offsets.
+
+    Deliberately NOT via an (nbr, nbc, bm, bn) grid intermediate: TPU
+    tile padding blows a (435, 435, 23, 23) f64 grid up 5.8x (~4.5 GB);
+    the 2-D canvas pads ~1.0x.  Three such grid temps pushed the
+    nonempty-C north-star dense multiply from ~1 s to ~6.7 s (HBM
+    thrash/remat)."""
+    ro = (rows * bm).astype(jnp.int32)
+    co = (cols * bn).astype(jnp.int32)
+    canvas = jnp.zeros((nbr * bm, nbc * bn), data.dtype)
+    return _scatter_bin_to_canvas(canvas, data, ro, co, bm=bm, bn=bn)
 
 
 @functools.partial(jax.jit, donate_argnums=2, static_argnames=("nbr", "nbc", "bm", "bn"))
-def _dense_product_to_blocks(ad, bd, c_blocks, c_rows, c_cols, alpha, beta, nbr, nbc, bm, bn):
+def _dense_product_to_blocks(ad, bd, c_blocks, c_keys, alpha, beta, nbr, nbc, bm, bn):
+    """Matmul on 2-D canvases, then carve the FULL row-major block
+    pattern straight off the product canvas and scatter-add beta*old
+    in block layout (position of old key k in the full pattern = k)."""
     acc = ad.dtype
     cd = jax.lax.dot_general(
         ad, bd, (((1,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=acc,
     )
-    grid = cd.reshape(nbr, bm, nbc, bn).transpose(0, 2, 1, 3)
-    old = jnp.zeros((nbr, nbc, bm, bn), cd.dtype)
-    old = old.at[c_rows, c_cols].set(c_blocks, mode="drop")
-    out = alpha * grid + beta * old
-    return out.reshape(nbr * nbc, bm, bn)
+    keys = jnp.arange(nbr * nbc, dtype=jnp.int32)
+    ro = (keys // nbc) * bm
+    co = (keys % nbc) * bn
+    out = alpha * _gather_bin_from_canvas(cd, ro, co, bm=bm, bn=bn)
+    return out.at[c_keys].add(beta * c_blocks.astype(acc), mode="drop")
 
 
 @functools.partial(jax.jit, donate_argnums=0, static_argnames=("bm", "bn"))
@@ -445,7 +473,6 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
         b.bins[0].data[: b.nblks] if b.nblks else jnp.zeros((0, bk, bn), c.dtype),
         jnp.asarray(br_), jnp.asarray(bc_), nbk, nbc, bk, bn,
     )
-    cr, cc = c.entry_coords()
     c_blocks = (
         c.bins[0].data[: c.nblks]
         if c.nblks
@@ -454,7 +481,7 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
     alpha_dev = jnp.asarray(alpha, dtype=c.dtype)
     beta_dev = jnp.asarray(beta, dtype=c.dtype)
     out = _dense_product_to_blocks(
-        ad, bd, c_blocks, jnp.asarray(cr), jnp.asarray(cc),
+        ad, bd, c_blocks, jnp.asarray(c.keys.astype(np.int32)),
         alpha_dev, beta_dev, nbr, nbc, bm, bn,
     )
     new_keys = np.arange(nbr * nbc, dtype=np.int64)  # full pattern, row-major

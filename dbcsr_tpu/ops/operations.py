@@ -303,11 +303,32 @@ def get_block_diag(
     matrix: BlockSparseMatrix, name: Optional[str] = None
 ) -> BlockSparseMatrix:
     """New matrix holding only the diagonal blocks of ``matrix``
-    (ref `dbcsr_get_block_diag`, `dbcsr_operations.F:1158`)."""
+    (ref `dbcsr_get_block_diag`, `dbcsr_operations.F:1158`).  Gathers
+    just the diagonal entries — no copy of the off-diagonal data."""
     _require_valid(matrix)
-    out = matrix.copy(name or f"diag of {matrix.name}")
-    rows, cols = out.entry_coords()
-    return compress(out, rows == cols)
+    out = BlockSparseMatrix(
+        name or f"diag of {matrix.name}",
+        matrix.row_blk_sizes,
+        matrix.col_blk_sizes,
+        matrix.dtype,
+        matrix.dist,
+        matrix.matrix_type,
+    )
+    rows, cols = matrix.entry_coords()
+    sel = np.nonzero(rows == cols)[0]
+    bins = []
+    seen = set()
+    for e_bin in matrix.ent_bin[sel]:
+        if int(e_bin) in seen:
+            continue
+        seen.add(int(e_bin))
+        src = matrix.bins[e_bin]
+        ss = sel[matrix.ent_bin[sel] == e_bin]
+        slots = np.sort(matrix.ent_slot[ss])
+        data = _gather_pad(src.data, jnp.asarray(slots), bucket_size(len(ss)))
+        bins.append(_Bin(src.shape, data, len(ss)))
+    out.set_structure_from_device(matrix.keys[sel], bins)
+    return out
 
 
 def copy_into_existing(

@@ -81,7 +81,7 @@ def run_tests(
     data_type: int = 3,
     test_type: int = TEST_MM,
     n_loops: int = 1,
-    eps: float = 1e-8,
+    eps: Optional[float] = None,
     retain_sparsity: bool = False,
     always_checksum: bool = False,
     seed: int = 2131,
@@ -93,8 +93,15 @@ def run_tests(
 
     ``bs_*`` are (mult, size, mult, size, ...) multisets like the
     reference's; None selects the reference default (1,13,2,5).
+    ``eps=None`` picks a dtype-appropriate tolerance (a correct f32
+    product is nowhere near 1e-8).
     """
     rng = np.random.default_rng(seed)
+    if eps is None:
+        resolution = np.finfo(
+            np.zeros(1, dtype_of(data_type)).real.dtype
+        ).resolution
+        eps = 100.0 * np.sqrt(matrix_sizes[2]) * resolution
     default_bs = (1, 13, 2, 5)
     m_sizes = make_random_block_sizes(matrix_sizes[0], bs_m or default_bs, rng)
     n_sizes = make_random_block_sizes(matrix_sizes[1], bs_n or default_bs, rng)

@@ -119,16 +119,57 @@ def _prepare_operands(matrix_a, matrix_b, matrix_c):
     return a, b, matrix_c, dtype, bm, bk, bn
 
 
-def _fill_stacks(group_id, st_a, st_b, st_c, nslots, cap_c):
+def _fill_stacks(group_id, st_a, st_b, st_c, nslots, cap_c, r0=0,
+                 pad_a=0, pad_b=0):
     """Sort stack entries by (slot-group, C slot, A slot) and scatter
     into a (nslots, s_cap, 3) array whose padding rows target the
     dropped segment cap_c.  Shared by the ungrouped and grouped Cannon
     assemblies (the host-side analog of `dbcsr_mm_accdrv.F:364-423`
-    stack sort/binning)."""
+    stack sort/binning).
+
+    ``r0 > 0`` emits the R-tiled layout instead (the mesh sibling of
+    `acc/smm.py:_process_stack_xla_group`): each C slot's entries are
+    tiled into runs of r0 and packed as (nslots, G_cap, 2*r0+1) rows
+    ``[a_0..a_{r0-1}, b_0..b_{r0-1}, c]``; in-tile pads reference the
+    guaranteed-zero panel rows ``pad_a``/``pad_b`` (their product is 0
+    and MAY land in a live segment), dead tiles target segment cap_c.
+    """
     order = np.lexsort((st_a, st_c, group_id))
     group_id, st_a, st_b, st_c = (
         group_id[order], st_a[order], st_b[order], st_c[order]
     )
+    if r0:
+        n = len(group_id)
+        width = 2 * r0 + 1
+        if n == 0:
+            out = np.empty((nslots, 1, width), np.int32)
+            out[:, :, :r0] = pad_a
+            out[:, :, r0:2 * r0] = pad_b
+            out[:, :, 2 * r0] = cap_c
+            return out
+        same = (group_id[1:] == group_id[:-1]) & (st_c[1:] == st_c[:-1])
+        seg_id = np.concatenate([[0], np.cumsum(~same)])
+        seg_first = np.concatenate([[0], np.nonzero(~same)[0] + 1])
+        off = np.arange(n) - seg_first[seg_id]
+        new_tile = np.ones(n, bool)
+        new_tile[1:] = ~same | (off[1:] % r0 == 0)
+        tile_id = np.cumsum(new_tile) - 1
+        first_of_tile = np.nonzero(new_tile)[0]
+        tile_g = group_id[first_of_tile]
+        counts = np.bincount(tile_g, minlength=nslots)
+        g_cap = bucket_size(max(int(counts.max()), 1))
+        starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
+        tile_pos = np.arange(len(first_of_tile)) - starts[tile_g]
+        out = np.empty((nslots, g_cap, width), np.int32)
+        out[:, :, :r0] = pad_a
+        out[:, :, r0:2 * r0] = pad_b
+        out[:, :, 2 * r0] = cap_c
+        sl = off % r0
+        pos_e = tile_pos[tile_id]
+        out[group_id, pos_e, sl] = st_a
+        out[group_id, pos_e, r0 + sl] = st_b
+        out[tile_g, tile_pos, 2 * r0] = st_c[first_of_tile]
+        return out
     counts = np.bincount(group_id, minlength=nslots)
     s_cap = bucket_size(max(int(counts.max()), 1) if len(counts) else 1)
     stacks = np.zeros((nslots, s_cap, 3), np.int32)
@@ -142,11 +183,31 @@ def _fill_stacks(group_id, st_a, st_b, st_c, nslots, cap_c):
     return stacks
 
 
-def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype):
+def _stack_r0(dtype) -> int:
+    """R-tiling factor for the mesh stacks: group emulated dtypes
+    (f64/c128 — per-entry dots are MXU-starved under emulation, see
+    `acc/smm.py:_process_stack_xla_group`).  Auto mode applies this on
+    TPU only (f64 is native elsewhere; per-entry dots are fine there);
+    mm_driver='xla_group' forces it on any platform (how the CPU-mesh
+    tests cover the tiled layout)."""
+    from dbcsr_tpu.core.config import get_config
+
+    driver = get_config().mm_driver
+    if driver == "xla_group":
+        return 8
+    if driver != "auto":
+        return 0
+    if np.dtype(dtype) not in (np.float64, np.complex128):
+        return 0
+    return 8 if jax.devices()[0].platform == "tpu" else 0
+
+
+def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=0):
     """The shared Cannon metronome: s ticks of gather → batched matmul →
     sorted segment-sum, ring-shifting A along 'pc' and B along 'pr'
-    (ref the grouped_k_index loop, `dbcsr_mm_cannon.F:1345`)."""
-    bm, bn = a.shape[1], b.shape[2]
+    (ref the grouped_k_index loop, `dbcsr_mm_cannon.F:1345`).
+    ``r0 > 0``: R-tiled stacks (k-merged dots, `_fill_stacks` layout)."""
+    bm, bk, bn = a.shape[1], a.shape[2], b.shape[2]
     from dbcsr_tpu.parallel.cannon import mark_varying
 
     c = jnp.zeros((cap_c, bm, bn), acc_dtype)
@@ -155,15 +216,24 @@ def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype):
     def tick(t, carry):
         a, b, c = carry
         entries = st[t]
-        pa = jnp.take(a, entries[:, 0], axis=0)
-        pb = jnp.take(b, entries[:, 1], axis=0)
+        if r0:
+            ia = entries[:, :r0]
+            ib = entries[:, r0:2 * r0]
+            ic = entries[:, 2 * r0]
+            pa = jnp.take(a, ia.reshape(-1), axis=0).reshape(-1, r0, bm, bk)
+            pa = jnp.swapaxes(pa, 1, 2).reshape(-1, bm, r0 * bk)
+            pb = jnp.take(b, ib.reshape(-1), axis=0).reshape(-1, r0 * bk, bn)
+        else:
+            pa = jnp.take(a, entries[:, 0], axis=0)
+            pb = jnp.take(b, entries[:, 1], axis=0)
+            ic = entries[:, 2]
         prod = jax.lax.dot_general(
             pa, pb, (((2,), (1,)), ((0,), (0,))),
             precision=jax.lax.Precision.HIGHEST,
             preferred_element_type=acc_dtype,
         )
         c = c + jax.ops.segment_sum(
-            prod, entries[:, 2], num_segments=cap_c,
+            prod, ic, num_segments=cap_c,
             indices_are_sorted=True,
         )
         if s > 1:
@@ -250,10 +320,10 @@ def _resolve_maps(a, b, matrix_c, s: int, kl: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("s", "cap_c", "acc_name", "mesh_ref"),
+    jax.jit, static_argnames=("s", "cap_c", "acc_name", "mesh_ref", "r0"),
 )
 def _run_sparse_cannon(a_panels, b_panels, stacks, c_init, alpha, beta_fac,
-                       *, s, cap_c, acc_name, mesh_ref):
+                       *, s, cap_c, acc_name, mesh_ref, r0=0):
     """``beta_fac`` is a per-C-slot (s, s, cap_c) factor: scalar beta
     everywhere normally; with block limits, 1.0 for blocks outside the
     limited window so they keep their old values (windowed-beta
@@ -264,10 +334,10 @@ def _run_sparse_cannon(a_panels, b_panels, stacks, c_init, alpha, beta_fac,
     def body(a_p, b_p, st, c_in, alpha, beta_fac):
         a = a_p.reshape(a_p.shape[3:])  # (cap_a, bm, bk)
         b = b_p.reshape(b_p.shape[3:])
-        st = st.reshape(st.shape[3:])  # (s, s_cap, 3)
+        st = st.reshape(st.shape[3:])  # (s, s_cap, 3) or (s, G_cap, 2*r0+1)
         c_in = c_in.reshape(c_in.shape[2:])  # (cap_c, bm, bn)
         fac = beta_fac.reshape(beta_fac.shape[2:])[:, None, None]  # (cap_c,1,1)
-        c = _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype)
+        c = _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=r0)
         c = jax.lax.psum(c, "kl")
         c = (alpha * c + fac * c_in.astype(acc_dtype)).astype(c_in.dtype)
         return c.reshape((1, 1) + c.shape)
@@ -399,21 +469,24 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     # ---- per-(device, tick) stacks ----
     ent_c = np.searchsorted(c_keys, rows_t * shell_c.nblkcols + cols_t)
     group = (((layer * s + i_dev) * s + j_dev) * s) + tick_t
+    r0 = _stack_r0(dtype)
     stacks = _fill_stacks(
         group, a_slots[a_ent], b_slots[b_ent], c_slots[ent_c],
-        kl * s * s * s, cap_c,
+        kl * s * s * s, cap_c, r0=r0, pad_a=cap_a, pad_b=cap_b,
     )
-    stacks = stacks.reshape(kl, s, s, s, -1, 3)
+    stacks = stacks.reshape(kl, s, s, s, -1, stacks.shape[-1])
 
     # ---- panel data, placed at the skewed start position ----
+    # r0-tiled stacks reference a guaranteed-zero pad row at cap_a/cap_b
+    xtr = 1 if r0 else 0
     a_host = _dense_blocks_host(a, bm, bk)
-    a_panels = np.zeros((kl, s, s, cap_a, bm, bk), dtype)
+    a_panels = np.zeros((kl, s, s, cap_a + xtr, bm, bk), dtype)
     al, ai_, akc = a_panel // (s * s), (a_panel // s) % s, a_panel % s
     aj0 = (akc - ai_) % s  # device col initially holding panel (i, kc)
     a_panels[al, ai_, aj0, a_slots] = a_host
 
     b_host = _dense_blocks_host(b, bk, bn)
-    b_panels = np.zeros((kl, s, s, cap_b, bk, bn), dtype)
+    b_panels = np.zeros((kl, s, s, cap_b + xtr, bk, bn), dtype)
     bl, bkr, bj = b_panel // (s * s), (b_panel // s) % s, b_panel % s
     bi0 = (bkr - bj) % s  # device row initially holding panel (kr, j)
     b_panels[bl, bi0, bj, b_slots] = b_host
@@ -457,7 +530,7 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         dev(c_init, P("pr", "pc")),
         jnp.asarray(alpha, dtype), dev(beta_fac, P("pr", "pc")),
         s=s, cap_c=cap_c, acc_name=acc_name,
-        mesh_ref=_HashableMesh(mesh),
+        mesh_ref=_HashableMesh(mesh), r0=r0,
     )
 
     # ---- collect back into a host-indexed matrix ----
@@ -516,10 +589,10 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("s", "cap_c", "acc_name", "mesh_ref"),
+    jax.jit, static_argnames=("s", "cap_c", "acc_name", "mesh_ref", "r0"),
 )
 def _run_grouped_cannon(a_panels, b_panels, stacks, c_init, alpha, beta,
-                        *, s, cap_c, acc_name, mesh_ref):
+                        *, s, cap_c, acc_name, mesh_ref, r0=0):
     """nsplit independent Cannon multiplies, one per 'kl' group, in a
     single SPMD program.  The short matrix (B) arrives replicated over
     'kl' (spec without the axis) — the `dbcsr_tas_replicate` analog —
@@ -532,12 +605,12 @@ def _run_grouped_cannon(a_panels, b_panels, stacks, c_init, alpha, beta,
     def body(a_p, b_p, st, c_in, alpha, beta):
         a = a_p.reshape(a_p.shape[3:])  # (cap_a, bm, bk)
         b = b_p.reshape(b_p.shape[2:])  # (cap_b, bk, bn), replicated on kl
-        st = st.reshape(st.shape[3:])  # (s, s_cap, 3)
+        st = st.reshape(st.shape[3:])  # (s, s_cap, 3) or (s, G_cap, 2*r0+1)
         c_in = c_in.reshape(c_in.shape[3:])  # (cap_c, bm, bn)
         from dbcsr_tpu.parallel.cannon import mark_varying
 
         b = mark_varying(b, ("kl",))
-        c = _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype)
+        c = _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=r0)
         c = (alpha * c + beta * c_in.astype(acc_dtype)).astype(c_in.dtype)
         return c.reshape((1, 1, 1) + c.shape)
 
@@ -666,21 +739,24 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     # ---- per-(group, device, tick) stacks ----
     ent_c = np.searchsorted(c_keys, rows_t * shell_c.nblkcols + cols_t)
     group_id = (((grp * s + i_dev) * s + j_dev) * s) + tick_t
+    r0 = _stack_r0(dtype)
     stacks = _fill_stacks(
         group_id, a_slots[a_ent], b_slots[b_ent], c_slots[ent_c],
-        g * s * s * s, cap_c,
+        g * s * s * s, cap_c, r0=r0, pad_a=cap_a, pad_b=cap_b,
     )
-    stacks = stacks.reshape(g, s, s, s, -1, 3)
+    stacks = stacks.reshape(g, s, s, s, -1, stacks.shape[-1])
 
     # ---- panel data at skewed start positions ----
+    # r0-tiled stacks reference a guaranteed-zero pad row at cap_a/cap_b
+    xtr = 1 if r0 else 0
     a_host = _dense_blocks_host(a, bm, bk)
-    a_panels = np.zeros((g, s, s, cap_a, bm, bk), dtype)
+    a_panels = np.zeros((g, s, s, cap_a + xtr, bm, bk), dtype)
     agr, ai_, akc = a_panel // (s * s), (a_panel // s) % s, a_panel % s
     aj0 = (akc - ai_) % s
     a_panels[agr, ai_, aj0, a_slots] = a_host
 
     b_host = _dense_blocks_host(b, bk, bn)
-    b_panels = np.zeros((s, s, cap_b, bk, bn), dtype)
+    b_panels = np.zeros((s, s, cap_b + xtr, bk, bn), dtype)
     bkr, bj = b_panel // s, b_panel % s
     bi0 = (bkr - bj) % s
     b_panels[bi0, bj, b_slots] = b_host
@@ -703,7 +779,7 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         dev(c_init, P("kl", "pr", "pc")),
         jnp.asarray(alpha, dtype), jnp.asarray(beta, dtype),
         s=s, cap_c=cap_c, acc_name=acc_name,
-        mesh_ref=_HashableMesh(mesh),
+        mesh_ref=_HashableMesh(mesh), r0=r0,
     )
 
     # ---- collect (groups disjoint: no reduction) ----

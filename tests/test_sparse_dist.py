@@ -289,3 +289,49 @@ def test_tas_grouped_column_long(mesh8):
     np.testing.assert_allclose(
         to_dense(c_mesh), to_dense(c_host), rtol=1e-12, atol=1e-12
     )
+
+
+def test_sparse_cannon_r_tiled_stacks(mesh8):
+    """mm_driver='xla_group' forces the R-tiled mesh stack layout (the
+    TPU-emulation path) on any platform; results and determinism must
+    match the per-entry layout."""
+    from dbcsr_tpu import set_config
+
+    rbs = [3, 5, 4] * 4
+    a = _rand("A", rbs, rbs, 0.4, 41)
+    b = _rand("B", rbs, rbs, 0.4, 42)
+    c0 = _rand("C", rbs, rbs, 0.3, 43)
+    set_config(mm_driver="xla_group")
+    try:
+        c_tiled = sparse_multiply_distributed(1.5, a, b, 0.5, c0.copy(), mesh8)
+        cs = checksum(c_tiled)
+        c_rep = sparse_multiply_distributed(1.5, a, b, 0.5, c0.copy(), mesh8)
+        assert checksum(c_rep) == cs  # bit-identical repeats
+    finally:
+        set_config(mm_driver="auto")
+    c_plain = sparse_multiply_distributed(1.5, a, b, 0.5, c0.copy(), mesh8)
+    want = 1.5 * (to_dense(a) @ to_dense(b)) + 0.5 * to_dense(c0)
+    np.testing.assert_allclose(to_dense(c_tiled), want, rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(to_dense(c_plain), want, rtol=1e-12, atol=1e-12)
+
+
+def test_sparse_cannon_r_tiled_filtering(mesh8):
+    """R-tiled layout + on-the-fly filtering/retain_sparsity agree with
+    the single-chip engine."""
+    from dbcsr_tpu import create, multiply, set_config
+
+    rbs = [4] * 10
+    a = _rand("A", rbs, rbs, 0.5, 44)
+    b = _rand("B", rbs, rbs, 0.5, 45)
+    set_config(mm_driver="xla_group")
+    try:
+        c_mesh = sparse_multiply_distributed(
+            1.0, a, b, 0.0, None, mesh8, filter_eps=0.5
+        )
+    finally:
+        set_config(mm_driver="auto")
+    c_ref = create("c", rbs, rbs)
+    multiply("N", "N", 1.0, a, b, 0.0, c_ref, filter_eps=0.5)
+    assert np.array_equal(c_mesh.keys, c_ref.keys)
+    np.testing.assert_allclose(to_dense(c_mesh), to_dense(c_ref),
+                               rtol=1e-12, atol=1e-12)
