@@ -303,25 +303,47 @@ def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits) -> bool:
     return dense_flops < cfg.dense_flop_ratio * _true_product_flops(a, b)
 
 
+_fill_cache: "OrderedDict" = None  # created lazily; pattern-keyed
+
+
 def _candidate_fill(a, b) -> float:
     """Fraction of C blocks the symbolic product would store.  EXACT
     (one host float32 boolean matmul over the block grids) when the
-    grid volume allows — structured patterns (triangular, banded) are
-    what the guard exists for, and a random-pattern estimate misses
-    them; beyond ~1e9 grid volume, fall back to the Poisson model."""
+    grid volume and temp size allow — structured patterns (triangular,
+    banded) are what the guard exists for, and a random-pattern
+    estimate misses them; beyond the caps, fall back to the Poisson
+    model.  Memoized by pattern fingerprints: repeated same-pattern
+    multiplies (SCF loops) pay the matmul once."""
+    import collections
+
+    global _fill_cache
     nbr, nbk, nbc = a.nblkrows, a.nblkcols, b.nblkcols
     if a.nblks == 0 or b.nblks == 0 or nbr * nbc == 0:
         return 0.0
-    if float(nbr) * nbk * nbc <= 1e9:
-        ar, ac = a.entry_coords()
-        br, bc = b.entry_coords()
-        ia = np.zeros((nbr, nbk), np.float32)
-        ia[ar, ac] = 1.0
-        ib = np.zeros((nbk, nbc), np.float32)
-        ib[br, bc] = 1.0
-        return float(np.count_nonzero(ia @ ib)) / (nbr * nbc)
-    lam = float(a.nblks) * b.nblks / (float(nbr) * nbc * nbk)
-    return 1.0 - float(np.exp(-lam))
+    exact_ok = (
+        float(nbr) * nbk * nbc <= 1e9
+        and float(nbr) * nbk + float(nbk) * nbc + float(nbr) * nbc <= 5e7
+    )
+    if not exact_ok:
+        lam = float(a.nblks) * b.nblks / (float(nbr) * nbc * nbk)
+        return 1.0 - float(np.exp(-lam))
+    key = (a.pattern_fingerprint(), b.pattern_fingerprint())
+    if _fill_cache is None:
+        _fill_cache = collections.OrderedDict()
+    if key in _fill_cache:
+        _fill_cache.move_to_end(key)
+        return _fill_cache[key]
+    ar, ac = a.entry_coords()
+    br, bc = b.entry_coords()
+    ia = np.zeros((nbr, nbk), np.float32)
+    ia[ar, ac] = 1.0
+    ib = np.zeros((nbk, nbc), np.float32)
+    ib[br, bc] = 1.0
+    fill = float(np.count_nonzero(ia @ ib)) / (nbr * nbc)
+    _fill_cache[key] = fill
+    while len(_fill_cache) > 64:
+        _fill_cache.popitem(last=False)
+    return fill
 
 
 @functools.partial(jax.jit, static_argnames=("nbr", "nbc", "bm", "bn"))

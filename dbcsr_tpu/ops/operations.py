@@ -51,22 +51,29 @@ def _gather_pad(data, slots, capacity):
     return out
 
 
-def compress(matrix: BlockSparseMatrix, keep: np.ndarray) -> BlockSparseMatrix:
-    """Drop entries where ``keep`` is False; rebuild bins by device gather."""
-    _require_valid(matrix)
-    if keep.all():
-        return matrix
+def _subset_bins(matrix: BlockSparseMatrix, keep: np.ndarray):
+    """(keys, freshly gathered bins) for the ``keep``-masked entries —
+    the slot-ordering contract (sorted slots preserve key order within
+    a bin) lives HERE, shared by compress and get_block_diag."""
     new_keys = matrix.keys[keep]
-    old_bins = matrix.bins
     ent_bin = matrix.ent_bin[keep]
     ent_slot = matrix.ent_slot[keep]
     bins = []
-    for b_id, b in enumerate(old_bins):
+    for b_id, b in enumerate(matrix.bins):
         mask = ent_bin == b_id
         count = int(mask.sum())
         slots = np.sort(ent_slot[mask])  # preserve key order within bin
         data = _gather_pad(b.data, jnp.asarray(slots), bucket_size(count))
         bins.append(_Bin(b.shape, data, count))
+    return new_keys, bins
+
+
+def compress(matrix: BlockSparseMatrix, keep: np.ndarray) -> BlockSparseMatrix:
+    """Drop entries where ``keep`` is False; rebuild bins by device gather."""
+    _require_valid(matrix)
+    if keep.all():
+        return matrix
+    new_keys, bins = _subset_bins(matrix, keep)
     matrix.set_structure_from_device(new_keys, bins)
     return matrix
 
@@ -315,19 +322,8 @@ def get_block_diag(
         matrix.matrix_type,
     )
     rows, cols = matrix.entry_coords()
-    sel = np.nonzero(rows == cols)[0]
-    bins = []
-    seen = set()
-    for e_bin in matrix.ent_bin[sel]:
-        if int(e_bin) in seen:
-            continue
-        seen.add(int(e_bin))
-        src = matrix.bins[e_bin]
-        ss = sel[matrix.ent_bin[sel] == e_bin]
-        slots = np.sort(matrix.ent_slot[ss])
-        data = _gather_pad(src.data, jnp.asarray(slots), bucket_size(len(ss)))
-        bins.append(_Bin(src.shape, data, len(ss)))
-    out.set_structure_from_device(matrix.keys[sel], bins)
+    keys, bins = _subset_bins(matrix, rows == cols)
+    out.set_structure_from_device(keys, bins)
     return out
 
 

@@ -286,3 +286,72 @@ def test_tas_batched_split_reoptimizes_on_sparsity_change():
         # second call: cached split now optimal, no further re-split
         assert state.get("resplit_count", 0) == 1
     np.testing.assert_allclose(to_dense(c), want, rtol=1e-10, atol=1e-12)
+
+
+# ------------------------------------------------ dbcsr_t_* API parity
+def test_tensor_api_parity_surface():
+    import io as _io
+
+    from dbcsr_tpu.tensor.types import create_tensor
+
+    rng = np.random.default_rng(17)
+    t = create_tensor("t", [[2, 3], [3, 2], [2, 2]])
+    t.reserve_blocks([[0, 0, 0], [1, 1, 1]])
+    assert t.nblks == 2
+    t.put_block([0, 1, 0], rng.standard_normal((2, 2, 2)))
+    t.finalize()
+    t.set_value(2.0)
+    assert np.allclose(t.get_block([0, 1, 0]), 2.0)
+    t.scale(0.5)
+    assert np.allclose(t.get_block([0, 1, 0]), 1.0)
+    info = t.get_info()
+    assert info["ndim"] == 3 and info["nblks"] == 3
+    assert t.get_nze() == 12 + 12 + 8  # (2,3,2) + (3,2,2) + (2,2,2)
+    mi = t.get_mapping_info()
+    assert mi["dims_2d"] == (t.matrix.nblkrows, t.matrix.nblkcols)
+    assert isinstance(t.checksum(), float)
+    assert t.get_stored_coordinates([0, 0, 0]) == (0, 0)
+    assert t.blk_sizes_of([1, 0, 1]) == (3, 3, 2)
+    buf = _io.StringIO()
+    t.write_blocks(buf)
+    assert "block (0, 0, 0)" in buf.getvalue()
+    buf2 = _io.StringIO()
+    t.write_split_info(buf2)
+    assert "2d grid" in buf2.getvalue()
+    t.filter(1e30)
+    assert t.nblks == 0
+    t.clear()
+    assert t.nblks == 0 and t.matrix.valid
+
+
+def test_tensor_split_blocks():
+    from dbcsr_tpu.tensor.types import create_tensor, split_blocks
+
+    rng = np.random.default_rng(18)
+    t = create_tensor("t", [[4, 2], [3, 3]])
+    t.put_block([0, 0], rng.standard_normal((4, 3)))
+    t.put_block([1, 1], rng.standard_normal((2, 3)))
+    t.finalize()
+    s = split_blocks(t, [[2, 2, 2], [3, 1, 2]])
+    np.testing.assert_allclose(s.to_dense(), t.to_dense())
+    assert s.nblks > t.nblks
+    with pytest.raises(ValueError):
+        split_blocks(t, [[3, 3], [3, 3]])  # breaks an old boundary
+
+
+def test_tensor_matrix_copies():
+    from dbcsr_tpu import create, make_random_matrix, to_dense
+    from dbcsr_tpu.tensor.types import (
+        copy_matrix_to_tensor,
+        copy_tensor_to_matrix,
+        create_tensor,
+    )
+
+    rng = np.random.default_rng(19)
+    m = make_random_matrix("m", [2, 3], [3, 2], occupation=0.8, rng=rng)
+    t = create_tensor("t", [[2, 3], [3, 2]], row_dims=(0,), col_dims=(1,))
+    copy_matrix_to_tensor(m, t)
+    np.testing.assert_allclose(t.to_dense(), to_dense(m))
+    m2 = create("m2", [2, 3], [3, 2])
+    copy_tensor_to_matrix(t, m2)
+    np.testing.assert_allclose(to_dense(m2), to_dense(m))
