@@ -36,7 +36,7 @@ from dbcsr_tpu.core.dist import (
     convert_sizes_to_offsets,
     dist_bin,
 )
-from dbcsr_tpu.core.matrix import BlockSparseMatrix, create
+from dbcsr_tpu.core.matrix import BlockIterator, BlockSparseMatrix, create
 from dbcsr_tpu.mm.multiply import multiply
 from dbcsr_tpu.ops.operations import (
     FUNC_ARTANH,

@@ -512,6 +512,13 @@ class BlockSparseMatrix:
                 self.ent_slot[e]
             ]
 
+    def iterator(self) -> "BlockIterator":
+        """Reference-style explicit iterator (ref `dbcsr_iterator_start`
+        / `_blocks_left` / `_next_block` / `_stop`,
+        `src/block/dbcsr_iterator_operations.F:44-91`); `iterate_blocks`
+        is the Pythonic equivalent."""
+        return BlockIterator(self)
+
     def block_norms(self) -> np.ndarray:
         """Frobenius norm per finalized entry, key-ordered (device compute)."""
         from dbcsr_tpu.acc.smm import block_norms as _bn
@@ -591,6 +598,45 @@ class BlockSparseMatrix:
             f" {self.nblks} stored, dtype={np.dtype(self.dtype).name},"
             f" type={self.matrix_type})"
         )
+
+
+class BlockIterator:
+    """Explicit start/next/stop block iterator mirroring the reference
+    API shape (`dbcsr_iterator_operations.F`): ``blocks_left()`` /
+    ``next_block() -> (row, col, block)`` / ``stop()``.  Fetches each
+    device bin once at start, like `iterate_blocks`."""
+
+    def __init__(self, matrix: "BlockSparseMatrix"):
+        if not matrix.valid:
+            raise RuntimeError("finalize() before iterating")
+        self._it = matrix.iterate_blocks()
+        self._next = None
+        self._live = True
+        self._advance()
+
+    def _advance(self):
+        try:
+            self._next = next(self._it)
+        except StopIteration:
+            self._next = None
+
+    def blocks_left(self) -> bool:
+        return self._live and self._next is not None
+
+    def next_block(self):
+        # IndexError, not StopIteration: a StopIteration escaping from a
+        # plain method into a caller's generator frame becomes
+        # RuntimeError under PEP 479
+        if not self.blocks_left():
+            raise IndexError("no blocks left")
+        out = self._next
+        self._advance()
+        return out
+
+    def stop(self) -> None:
+        self._live = False
+        self._it = iter(())
+        self._next = None
 
 
 def _bin_entries(row_blk_sizes, col_blk_sizes, rows, cols):

@@ -62,6 +62,10 @@ def _subset_bins(matrix: BlockSparseMatrix, keep: np.ndarray):
     for b_id, b in enumerate(matrix.bins):
         mask = ent_bin == b_id
         count = int(mask.sum())
+        if count == 0:
+            # shapes absent from the subset are never referenced by
+            # set_structure_from_device; skip the dispatch entirely
+            continue
         slots = np.sort(ent_slot[mask])  # preserve key order within bin
         data = _gather_pad(b.data, jnp.asarray(slots), bucket_size(count))
         bins.append(_Bin(b.shape, data, count))

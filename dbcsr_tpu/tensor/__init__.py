@@ -8,8 +8,20 @@ layouts, runs the TAS multiply, and maps back
 (`dbcsr_tensor.F:418,1162-1183`).
 """
 
-from dbcsr_tpu.tensor.types import BlockSparseTensor, create_tensor
-from dbcsr_tpu.tensor.contract import contract, tensor_copy, remap, restrict_tensor
+from dbcsr_tpu.tensor.types import (
+    BlockSparseTensor,
+    copy_matrix_to_tensor,
+    copy_tensor_to_matrix,
+    create_tensor,
+    split_blocks,
+)
+from dbcsr_tpu.tensor.contract import (
+    contract,
+    contract_test,
+    tensor_copy,
+    remap,
+    restrict_tensor,
+)
 from dbcsr_tpu.tensor.batched import (
     batched_contract_init,
     batched_contract_finalize,

@@ -283,3 +283,94 @@ def contract(
             scale(tensor_c.matrix, beta)
         tensor_copy(tensor_c, tmp, summation=True)
         return flops
+
+
+def contract_test(
+    alpha,
+    tensor_a: BlockSparseTensor,
+    tensor_b: BlockSparseTensor,
+    beta,
+    tensor_c: BlockSparseTensor,
+    contract_a: Sequence[int],
+    notcontract_a: Sequence[int],
+    contract_b: Sequence[int],
+    notcontract_b: Sequence[int],
+    map_1: Optional[Sequence[int]] = None,
+    map_2: Optional[Sequence[int]] = None,
+    eps: Optional[float] = None,
+    io=print,
+    **contract_kwargs,
+) -> bool:
+    """Run the contraction AND verify it against a dense einsum oracle
+    (ref `dbcsr_t_contract_test`, `dbcsr_tensor_api.F:55`): returns
+    True when the result matches within ``eps`` (dtype-scaled default),
+    False otherwise, reporting the error through ``io``.  ``tensor_c``
+    is updated with the contraction result either way."""
+    ca, nca = tuple(contract_a), tuple(notcontract_a)
+    cb, ncb = tuple(contract_b), tuple(notcontract_b)
+    if map_1 is None:
+        map_1 = tuple(range(len(nca)))
+    if map_2 is None:
+        map_2 = tuple(range(len(nca), len(nca) + len(ncb)))
+    if contract_kwargs.get("filter_eps") is not None:
+        raise ValueError(
+            "contract_test's dense oracle cannot model filter_eps; "
+            "call contract() directly for filtered contractions"
+        )
+    dense_a = tensor_a.to_dense().copy()
+    dense_b = tensor_b.to_dense().copy()
+    dense_c0 = tensor_c.to_dense()
+
+    # bounds semantics (same as contract): operands are zeroed outside
+    # the block-index windows, so the oracle masks its dense inputs
+    def _mask(dense, tensor, dim, lo_hi):
+        off = np.concatenate([[0], np.cumsum(tensor.blk_sizes[dim])])
+        lo, hi = lo_hi
+        sl = [slice(None)] * dense.ndim
+        sl[dim] = slice(0, int(off[lo]))
+        dense[tuple(sl)] = 0
+        sl[dim] = slice(int(off[hi + 1]), None)
+        dense[tuple(sl)] = 0
+
+    for i, b in enumerate(contract_kwargs.get("bounds_1") or []):
+        if b is not None:
+            _mask(dense_a, tensor_a, ca[i], b)
+            _mask(dense_b, tensor_b, cb[i], b)
+    for i, b in enumerate(contract_kwargs.get("bounds_2") or []):
+        if b is not None:
+            _mask(dense_a, tensor_a, nca[i], b)
+    for i, b in enumerate(contract_kwargs.get("bounds_3") or []):
+        if b is not None:
+            _mask(dense_b, tensor_b, ncb[i], b)
+    # einsum subscripts: one letter per A dim; contracted B dims share
+    # A's letters, free B dims get fresh ones; C positions by map_1/2
+    letters = iter("abcdefghijklmnopqrstuvwxyz")
+    sub_a = [next(letters) for _ in range(tensor_a.ndim)]
+    sub_b = [None] * tensor_b.ndim
+    for da, db in zip(ca, cb):
+        sub_b[db] = sub_a[da]
+    for db in ncb:
+        sub_b[db] = next(letters)
+    sub_c = [None] * tensor_c.ndim
+    for da, dc in zip(nca, map_1):
+        sub_c[dc] = sub_a[da]
+    for db, dc in zip(ncb, map_2):
+        sub_c[dc] = sub_b[db]
+    spec = f"{''.join(sub_a)},{''.join(sub_b)}->{''.join(sub_c)}"
+    want = alpha * np.einsum(spec, dense_a, dense_b) + beta * dense_c0
+
+    contract(alpha, tensor_a, tensor_b, beta, tensor_c,
+             ca, nca, cb, ncb, map_1=map_1, map_2=map_2, **contract_kwargs)
+    got = tensor_c.to_dense()
+    if eps is None:
+        resolution = np.finfo(np.zeros(1, tensor_c.dtype).real.dtype).resolution
+        k_extent = int(np.prod(
+            [int(tensor_a.blk_sizes[d].sum()) for d in ca], dtype=np.int64
+        ))
+        eps = 100.0 * np.sqrt(max(k_extent, 1)) * resolution
+    scale_ref = max(float(np.abs(want).max()), 1.0)
+    err = float(np.abs(got - want).max()) / scale_ref
+    ok = bool(np.isfinite(err) and err <= eps)
+    io(f" contract_test {spec}: max rel err {err:.3e} "
+       f"{'<=' if ok else '>'} eps {eps:.1e} -> {'OK' if ok else 'FAILED'}")
+    return ok

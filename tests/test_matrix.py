@@ -275,3 +275,22 @@ def test_unfinalized_panel_assembly_rejected():
 
     with _pytest.raises(RuntimeError, match="finalize"):
         _dense_blocks_host(m, 2, 2)
+
+
+def test_reference_style_iterator():
+    """Explicit start/blocks_left/next_block/stop API
+    (ref dbcsr_iterator_operations.F)."""
+    rng = np.random.default_rng(8)
+    m = make_random_matrix("m", [2, 3], [3, 2], occupation=1.0, rng=rng)
+    it = m.iterator()
+    seen = []
+    while it.blocks_left():
+        r, c, blk = it.next_block()
+        seen.append((r, c))
+        np.testing.assert_allclose(blk, m.get_block(r, c))
+    assert seen == [(int(r), int(c)) for r, c in zip(*m.entry_coords())]
+    it.stop()
+    assert not it.blocks_left()
+    import pytest as _pytest
+    with _pytest.raises(IndexError):
+        it.next_block()

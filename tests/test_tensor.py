@@ -355,3 +355,40 @@ def test_tensor_matrix_copies():
     m2 = create("m2", [2, 3], [3, 2])
     copy_tensor_to_matrix(t, m2)
     np.testing.assert_allclose(to_dense(m2), to_dense(m))
+
+
+def test_contract_test_harness():
+    """dbcsr_t_contract_test analog: contraction vs dense einsum oracle."""
+    from dbcsr_tpu.tensor.contract import contract_test
+    from dbcsr_tpu.tensor.types import create_tensor
+
+    rng = np.random.default_rng(21)
+    a = create_tensor("a", [[2, 3], [3], [2, 2]])
+    b = create_tensor("b", [[3], [2, 2], [4]])
+    c = create_tensor("c", [[2, 3], [2, 2], [2, 2], [4]])
+    for t in (a, b):
+        for idx in np.ndindex(*t.nblks_per_dim):
+            if rng.random() < 0.7:
+                t.put_block(list(idx), rng.standard_normal(t.block_shape(idx)))
+        t.finalize()
+    c.finalize()
+    msgs = []
+    assert contract_test(2.0, a, b, 0.0, c, [1], [0, 2], [0], [1, 2],
+                         io=msgs.append)
+    assert msgs and "OK" in msgs[0]
+
+
+def test_contract_test_with_bounds_and_filter_reject():
+    from dbcsr_tpu.tensor.contract import contract_test
+    from dbcsr_tpu.tensor.types import create_tensor
+
+    si, sk, sj = [2, 3, 2], [4, 2, 3], [3, 2]
+    a = _rand_tensor("a", [si, sk], occ=0.9, seed=23)
+    b = _rand_tensor("b", [sk, sj], occ=0.9, seed=24)
+    c = create_tensor("c", [si, sj])
+    c.finalize()
+    assert contract_test(1.0, a, b, 0.0, c, [1], [0], [0], [1],
+                         bounds_1=[(1, 2)], io=lambda *_: None)
+    with pytest.raises(ValueError, match="filter_eps"):
+        contract_test(1.0, a, b, 0.0, c, [1], [0], [0], [1],
+                      filter_eps=1e-10, io=lambda *_: None)
