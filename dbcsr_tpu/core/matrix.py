@@ -471,6 +471,7 @@ class BlockSparseMatrix:
         self._shape_to_bin = {b.shape: i for i, b in enumerate(self.bins)}
         self._work.clear()
         self._work_batches.clear()
+        self._dense_canvas_cache = None  # structure changed
         self.valid = True
 
     # --------------------------------------------------------------- access
@@ -588,6 +589,7 @@ class BlockSparseMatrix:
                 if data.shape[0] > b.count:
                     data = _rezero_pad_rows(data, b.count)
                 b.data = data
+        self._dense_canvas_cache = None  # values changed
 
     def zero_data(self) -> None:
         self.map_bin_data(lambda d: jnp.zeros_like(d))
@@ -611,7 +613,6 @@ class BlockIterator:
             raise RuntimeError("finalize() before iterating")
         self._it = matrix.iterate_blocks()
         self._next = None
-        self._live = True
         self._advance()
 
     def _advance(self):
@@ -621,20 +622,19 @@ class BlockIterator:
             self._next = None
 
     def blocks_left(self) -> bool:
-        return self._live and self._next is not None
+        return self._next is not None
 
     def next_block(self):
         # IndexError, not StopIteration: a StopIteration escaping from a
         # plain method into a caller's generator frame becomes
         # RuntimeError under PEP 479
-        if not self.blocks_left():
+        if self._next is None:
             raise IndexError("no blocks left")
         out = self._next
         self._advance()
         return out
 
     def stop(self) -> None:
-        self._live = False
         self._it = iter(())
         self._next = None
 

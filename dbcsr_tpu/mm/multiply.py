@@ -398,6 +398,23 @@ def _gather_bin_from_canvas(canvas, row_off, col_off, bm: int, bn: int):
     return canvas[r_idx, c_idx]
 
 
+def _dense_canvas_cached(m: BlockSparseMatrix, build) -> object:
+    """Device canvas of ``m``, cached on the instance keyed by its bin
+    data-array identities (jax arrays are immutable, and the cache holds
+    the arrays so ids cannot be recycled): repeated dense-mode
+    multiplies with unchanged operands skip the scatter entirely.
+    ``build`` constructs the canvas on a miss."""
+    key = tuple(id(b.data) for b in m.bins)
+    cache = getattr(m, "_dense_canvas_cache", None)
+    if cache is not None and cache[0] == key:
+        return cache[1]
+    # the mutation funnels (map_bin_data / set_structure_from_device)
+    # drop the attribute, so a live cache is always for current data
+    canvas = build()
+    m._dense_canvas_cache = (key, canvas, [b.data for b in m.bins])
+    return canvas
+
+
 def _to_dense_device(m: BlockSparseMatrix):
     """Densify a (possibly non-uniformly blocked) matrix on device."""
     canvas = jnp.zeros((m.nfullrows, m.nfullcols), m.dtype)
@@ -429,8 +446,8 @@ def _dense_multiply_general(a, b, c, alpha, beta) -> int:
     device, one MXU matmul, carve C back into its own full blocking
     (the `dbcsr_make_dense`/`dbcsr_make_undense` re-blocking pair,
     `dbcsr_mm.F:593-617`, generalized to one flat dense canvas)."""
-    ad = _to_dense_device(a)
-    bd = _to_dense_device(b)
+    ad = _dense_canvas_cached(a, lambda: _to_dense_device(a))
+    bd = _dense_canvas_cached(b, lambda: _to_dense_device(b))
     acc = ad.dtype
     cd = jax.lax.dot_general(
         ad, bd, (((1,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST,
@@ -487,14 +504,14 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
     nbr, nbc, nbk = a.nblkrows, c.nblkcols, a.nblkcols
     ar, ac = a.entry_coords()
     br_, bc_ = b.entry_coords()
-    ad = _blocks_to_dense(
+    ad = _dense_canvas_cached(a, lambda: _blocks_to_dense(
         a.bins[0].data[: a.nblks] if a.nblks else jnp.zeros((0, bm, bk), c.dtype),
         jnp.asarray(ar), jnp.asarray(ac), nbr, nbk, bm, bk,
-    )
-    bd = _blocks_to_dense(
+    ))
+    bd = _dense_canvas_cached(b, lambda: _blocks_to_dense(
         b.bins[0].data[: b.nblks] if b.nblks else jnp.zeros((0, bk, bn), c.dtype),
         jnp.asarray(br_), jnp.asarray(bc_), nbk, nbc, bk, bn,
-    )
+    ))
     c_blocks = (
         c.bins[0].data[: c.nblks]
         if c.nblks

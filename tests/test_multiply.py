@@ -6,7 +6,7 @@ named cases of `dbcsr_unittest1.F:79-293`."""
 import numpy as np
 import pytest
 
-from dbcsr_tpu import create, make_random_matrix, multiply, to_dense
+from dbcsr_tpu import create, make_random_matrix, multiply, new_transposed, to_dense
 from dbcsr_tpu.core.matrix import SYMMETRIC
 from dbcsr_tpu.ops.test_methods import checksum, impose_sparsity
 
@@ -333,3 +333,62 @@ def test_dense_mode_not_used_with_filter():
     c = create("c", rbs, rbs)
     multiply("N", "N", 1.0, a, b, 0.0, c, filter_eps=1e30)
     assert c.nblks == 0  # all filtered -> sparse machinery ran
+
+
+def test_multiply_large_blocks_stress():
+    """ref dbcsr_unittest2.F:80-102: large and rectangular block sizes
+    (up to 100s) must flow through the engine like small ones — these
+    exceed the fused-kernel regime and exercise the big-block path
+    (ref cuBLAS fallback for blocks > max_kernel_dim=80)."""
+    rbs = [76, 113]
+    kbs = [52, 97]
+    cbs = [120, 33]
+    a = _rand("a", rbs, kbs, 0.9, seed=70)
+    b = _rand("b", kbs, cbs, 0.9, seed=71)
+    c = create("c", rbs, cbs)
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    np.testing.assert_allclose(to_dense(c), to_dense(a) @ to_dense(b),
+                               rtol=1e-11, atol=1e-11)
+
+
+def test_multiply_mixed_tiny_and_large_blocks():
+    """1-element blocks alongside 100+ blocks in one multiply."""
+    rbs = [1, 88, 3]
+    kbs = [105, 1, 7]
+    cbs = [2, 94]
+    a = _rand("a", rbs, kbs, 1.0, seed=72)
+    b = _rand("b", kbs, cbs, 1.0, seed=73)
+    c = create("c", rbs, cbs)
+    multiply("N", "T", 1.0, a, new_transposed(b), 0.0, c)
+    np.testing.assert_allclose(to_dense(c), to_dense(a) @ to_dense(b),
+                               rtol=1e-11, atol=1e-11)
+
+
+def test_dense_canvas_cache_hits_and_invalidates():
+    """Repeated dense-mode multiplies reuse the densified operands;
+    mutating an operand invalidates its canvas (keyed by bin data-array
+    identity)."""
+    from dbcsr_tpu.core.config import set_config
+    from dbcsr_tpu.mm.multiply import _dense_canvas_cached
+    from dbcsr_tpu.ops.operations import scale
+
+    rbs = [4] * 5
+    a = _rand("a", rbs, rbs, 1.0, seed=80)
+    b = _rand("b", rbs, rbs, 1.0, seed=81)
+    set_config(mm_dense=True)
+    try:
+        c1 = create("c", rbs, rbs)
+        multiply("N", "N", 1.0, a, b, 0.0, c1)
+        canvas1 = a._dense_canvas_cache[1]
+        c2 = create("c", rbs, rbs)
+        multiply("N", "N", 1.0, a, b, 0.0, c2)
+        assert a._dense_canvas_cache[1] is canvas1  # hit
+        assert checksum(c1) == checksum(c2)
+        scale(a, 2.0)
+        c3 = create("c", rbs, rbs)
+        multiply("N", "N", 1.0, a, b, 0.0, c3)
+        assert a._dense_canvas_cache[1] is not canvas1  # invalidated
+        np.testing.assert_allclose(to_dense(c3), 2.0 * to_dense(c1),
+                                   rtol=1e-12, atol=1e-12)
+    finally:
+        set_config(mm_dense=None)
