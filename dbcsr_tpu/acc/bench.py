@@ -22,6 +22,7 @@ import time
 import numpy as np
 
 from dbcsr_tpu.core.kinds import dtype_of
+from dbcsr_tpu.utils.sync import fetch_fence
 
 
 def _rand_stack(rng, nblocks_a, nblocks_b, nblocks_c, stack_size):
@@ -72,9 +73,7 @@ def bench_smm(nrep=5, stack_size=30000, m=23, n=23, k=23, dtype_enum=3,
         c = jnp.zeros((nc, m, n), dtype)
         t0 = time.perf_counter()
         c = process_stack(c, a, b, ai, bi, ci, 1.0)
-        # data-dependent 8-byte fetch: block_until_ready alone can
-        # return before the work ran on remote tunnels (PERF_NOTES.md)
-        float(np.asarray(c[0, 0, 0]).real)
+        fetch_fence(c)  # forced completion (PERF_NOTES.md)
         times.append(time.perf_counter() - t0)
     best = min(times)
     flops = 2.0 * m * n * k * stack_size
@@ -120,8 +119,7 @@ def bench_trans(nrep=5, stack_size=30000, m=23, n=23, dtype_enum=3,
     times = []
     for _ in range(nrep):
         t0 = time.perf_counter()
-        tr = transpose_blocks(data)
-        float(np.asarray(tr[0, 0, 0]).real)  # forced completion
+        fetch_fence(transpose_blocks(data))  # forced completion
         times.append(time.perf_counter() - t0)
     best = min(times)
     bytes_moved = 2 * host.nbytes

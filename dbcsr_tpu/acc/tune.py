@@ -29,14 +29,13 @@ def _time_config(fn, nrep: int) -> float:
     remote-tunnel backends (the axon illusion, PERF_NOTES.md), which
     produced the bogus round-2 table this replaces."""
 
-    def _fence(x):
-        return float(np.asarray(x.ravel()[0]).real)
+    from dbcsr_tpu.utils.sync import fetch_fence
 
-    _fence(fn())  # compile/warm
+    fetch_fence(fn())  # compile/warm
     best = float("inf")
     for _ in range(nrep):
         t0 = time.perf_counter()
-        _fence(fn())
+        fetch_fence(fn())
         best = min(best, time.perf_counter() - t0)
     return best
 

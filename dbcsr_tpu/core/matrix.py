@@ -471,7 +471,7 @@ class BlockSparseMatrix:
         self._shape_to_bin = {b.shape: i for i, b in enumerate(self.bins)}
         self._work.clear()
         self._work_batches.clear()
-        self._dense_canvas_cache = None  # structure changed
+        self.invalidate_dense_cache()  # structure changed
         self.valid = True
 
     # --------------------------------------------------------------- access
@@ -589,7 +589,14 @@ class BlockSparseMatrix:
                 if data.shape[0] > b.count:
                     data = _rezero_pad_rows(data, b.count)
                 b.data = data
-        self._dense_canvas_cache = None  # values changed
+        self.invalidate_dense_cache()  # values changed
+
+    def invalidate_dense_cache(self) -> None:
+        """Drop the cached dense canvas (multiply engine).  Must be
+        called by any code that rebinds bin ``data`` arrays directly
+        instead of going through `map_bin_data` /
+        `set_structure_from_device` (which call this themselves)."""
+        self._dense_canvas_cache = None
 
     def zero_data(self) -> None:
         self.map_bin_data(lambda d: jnp.zeros_like(d))

@@ -502,16 +502,16 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
     bn = int(c.col_blk_sizes[0])
     bk = int(a.col_blk_sizes[0])
     nbr, nbc, nbk = a.nblkrows, c.nblkcols, a.nblkcols
-    ar, ac = a.entry_coords()
-    br_, bc_ = b.entry_coords()
-    ad = _dense_canvas_cached(a, lambda: _blocks_to_dense(
-        a.bins[0].data[: a.nblks] if a.nblks else jnp.zeros((0, bm, bk), c.dtype),
-        jnp.asarray(ar), jnp.asarray(ac), nbr, nbk, bm, bk,
-    ))
-    bd = _dense_canvas_cached(b, lambda: _blocks_to_dense(
-        b.bins[0].data[: b.nblks] if b.nblks else jnp.zeros((0, bk, bn), c.dtype),
-        jnp.asarray(br_), jnp.asarray(bc_), nbk, nbc, bk, bn,
-    ))
+    def _build(m, nr, nc_, brow, bcol):
+        rows, cols = m.entry_coords()
+        return _blocks_to_dense(
+            m.bins[0].data[: m.nblks] if m.nblks
+            else jnp.zeros((0, brow, bcol), c.dtype),
+            jnp.asarray(rows), jnp.asarray(cols), nr, nc_, brow, bcol,
+        )
+
+    ad = _dense_canvas_cached(a, lambda: _build(a, nbr, nbk, bm, bk))
+    bd = _dense_canvas_cached(b, lambda: _build(b, nbk, nbc, bk, bn))
     c_blocks = (
         c.bins[0].data[: c.nblks]
         if c.nblks

@@ -139,6 +139,7 @@ def scale_by_vector(
             b.data = b.data * segs_d[:, None, :]
         else:
             b.data = b.data * segs_d[:, :, None]
+    matrix.invalidate_dense_cache()
     return matrix
 
 
@@ -370,6 +371,7 @@ def copy_into_existing(
             )
             new_data = new_data.at[jnp.asarray(matrix_b.ent_slot[ent])].set(blocks)
         b.data = new_data
+    matrix_b.invalidate_dense_cache()
     return matrix_b
 
 
@@ -670,6 +672,7 @@ def triu(matrix: BlockSparseMatrix) -> BlockSparseMatrix:
         sel = diag[matrix.ent_bin[diag] == b_id]
         if len(sel):
             b.data = _zero_strict_lower(b.data, jnp.asarray(matrix.ent_slot[sel]))
+    matrix.invalidate_dense_cache()
     return matrix
 
 

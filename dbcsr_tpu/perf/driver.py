@@ -357,10 +357,12 @@ def _force_completion(matrix: BlockSparseMatrix) -> float:
     dependency on the producing program, which no backend can satisfy
     early — the timing contract the reference gets from mp_sync
     (`dbcsr_performance_multiply.F:597`)."""
+    from dbcsr_tpu.utils.sync import fetch_fence
+
     total = 0.0
     for b in matrix.bins:
         if b.count:
-            total += float(np.asarray(b.data[0, 0, 0]).real)
+            total += fetch_fence(b.data)
     return total
 
 

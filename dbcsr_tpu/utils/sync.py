@@ -1,0 +1,21 @@
+"""Forced-completion fencing for honest timing.
+
+`jax.block_until_ready` can return before the device work actually ran
+on remote-tunnel backends (the axon pathology, PERF_NOTES.md): timing
+fenced that way reports dispatch, not execution.  A data-dependent
+fetch of one element cannot be served before the producing program
+finished — the moral equivalent of the reference's `mp_sync` timing
+fence (`dbcsr_performance_multiply.F:597`).  Every timed path (perf
+driver, autotuner, acc micro-benchmarks) fences through this helper so
+the contract lives in one place.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def fetch_fence(arr) -> float:
+    """Force REAL completion of the program producing ``arr`` by
+    fetching its first element (8-byte d2h); returns it as float."""
+    return float(np.asarray(arr.ravel()[0]).real)
