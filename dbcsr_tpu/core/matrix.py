@@ -592,10 +592,12 @@ class BlockSparseMatrix:
         self.invalidate_dense_cache()  # values changed
 
     def invalidate_dense_cache(self) -> None:
-        """Drop the cached dense canvas (multiply engine).  Must be
-        called by any code that rebinds bin ``data`` arrays directly
-        instead of going through `map_bin_data` /
-        `set_structure_from_device` (which call this themselves)."""
+        """Drop the cached dense canvas (multiply engine).  Correctness
+        never depends on this — the cache is keyed by bin data-array
+        identity, so any rebind misses — but code that rebinds bin
+        ``data`` on a matrix that may carry a live canvas should call
+        it to release the stale canvas/array references early
+        (`map_bin_data` / `set_structure_from_device` do)."""
         self._dense_canvas_cache = None
 
     def zero_data(self) -> None:

@@ -51,10 +51,7 @@ multihost.shutdown_multihost()
 '''
 
 
-def test_two_process_world_psum_and_sparse_cannon(tmp_path):
-    worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER.format(repo=os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))))
+def _run_world(worker, attempt_timeout):
     s = socket.socket()
     s.bind(("localhost", 0))
     port = s.getsockname()[1]
@@ -72,10 +69,25 @@ def test_two_process_world_psum_and_sparse_cannon(tmp_path):
     outs = []
     try:
         for p in procs:
-            outs.append(p.communicate(timeout=240)[0])
+            outs.append(p.communicate(timeout=attempt_timeout)[0])
+    except subprocess.TimeoutExpired:
+        outs = None  # port race / hung join: caller may retry
     finally:
         for p in procs:
             p.kill()
+    return procs, outs
+
+
+def test_two_process_world_psum_and_sparse_cannon(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER.format(repo=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    # the ephemeral port can be raced between close() and the rank-0
+    # bind; retry once on a hang with a fresh port
+    procs, outs = _run_world(worker, attempt_timeout=120)
+    if outs is None:
+        procs, outs = _run_world(worker, attempt_timeout=240)
+    assert outs is not None, "world never formed (twice)"
     for i, (p, o) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{o[-3000:]}"
     oks = [l for o in outs for l in o.splitlines() if " OK psum=" in l]

@@ -1,0 +1,107 @@
+"""Randomized configuration sweep of the multiply engine — breadth
+beyond the named unittest1-style cases: random blockings, occupancies,
+dtypes, alpha/beta, transposes, symmetry of inputs, drivers, filtering
+and retain_sparsity, each verified against the dense oracle (SURVEY §4
+pattern).  Seeded: every run checks the same 24 configurations."""
+
+import numpy as np
+import pytest
+
+from dbcsr_tpu import create, make_random_matrix, multiply, to_dense
+from dbcsr_tpu.core.config import set_config
+from dbcsr_tpu.ops.test_methods import impose_sparsity
+from dbcsr_tpu.ops.transformations import desymmetrize
+
+
+def _cfgs(n):
+    rng = np.random.default_rng(20260730)
+    out = []
+    for i in range(n):
+        out.append(dict(
+            seed=int(rng.integers(1 << 30)),
+            nbr=int(rng.integers(2, 7)),
+            nbk=int(rng.integers(2, 7)),
+            nbc=int(rng.integers(2, 7)),
+            sizes=rng.choice([1, 2, 3, 5, 7, 13], size=3).tolist(),
+            occ_a=float(rng.uniform(0.2, 1.0)),
+            occ_b=float(rng.uniform(0.2, 1.0)),
+            occ_c=float(rng.uniform(0.0, 0.6)),
+            alpha=float(rng.choice([1.0, -0.5, 2.0])),
+            beta=float(rng.choice([0.0, 1.0, 0.5])),
+            transa=str(rng.choice(["N", "T"])),
+            transb=str(rng.choice(["N", "T"])),
+            symm_a=bool(rng.random() < 0.25),
+            dtype=rng.choice([np.float64, np.float32, np.complex128]),
+            driver=str(rng.choice(["auto", "xla", "xla_group"])),
+            filter_eps=(None if rng.random() < 0.7 else 0.3),
+            retain=bool(rng.random() < 0.2),
+        ))
+    return out
+
+
+@pytest.mark.parametrize("cfg", _cfgs(24))
+def test_multiply_fuzz(cfg):
+    rng = np.random.default_rng(cfg["seed"])
+    pick = lambda n: rng.choice(cfg["sizes"], size=n).tolist()  # noqa: E731
+    m_s, k_s, n_s = pick(cfg["nbr"]), pick(cfg["nbk"]), pick(cfg["nbc"])
+    symm_a = cfg["symm_a"] and cfg["nbr"] == cfg["nbk"]
+    if symm_a:
+        k_s = m_s
+    dt = cfg["dtype"]
+    a_rbs, a_cbs = (m_s, k_s) if cfg["transa"] == "N" else (k_s, m_s)
+    if symm_a:
+        a = make_random_matrix("a", m_s, m_s, dtype=dt, occupation=cfg["occ_a"],
+                               matrix_type="S", rng=rng)
+    else:
+        a = make_random_matrix("a", a_rbs, a_cbs, dtype=dt,
+                               occupation=cfg["occ_a"], rng=rng)
+    b_rbs, b_cbs = (k_s, n_s) if cfg["transb"] == "N" else (n_s, k_s)
+    b = make_random_matrix("b", b_rbs, b_cbs, dtype=dt,
+                           occupation=cfg["occ_b"], rng=rng)
+    c = make_random_matrix("c", m_s, n_s, dtype=dt, occupation=cfg["occ_c"],
+                           rng=rng)
+    c0 = to_dense(c).copy()
+
+    def op(mat, tr):
+        d = to_dense(desymmetrize(mat) if mat.matrix_type != "N" else mat)
+        return d.T if tr == "T" else d
+
+    want = cfg["alpha"] * (op(a, "N" if symm_a else cfg["transa"])
+                           @ op(b, cfg["transb"])) + cfg["beta"] * c0
+    transa = "N" if symm_a else cfg["transa"]
+
+    if cfg["filter_eps"] is not None:
+        # filtered products have engine-defined semantics (on-the-fly
+        # norm-product skip + final pass); the meaningful fuzz property
+        # is CROSS-DRIVER agreement, elementwise exact
+        c2 = c.copy()
+        try:
+            set_config(mm_driver="xla")
+            multiply(transa, cfg["transb"], cfg["alpha"], a, b, cfg["beta"],
+                     c, filter_eps=cfg["filter_eps"],
+                     retain_sparsity=cfg["retain"])
+            set_config(mm_driver="xla_group")
+            multiply(transa, cfg["transb"], cfg["alpha"], a, b, cfg["beta"],
+                     c2, filter_eps=cfg["filter_eps"],
+                     retain_sparsity=cfg["retain"])
+        finally:
+            set_config(mm_driver="auto")
+        assert np.array_equal(c.keys, c2.keys)
+        # drivers accumulate in different orders; values agree to dtype
+        # precision (bit-identity holds only within one driver)
+        dtol = 5e-5 if np.dtype(dt) == np.float32 else 1e-12
+        np.testing.assert_allclose(to_dense(c), to_dense(c2),
+                                   rtol=dtol, atol=dtol)
+        return
+
+    set_config(mm_driver=cfg["driver"])
+    try:
+        multiply(transa, cfg["transb"], cfg["alpha"], a, b, cfg["beta"], c,
+                 retain_sparsity=cfg["retain"])
+    finally:
+        set_config(mm_driver="auto")
+    got = to_dense(c)
+    if cfg["retain"]:
+        want = impose_sparsity(want, c)
+    tol = 5e-5 if np.dtype(dt) == np.float32 else 1e-11
+    np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
