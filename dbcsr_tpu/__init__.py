@@ -27,7 +27,12 @@ from dbcsr_tpu.core.kinds import (
     dbcsr_type_complex_8,
     dtype_of,
 )
-from dbcsr_tpu.core.config import get_config, set_config, print_config
+from dbcsr_tpu.core.config import (
+    get_config,
+    get_default_config,
+    print_config,
+    set_config,
+)
 from dbcsr_tpu.core.lib import init_lib, finalize_lib, print_statistics
 from dbcsr_tpu.core.dist import (
     ProcessGrid,

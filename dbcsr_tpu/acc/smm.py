@@ -37,6 +37,18 @@ from dbcsr_tpu.core.kinds import real_dtype_of
 from dbcsr_tpu.utils.rounding import bucket_size
 
 
+def emulated_dtype_on_tpu(dtype) -> bool:
+    """True when ``dtype`` is software-EMULATED on the current device
+    (f64/c128 on TPU: split-f32/bf16 passes).  The single gate shared
+    by every driver decision that exists to counter the emulation
+    penalty (the xla_group default here and the mesh path's
+    `_stack_r0`)."""
+    return (
+        np.dtype(dtype) in (np.float64, np.complex128)
+        and jax.devices()[0].platform == "tpu"
+    )
+
+
 def _accum_dtype(dtype):
     """Accumulate bf16 in f32; everything else in its own precision."""
     d = jnp.dtype(dtype)
@@ -329,15 +341,17 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
     plan = StackPlan()
     plan.nseg = c_data.shape[0]
     # R-tiled grouped layout (see _process_stack_xla_group): the default
-    # for emulated-f64 dtypes, where the per-entry dot is MXU-starved
+    # for emulated-f64 dtypes on TPU, where the per-entry dot is
+    # MXU-starved; elsewhere f64 is native and per-entry is fine (same
+    # platform gate as the mesh path's _stack_r0)
     want_group = cfg.mm_driver == "xla_group" or (
         cfg.mm_driver == "auto"
         and (
             tuned_driver == "xla_group"
             or (
                 tuned_driver is None
-                and jnp.dtype(c_data.dtype) in (jnp.float64, jnp.complex128)
                 and S >= 2048
+                and emulated_dtype_on_tpu(c_data.dtype)
             )
         )
     )

@@ -117,3 +117,9 @@ def print_config(out=print) -> None:
     """Ref `dbcsr_print_config`."""
     for f in dataclasses.fields(Config):
         out(f"  dbcsr_tpu.{f.name:<28} {getattr(_cfg, f.name)}")
+
+
+def get_default_config() -> Config:
+    """A fresh Config with compile-time defaults — env overrides NOT
+    applied (ref `dbcsr_get_default_config`, `dbcsr_api.F:175`)."""
+    return Config()

@@ -190,6 +190,7 @@ def _stack_r0(dtype) -> int:
     TPU only (f64 is native elsewhere; per-entry dots are fine there);
     mm_driver='xla_group' forces it on any platform (how the CPU-mesh
     tests cover the tiled layout)."""
+    from dbcsr_tpu.acc.smm import emulated_dtype_on_tpu
     from dbcsr_tpu.core.config import get_config
 
     driver = get_config().mm_driver
@@ -197,9 +198,7 @@ def _stack_r0(dtype) -> int:
         return 8
     if driver != "auto":
         return 0
-    if np.dtype(dtype) not in (np.float64, np.complex128):
-        return 0
-    return 8 if jax.devices()[0].platform == "tpu" else 0
+    return 8 if emulated_dtype_on_tpu(dtype) else 0
 
 
 def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=0):

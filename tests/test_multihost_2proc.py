@@ -75,6 +75,11 @@ def _run_world(worker, attempt_timeout):
     finally:
         for p in procs:
             p.kill()
+        for p in procs:
+            try:
+                p.communicate(timeout=10)  # reap; close pipes
+            except Exception:
+                pass
     return procs, outs
 
 

@@ -70,6 +70,7 @@ def test_multiply_fuzz(cfg):
                            @ op(b, cfg["transb"])) + cfg["beta"] * c0
     transa = "N" if symm_a else cfg["transa"]
 
+    prev_driver = __import__("dbcsr_tpu").get_config().mm_driver
     if cfg["filter_eps"] is not None:
         # filtered products have engine-defined semantics (on-the-fly
         # norm-product skip + final pass); the meaningful fuzz property
@@ -85,7 +86,7 @@ def test_multiply_fuzz(cfg):
                      c2, filter_eps=cfg["filter_eps"],
                      retain_sparsity=cfg["retain"])
         finally:
-            set_config(mm_driver="auto")
+            set_config(mm_driver=prev_driver)
         assert np.array_equal(c.keys, c2.keys)
         # drivers accumulate in different orders; values agree to dtype
         # precision (bit-identity holds only within one driver)
@@ -99,7 +100,7 @@ def test_multiply_fuzz(cfg):
         multiply(transa, cfg["transb"], cfg["alpha"], a, b, cfg["beta"], c,
                  retain_sparsity=cfg["retain"])
     finally:
-        set_config(mm_driver="auto")
+        set_config(mm_driver=prev_driver)
     got = to_dense(c)
     if cfg["retain"]:
         want = impose_sparsity(want, c)
