@@ -192,3 +192,14 @@ def coo_fill_blocks(blk_of_entry, local_row, local_col, values,
         out_flat.ctypes.data_as(ctypes.c_void_p),
     )
     return True
+
+
+def sort_order(group, ngroups, c_slot, a_ent):
+    """Permutation sorting stack entries by (group, c_slot, a_ent) —
+    native when available, `np.lexsort` otherwise.  The ONE place the
+    sort-key contract (bit-reproducible stack order) lives; both the
+    single-chip stack builder and the mesh `_fill_stacks` use it."""
+    ns = group_sort_stacks(group, ngroups, c_slot, a_ent)
+    if ns is not None:
+        return ns[0]
+    return np.lexsort((a_ent, c_slot, group))

@@ -134,7 +134,9 @@ def _fill_stacks(group_id, st_a, st_b, st_c, nslots, cap_c, r0=0,
     guaranteed-zero panel rows ``pad_a``/``pad_b`` (their product is 0
     and MAY land in a live segment), dead tiles target segment cap_c.
     """
-    order = np.lexsort((st_a, st_c, group_id))
+    from dbcsr_tpu import native
+
+    order = native.sort_order(group_id, nslots, st_c, st_a)
     group_id, st_a, st_b, st_c = (
         group_id[order], st_a[order], st_b[order], st_c[order]
     )

@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from dbcsr_tpu import create, make_random_matrix, multiply, to_dense
-from dbcsr_tpu.core.config import set_config
+from dbcsr_tpu.core.config import get_config, set_config
 from dbcsr_tpu.ops.test_methods import impose_sparsity
 from dbcsr_tpu.ops.transformations import desymmetrize
 
@@ -70,7 +70,7 @@ def test_multiply_fuzz(cfg):
                            @ op(b, cfg["transb"])) + cfg["beta"] * c0
     transa = "N" if symm_a else cfg["transa"]
 
-    prev_driver = __import__("dbcsr_tpu").get_config().mm_driver
+    prev_driver = get_config().mm_driver
     if cfg["filter_eps"] is not None:
         # filtered products have engine-defined semantics (on-the-fly
         # norm-product skip + final pass); the meaningful fuzz property

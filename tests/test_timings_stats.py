@@ -1,0 +1,84 @@
+"""Timing framework and statistics registry tests (ref
+`core/dbcsr_timings*.F`: callstack timer with self/total accounting,
+report table, cachegrind callgraph export, overridable hooks
+`dbcsr_base_hooks.F:54-110`; `dbcsr_mm_sched.F:390-546` statistics)."""
+
+import time
+
+from dbcsr_tpu.core import stats, timings
+
+
+def setup_function(_):
+    timings.reset()
+    stats.reset()
+
+
+def test_timed_self_total_accounting():
+    with timings.timed("outer"):
+        time.sleep(0.02)
+        with timings.timed("inner"):
+            time.sleep(0.03)
+    outer = timings._stats["outer"]
+    inner = timings._stats["inner"]
+    assert outer.calls == 1 and inner.calls == 1
+    # total(outer) covers inner; self(outer) excludes it
+    assert outer.total >= 0.05 - 1e-3
+    assert outer.self_time <= outer.total - inner.total + 5e-3
+    assert inner.total >= 0.03 - 1e-3
+
+
+def test_report_lists_routines(capsys=None):
+    with timings.timed("alpha"):
+        with timings.timed("beta"):
+            pass
+    lines = []
+    timings.report(out=lines.append)
+    text = "\n".join(lines)
+    assert "alpha" in text and "beta" in text
+    assert "SELF" in text and "TOTAL" in text
+
+
+def test_callgraph_export_cachegrind_format(tmp_path):
+    with timings.timed("parent"):
+        with timings.timed("child"):
+            pass
+    path = tmp_path / "callgrind.out"
+    timings.export_callgraph(str(path))
+    text = path.read_text()
+    # cachegrind essentials: events header, fn= entries, cfn= call edge
+    assert "events:" in text
+    assert "fn=" in text and "cfn=" in text
+    assert "parent" in text and "child" in text
+
+
+def test_hooks_override():
+    """A host application can override timeset/timestop (ref
+    `dbcsr_init_lib_hooks`, `dbcsr_lib.F:142`)."""
+    calls = []
+    timings.set_hooks(lambda n: calls.append(("set", n)),
+                      lambda n: calls.append(("stop", n)))
+    try:
+        with timings.timed("hooked"):
+            pass
+    finally:
+        timings.set_hooks(None, None)
+    assert ("set", "hooked") in calls and ("stop", "hooked") in calls
+    # the default registry did NOT record while hooks were active
+    assert "hooked" not in timings._stats
+
+
+def test_stats_counters_and_print():
+    stats.record_stack(23, 23, 23, 100)
+    stats.record_stack(23, 23, 23, 50)
+    stats.record_stack(5, 5, 5, 10)
+    stats.record_multiply(12345)
+    stats.record_comm("ppermute", 4, 1024)
+    assert stats.total_flops() == 2 * 23**3 * 150 + 2 * 5**3 * 10
+    lines = []
+    stats.print_statistics(out=lines.append)
+    text = "\n".join(lines)
+    assert "23 x 23 x 23" in text or "23x23x23" in text
+    assert "ppermute" in text
+    assert "marketing" in text
+    stats.reset()
+    assert stats.total_flops() == 0
