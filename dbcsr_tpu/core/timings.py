@@ -28,8 +28,13 @@ _hooks = None  # optional (timeset_fn, timestop_fn) override
 
 
 def set_hooks(timeset_fn, timestop_fn) -> None:
+    """Install host-application timer hooks (ref `dbcsr_init_lib_hooks`,
+    `dbcsr_base_hooks.F:54-110`); ``set_hooks(None, None)`` restores
+    the built-in timer."""
     global _hooks
-    _hooks = (timeset_fn, timestop_fn)
+    _hooks = None if timeset_fn is None and timestop_fn is None else (
+        timeset_fn, timestop_fn
+    )
 
 
 def timeset(name: str) -> None:

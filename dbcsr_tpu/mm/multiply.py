@@ -792,17 +792,10 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None) -> int:
         ngroups = len(c.bins) * len(a.bins) * len(b.bins)
         from dbcsr_tpu import native
 
-        native_sorted = native.group_sort_stacks(g, ngroups, c_slot, a_ent)
-        if native_sorted is not None:
-            order, gbounds = native_sorted
-            nonempty = np.nonzero(np.diff(gbounds))[0]
-            spans = [(int(gbounds[gi]), int(gbounds[gi + 1])) for gi in nonempty]
-        else:
-            order = np.lexsort((a_ent, c_slot, g))
-            g_sorted = g[order]
-            uniq, first = np.unique(g_sorted, return_index=True)
-            b_arr = np.append(first, len(g_sorted))
-            spans = [(int(b_arr[i]), int(b_arr[i + 1])) for i in range(len(uniq))]
+        order, gbounds = native.sort_order(g, ngroups, c_slot, a_ent,
+                                           return_bounds=True)
+        nonempty = np.nonzero(np.diff(gbounds))[0]
+        spans = [(int(gbounds[gi]), int(gbounds[gi + 1])) for gi in nonempty]
         c_slot = c_slot[order]
         a_slot = a_slot[order]
         b_slot = b_slot[order]

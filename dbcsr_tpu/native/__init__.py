@@ -194,12 +194,21 @@ def coo_fill_blocks(blk_of_entry, local_row, local_col, values,
     return True
 
 
-def sort_order(group, ngroups, c_slot, a_ent):
+def sort_order(group, ngroups, c_slot, a_ent, return_bounds: bool = False):
     """Permutation sorting stack entries by (group, c_slot, a_ent) —
     native when available, `np.lexsort` otherwise.  The ONE place the
-    sort-key contract (bit-reproducible stack order) lives; both the
-    single-chip stack builder and the mesh `_fill_stacks` use it."""
+    sort-key contract (bit-reproducible stack order) lives; the
+    single-chip stack builder and the mesh `_fill_stacks` both use it.
+    ``return_bounds`` also returns the ngroups+1 group boundaries."""
     ns = group_sort_stacks(group, ngroups, c_slot, a_ent)
     if ns is not None:
-        return ns[0]
-    return np.lexsort((a_ent, c_slot, group))
+        return ns if return_bounds else ns[0]
+    order = np.lexsort((a_ent, c_slot, group))
+    if not return_bounds:
+        return order
+    counts = np.bincount(np.ascontiguousarray(group, np.int64),
+                         minlength=ngroups)
+    bounds = np.empty(ngroups + 1, np.int64)
+    bounds[0] = 0
+    np.cumsum(counts, out=bounds[1:])
+    return order, bounds

@@ -260,3 +260,51 @@ def test_reset_randmat_seed_reproduces():
     dt.reset_randmat_seed(7)
     m2 = make_random_matrix("x", [3, 3], [3, 3], occupation=0.7)
     assert checksum(m1) == checksum(m2)
+
+
+def test_remaining_export_surface():
+    """Touch every exported symbol that no other test references by
+    name: dtype enums, FUNC_DDTANH, BlockIterator re-export, CsrMatrix,
+    TEST_MM constant, get_default_config, lib lifecycle."""
+    import numpy as _np
+
+    assert dt.dtype_of(dt.dbcsr_type_real_8) == _np.float64
+    assert dt.dtype_of(dt.dbcsr_type_real_4) == _np.float32
+    assert dt.dtype_of(dt.dbcsr_type_complex_8) == _np.complex128
+    assert dt.dtype_of(dt.dbcsr_type_complex_4) == _np.complex64
+    # d2 tanh/dx2 of tanh(x) at x: 2*(t^3 - t)
+    m = create("m", [2], [2])
+    m.put_block(0, 0, np.array([[0.3, -0.2], [0.7, 0.1]]))
+    m.finalize()
+    x = to_dense(m).copy()
+    dt.function_of_elements(m, dt.FUNC_DDTANH)
+    t = np.tanh(x)
+    np.testing.assert_allclose(to_dense(m), 2.0 * (t**3 - t), rtol=1e-12)
+    # explicit-iterator re-export
+    it = dt.BlockIterator(m)
+    assert it.blocks_left()
+    # CsrMatrix direct construction
+    csr = dt.CsrMatrix(2, 2, [0, 1, 2], [0, 1], np.array([1.0, 2.0]))
+    assert csr.nze == 2 and csr.valid
+    assert dt.TEST_MM == 1 and dt.TEST_BINARY_IO == 2
+    assert dt.get_default_config().mm_driver == "auto"
+    # lifecycle: finalize then re-init is allowed
+    dt.finalize_lib()
+    dt.init_lib()
+
+
+def test_replicate_all_mesh():
+    """replicate_all puts the full matrix on every device (ref
+    dbcsr_replicate_all); collecting any single device's copy
+    reproduces the matrix."""
+    from dbcsr_tpu.parallel import make_grid
+
+    from dbcsr_tpu.parallel import collect
+
+    rng = np.random.default_rng(41)
+    m = make_random_matrix("m", [3, 2], [2, 3], occupation=0.9, rng=rng)
+    dm = dt.replicate_all(m, make_grid(8))
+    np.testing.assert_allclose(
+        to_dense(collect(dm, drop_zero_blocks=False)), to_dense(m),
+        rtol=1e-14, atol=1e-14,
+    )

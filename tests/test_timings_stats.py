@@ -27,7 +27,7 @@ def test_timed_self_total_accounting():
     assert inner.total >= 0.03 - 1e-3
 
 
-def test_report_lists_routines(capsys=None):
+def test_report_lists_routines():
     with timings.timed("alpha"):
         with timings.timed("beta"):
             pass
