@@ -92,8 +92,29 @@ def reset() -> None:
     _stack.clear()
 
 
-def report(out=print, top: int = 30) -> None:
-    """Per-routine table, self-time ordered (ref timings_report.F:51)."""
+def report(out=print, top: int = 30, aggregate: bool = False) -> None:
+    """Per-routine table, self-time ordered (ref timings_report.F:51).
+
+    ``aggregate=True`` in a multi-process world prints the
+    rank-aggregated table — AVERAGE and MAX self/total time per routine
+    across processes, on the coordinator only (ref the MPI-aggregated
+    report, `dbcsr_timings_report.F:51-301`)."""
+    import jax
+
+    if aggregate and jax.process_count() > 1:
+        rows = _aggregate_ranks()
+        if rows is None or jax.process_index() != 0:
+            return
+        out(" " + "-" * 88)
+        out(" -" + f"T I M I N G  ({jax.process_count()} ranks)".center(86) + "-")
+        out(" " + "-" * 88)
+        out(f" {'SUBROUTINE':<30} {'CALLS':>8} {'SELF avg':>10} "
+            f"{'SELF max':>10} {'TOT avg':>10} {'TOT max':>10}")
+        for name, calls, s_avg, s_max, t_avg, t_max in rows[:top]:
+            out(f" {name:<30} {calls:>8} {s_avg:>10.3f} {s_max:>10.3f} "
+                f"{t_avg:>10.3f} {t_max:>10.3f}")
+        out(" " + "-" * 88)
+        return
     if not _stats:
         return
     out(" " + "-" * 70)
@@ -104,6 +125,55 @@ def report(out=print, top: int = 30) -> None:
     for name, st in rows:
         out(f" {name:<36} {st.calls:>8} {st.self_time:>11.3f} {st.total:>11.3f}")
     out(" " + "-" * 70)
+
+
+_AGG_MAX_ROUTINES = 64
+_AGG_NAME_BYTES = 40
+
+
+def _aggregate_ranks():
+    """Gather every rank's (name, calls, self, total) table via
+    `process_allgather` (fixed-shape padded arrays — routine sets may
+    differ per rank) and reduce to per-routine avg/max rows sorted by
+    avg self time.  Returns None when no rank has timings."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    local = sorted(_stats.items(), key=lambda kv: -kv[1].self_time)
+    local = local[:_AGG_MAX_ROUTINES]
+    names = np.zeros((_AGG_MAX_ROUTINES, _AGG_NAME_BYTES), np.uint8)
+    vals = np.zeros((_AGG_MAX_ROUTINES, 3), np.float64)
+    for i, (name, st) in enumerate(local):
+        raw = name.encode()[:_AGG_NAME_BYTES]
+        names[i, : len(raw)] = np.frombuffer(raw, np.uint8)
+        vals[i] = (st.calls, st.self_time, st.total)
+    gathered = multihost_utils.process_allgather((names, vals))
+    all_names = np.asarray(gathered[0])
+    all_vals = np.asarray(gathered[1])
+    table = {}
+    for r in range(all_names.shape[0]):
+        for i in range(_AGG_MAX_ROUTINES):
+            raw = bytes(all_names[r, i][all_names[r, i] != 0])
+            if not raw:
+                continue
+            name = raw.decode(errors="replace")
+            calls, s, t = all_vals[r, i]
+            e = table.setdefault(name, [0, [], []])
+            e[0] = max(e[0], int(calls))
+            e[1].append(float(s))
+            e[2].append(float(t))
+    if not table:
+        return None
+    nproc = all_names.shape[0]
+    rows = []
+    for name, (calls, selfs, tots) in table.items():
+        # ranks missing the routine contribute 0 to the average, like
+        # the reference's sum/nranks
+        s_avg = sum(selfs) / nproc
+        t_avg = sum(tots) / nproc
+        rows.append((name, calls, s_avg, max(selfs), t_avg, max(tots)))
+    rows.sort(key=lambda r: -r[2])
+    return rows
 
 
 def export_callgraph(path: str) -> None:

@@ -45,6 +45,19 @@ b = make_random_matrix("B", sizes, sizes, occupation=0.5, rng=rng)
 c = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh)
 err = np.abs(to_dense(c) - to_dense(a) @ to_dense(b)).max()
 assert err < 1e-12, err
+
+# rank-aggregated timing report: printed by rank 0 only, every rank
+# participates in the allgather (ref dbcsr_timings_report.F:51-301)
+from dbcsr_tpu.core import timings
+lines = []
+timings.report(out=lines.append, aggregate=True)
+if pid == 0:
+    text = "\n".join(lines)
+    assert "2 ranks" in text and "SELF avg" in text, text
+    assert "sparse_cannon" in text, text
+else:
+    assert not lines
+
 print(f"WORKER{{pid}} OK psum={{local[0]}} err={{err:.2e}} "
       f"checksum={{checksum(c)!r}}")
 multihost.shutdown_multihost()
