@@ -99,8 +99,8 @@ def report(out=print, top: int = 30, aggregate: bool = False) -> None:
     rank-aggregated table — AVERAGE and MAX self/total time per routine
     across processes, on the coordinator only (ref the MPI-aggregated
     report, `dbcsr_timings_report.F:51-301`)."""
-    import jax
-
+    if aggregate:
+        import jax
     if aggregate and jax.process_count() > 1:
         rows = _aggregate_ranks()
         if rows is None or jax.process_index() != 0:
@@ -144,7 +144,13 @@ def _aggregate_ranks():
     names = np.zeros((_AGG_MAX_ROUTINES, _AGG_NAME_BYTES), np.uint8)
     vals = np.zeros((_AGG_MAX_ROUTINES, 3), np.float64)
     for i, (name, st) in enumerate(local):
-        raw = name.encode()[:_AGG_NAME_BYTES]
+        raw = name.encode()
+        if len(raw) > _AGG_NAME_BYTES:
+            # keep long names distinct after truncation: last 6 bytes
+            # carry a content hash, not the (possibly shared) prefix
+            import hashlib
+
+            raw = raw[: _AGG_NAME_BYTES - 6] + hashlib.sha1(raw).hexdigest()[:6].encode()
         names[i, : len(raw)] = np.frombuffer(raw, np.uint8)
         vals[i] = (st.calls, st.self_time, st.total)
     gathered = multihost_utils.process_allgather((names, vals))

@@ -67,6 +67,21 @@ def _scatter_scaled_window(dst, src, src_slots, dst_slots, beta, rl, rh, cl, ch)
     return dst.at[dst_slots].set(blk * factor, mode="drop")
 
 
+def _real_scalar(x, dtype):
+    """Coerce alpha/beta for a real-dtype product, raising a clear
+    TypeError (not a deep cast error) on a nonzero imaginary part."""
+    arr = np.asarray(x)
+    if np.iscomplexobj(arr):
+        if complex(arr).imag != 0.0:
+            raise TypeError(
+                f"complex alpha/beta with a real matrix C "
+                f"(dtype {np.dtype(dtype).name}); use a complex matrix "
+                f"or real scalars"
+            )
+        return complex(arr).real
+    return x
+
+
 def _effective(matrix: BlockSparseMatrix, trans: str) -> BlockSparseMatrix:
     """Resolve op(X): desymmetrize + transpose/conjugate as needed
     (ref transpose wrappers at `dbcsr_mm.F:521-582`)."""
@@ -130,6 +145,11 @@ def multiply(
         a = _effective(matrix_a, transa)
         b = _effective(matrix_b, transb)
         c = matrix_c
+        if not np.issubdtype(np.dtype(c.dtype), np.complexfloating):
+            # the reference's typed-alpha contract, surfaced clearly: a
+            # complex scalar with nonzero imaginary part cannot scale a
+            # real product; zero-imag complex scalars coerce
+            alpha, beta = (_real_scalar(x, c.dtype) for x in (alpha, beta))
         if not np.array_equal(a.col_blk_sizes, b.row_blk_sizes):
             raise ValueError("inner blockings of op(A), op(B) differ")
         if not np.array_equal(c.row_blk_sizes, a.row_blk_sizes):

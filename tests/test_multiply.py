@@ -392,3 +392,16 @@ def test_dense_canvas_cache_hits_and_invalidates():
                                    rtol=1e-12, atol=1e-12)
     finally:
         set_config(mm_dense=None)
+
+
+def test_alpha_beta_scalar_typing():
+    """Zero-imag complex scalars coerce for real products; nonzero-imag
+    raise a clear TypeError (the reference's typed-alpha contract)."""
+    a = _rand("a", [2, 2], [2, 2], 1.0, seed=90)
+    b = _rand("b", [2, 2], [2, 2], 1.0, seed=91)
+    c = create("c", [2, 2], [2, 2])
+    multiply("N", "N", complex(2.0, 0.0), a, b, complex(0.0, 0.0), c)
+    np.testing.assert_allclose(to_dense(c), 2.0 * (to_dense(a) @ to_dense(b)),
+                               rtol=1e-12, atol=1e-12)
+    with pytest.raises(TypeError, match="complex alpha"):
+        multiply("N", "N", 1.0 + 2.0j, a, b, 0.0, create("c", [2, 2], [2, 2]))
