@@ -71,6 +71,32 @@ class Distribution:
         `dbcsr_get_stored_coordinates`, `dbcsr_dist_operations.F`)."""
         return int(self.row_dist[row]), int(self.col_dist[col])
 
+    def get_info(self) -> dict:
+        """Distribution summary (ref `dbcsr_distribution_get`,
+        `dbcsr_api.F:226`)."""
+        return {
+            "nblkrows": self.nblkrows,
+            "nblkcols": self.nblkcols,
+            "nprows": self.grid.nprows,
+            "npcols": self.grid.npcols,
+            "row_dist": self.row_dist.copy(),
+            "col_dist": self.col_dist.copy(),
+        }
+
+    def checksum(self) -> int:
+        """Content hash of the maps (ref `dbcsr_dist_util.F:57`
+        distribution checksum/verify)."""
+        import hashlib
+
+        # lengths first: without them the concatenated maps of a 2x3
+        # and a 3x2 blocking hash identically
+        h = hashlib.sha1(np.int64(
+            [self.nblkrows, self.nblkcols, self.grid.nprows, self.grid.npcols]
+        ).tobytes())
+        h.update(self.row_dist.tobytes())
+        h.update(self.col_dist.tobytes())
+        return int.from_bytes(h.digest()[:8], "little")
+
     def transposed(self) -> "Distribution":
         """Ref `dbcsr_transpose_distribution` (`dbcsr_dist_operations.F:55`)."""
         grid = ProcessGrid(self.grid.npcols, self.grid.nprows, self.grid.mesh)

@@ -179,6 +179,14 @@ class BlockSparseMatrix:
         """Ref `dbcsr_setname`."""
         self.name = str(name)
 
+    def get_stored_coordinates(self, row: int, col: int):
+        """Owning (prow, pcol) of a block under this matrix's
+        distribution (ref `dbcsr_get_stored_coordinates`)."""
+        srow, scol = row, col
+        if self.matrix_type != NO_SYMMETRY and row > col:
+            srow, scol = col, row  # canonical triangle owns the block
+        return self.dist.stored_coordinates(srow, scol)
+
     @property
     def valid_index(self) -> bool:
         """Finalized and consistent (ref `dbcsr_valid_index`)."""

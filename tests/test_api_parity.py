@@ -308,3 +308,15 @@ def test_replicate_all_mesh():
         to_dense(collect(dm, drop_zero_blocks=False)), to_dense(m),
         rtol=1e-14, atol=1e-14,
     )
+
+
+def test_distribution_get_info_and_checksum():
+    from dbcsr_tpu import Distribution, ProcessGrid
+
+    d = Distribution([0, 1, 0], [1, 0], ProcessGrid(2, 2))
+    info = d.get_info()
+    assert info["nblkrows"] == 3 and info["npcols"] == 2
+    np.testing.assert_array_equal(info["row_dist"], [0, 1, 0])
+    cs = d.checksum()
+    assert cs == Distribution([0, 1, 0], [1, 0], ProcessGrid(2, 2)).checksum()
+    assert cs != d.transposed().checksum()

@@ -294,3 +294,20 @@ def test_reference_style_iterator():
     import pytest as _pytest
     with _pytest.raises(IndexError):
         it.next_block()
+
+
+def test_get_stored_coordinates():
+    """Matrix-level owner lookup honors the distribution and symmetric
+    canonical storage (ref dbcsr_get_stored_coordinates)."""
+    from dbcsr_tpu.core.dist import Distribution, ProcessGrid
+
+    grid = ProcessGrid(2, 2)
+    dist = Distribution([0, 1, 0], [1, 0, 1], grid)
+    m = make_random_matrix("m", [2, 2, 2], [2, 2, 2], occupation=1.0,
+                           rng=np.random.default_rng(9), dist=dist)
+    assert m.get_stored_coordinates(1, 2) == (1, 1)
+    s = make_random_matrix("s", [2, 2, 2], [2, 2, 2], occupation=1.0,
+                           matrix_type="S", rng=np.random.default_rng(9),
+                           dist=dist)
+    # lower-triangle query resolves to the stored upper block's owner
+    assert s.get_stored_coordinates(2, 0) == s.get_stored_coordinates(0, 2)
