@@ -86,6 +86,10 @@ def main():
         "checksum": res["checksum"],
         "device": res["device"],
         "device_fallback": fallback,
+        # which algorithm the engine's cost model chose ("dense" on TPU
+        # for this config; "stack" on CPU) — GFLOP/s is always TRUE
+        # sparse-product flops over wall time either way
+        "algorithm": res.get("algorithm"),
         # timing forces real device completion via a data-dependent
         # 8-byte fetch per rep (driver._force_completion): on the axon
         # tunnel, block_until_ready alone can return before the work
