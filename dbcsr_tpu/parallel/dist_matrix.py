@@ -30,13 +30,17 @@ from dbcsr_tpu.parallel.cannon import cannon_multiply_dense
 from dbcsr_tpu.utils.rounding import ceil_div
 
 # sharding of each operand role (Cannon layout, see cannon.py);
-# 'R' = fully replicated (ref dbcsr_repl_full, dbcsr_replicate_all,
+# 'R' = fully replicated, 'Rrow'/'Rcol' = replicated across grid
+# rows/cols only (ref dbcsr_repl_none/row/col/full,
+# `dbcsr_types.F:476-479`; dbcsr_replicate_all,
 # dbcsr_transformations.F:108)
 _ROLE_SPECS = {
     "A": P("pr", ("kl", "pc")),
     "B": P(("kl", "pr"), "pc"),
     "C": P("pr", "pc"),
     "R": P(),
+    "Rrow": P(None, "pc"),   # every process row holds the full rows
+    "Rcol": P("pr", None),   # every process col holds the full cols
 }
 
 
@@ -70,6 +74,10 @@ def _pad_counts(mesh: Mesh, role: str):
         return s, kls
     if role == "B":
         return kls, s
+    if role == "Rrow":
+        return 1, s  # rows replicated, cols sharded over 'pc'
+    if role == "Rcol":
+        return s, 1  # cols replicated, rows sharded over 'pr'
     return s, s
 
 
@@ -146,10 +154,16 @@ def collect(dm: DistMatrix, drop_zero_blocks: bool = True) -> BlockSparseMatrix:
     return _adopt_panels(out, keys.astype(np.int64), grid[rows, cols])
 
 
-def replicate(matrix: BlockSparseMatrix, mesh: Mesh, name: Optional[str] = None) -> DistMatrix:
-    """Replicate a matrix onto every device (ref `dbcsr_replicate_all`,
+def replicate(matrix: BlockSparseMatrix, mesh: Mesh, name: Optional[str] = None,
+              mode: str = "full") -> DistMatrix:
+    """Replicate a matrix onto the mesh (ref `dbcsr_replicate_all`,
     `dbcsr_transformations.F:108`) — the layout TAS uses for the small
     matrix of a split multiply.
+
+    ``mode``: "full" replicates onto every device (dbcsr_repl_full);
+    "row" replicates across grid rows, sharding columns over 'pc'
+    (dbcsr_repl_row, `dbcsr_types.F:476-479`); "col" the transpose
+    (dbcsr_repl_col).
 
     The reference pairs this with `dbcsr_sum_replicated`
     (`dbcsr_operations.F:2383`) to merge per-rank updates; under jax
@@ -158,7 +172,11 @@ def replicate(matrix: BlockSparseMatrix, mesh: Mesh, name: Optional[str] = None)
     computation produced per-device contributions (see the 'kl'
     reduction in `cannon.py` for the pattern).
     """
-    return distribute(matrix, mesh, role="R", name=name)
+    try:
+        role = {"full": "R", "row": "Rrow", "col": "Rcol"}[mode]
+    except KeyError:
+        raise ValueError(f"unknown replication mode {mode!r}") from None
+    return distribute(matrix, mesh, role=role, name=name)
 
 
 def multiply_distributed(

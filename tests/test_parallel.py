@@ -150,3 +150,26 @@ def test_stored_coordinates():
     d = Distribution(np.array([0, 1, 0]), np.array([2, 0, 1, 2]), grid)
     assert d.stored_coordinates(1, 0) == (1, 2)
     assert d.stored_coordinates(2, 1) == (0, 0)
+
+
+def test_replicate_modes_row_col_full():
+    """dbcsr_repl_row/col/full analogs: each mode's collect reproduces
+    the matrix, and the sharding replicates along the right axis."""
+    from jax.sharding import PartitionSpec as P
+
+    from dbcsr_tpu.parallel import replicate
+
+    mesh = make_grid(8)
+    rng = np.random.default_rng(77)
+    m = make_random_matrix("m", [3, 2, 3], [2, 3, 2], occupation=0.9, rng=rng)
+    want = to_dense(m)
+    for mode, spec in (("full", P()), ("row", P(None, "pc")),
+                       ("col", P("pr", None))):
+        dm = replicate(m, mesh, mode=mode)
+        np.testing.assert_allclose(
+            to_dense(collect(dm, drop_zero_blocks=False)), want,
+            rtol=1e-14, atol=1e-14, err_msg=mode,
+        )
+        assert dm.data.sharding.spec == spec, (mode, dm.data.sharding.spec)
+    with pytest.raises(ValueError, match="replication mode"):
+        replicate(m, mesh, mode="diagonal")
