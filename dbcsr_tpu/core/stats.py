@@ -16,6 +16,7 @@ class _MnkStat:
     nstacks: int = 0
     nentries: int = 0
     flops: int = 0
+    by_driver: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -29,7 +30,11 @@ _comm: dict = collections.defaultdict(_CommStat)
 _totals = {"multiplies": 0, "flops": 0, "marketing_flops": 0}
 
 
-def record_stack(m: int, n: int, k: int, nentries: int) -> None:
+def record_stack(m: int, n: int, k: int, nentries: int, *,
+                 driver: str) -> None:
+    """Per-(m,n,k) stack accounting with a DRIVER breakdown — the
+    reference's BLAS/SMM/ACC split (`dbcsr_mm_sched.F:390-546`) maps to
+    {xla, xla_flat, xla_group, pallas, dense, mesh} here."""
     from dbcsr_tpu.core.config import get_config
 
     if not get_config().keep_stats:
@@ -38,6 +43,7 @@ def record_stack(m: int, n: int, k: int, nentries: int) -> None:
     st.nstacks += 1
     st.nentries += nentries
     st.flops += 2 * m * n * k * nentries
+    st.by_driver[driver] = st.by_driver.get(driver, 0) + 2 * m * n * k * nentries
 
 
 def record_comm(kind: str, nmessages: int, nbytes: int) -> None:
@@ -77,13 +83,15 @@ def print_statistics(out=print) -> None:
     out(" " + "-" * 70)
     out(" -" + "DBCSR-TPU STATISTICS".center(68) + "-")
     out(" " + "-" * 70)
-    out(f" {'COUNT':>24} {'m x n x k':>14} {'entries':>12} {'GFLOP':>12}")
+    out(f" {'COUNT':>24} {'m x n x k':>14} {'entries':>12} {'GFLOP':>12}"
+        f"  {'drivers'}")
     tot = 0
     for (m, n, k), st in sorted(_by_mnk.items()):
         tot += st.flops
+        drv = ",".join(f"{d}={f / 1e9:.2f}" for d, f in sorted(st.by_driver.items()))
         out(
             f" {st.nstacks:>24} {f'{m}x{n}x{k}':>14} {st.nentries:>12}"
-            f" {st.flops / 1e9:>12.3f}"
+            f" {st.flops / 1e9:>12.3f}  {drv}"
         )
     out(f" {'total (TPU stacks)':>24} {'':>14} {'':>12} {tot / 1e9:>12.3f}")
     out(f" multiplications:       {_totals['multiplies']}")

@@ -549,7 +549,7 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
     if pad:
         out = jnp.concatenate([out, jnp.zeros((pad, bm, bn), out.dtype)])
     c.set_structure_from_device(new_keys, [_Bin((bm, bn), out, len(new_keys))])
-    stats.record_stack(bm, bn, bk, nbr * nbc * nbk)
+    stats.record_stack(bm, bn, bk, nbr * nbc * nbk, driver="dense")
     stats.record_multiply(2 * nbr * bm * nbc * bn * nbk * bk)
     return _true_product_flops(a, b)
 
@@ -845,6 +845,6 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None) -> int:
         c.bins[cbin].data = execute_stack(
             c.bins[cbin].data, a.bins[abin].data, b.bins[bbin].data, plan, alpha
         )
-        stats.record_stack(m, n, k, cnt)
+        stats.record_stack(m, n, k, cnt, driver=plan.driver)
         flops += 2 * m * n * k * cnt
     return flops

@@ -68,17 +68,22 @@ class DistMatrix:
 
 
 def _pad_counts(mesh: Mesh, role: str):
-    s = mesh.shape["pr"]
-    kls = mesh.shape["kl"] * s
-    if role == "A":
-        return s, kls
-    if role == "B":
-        return kls, s
-    if role == "Rrow":
-        return 1, s  # rows replicated, cols sharded over 'pc'
-    if role == "Rcol":
-        return s, 1  # cols replicated, rows sharded over 'pr'
-    return s, s
+    """Block-count padding quanta per dim, DERIVED from the role's
+    PartitionSpec (product of the mesh axis sizes sharding that dim) —
+    one source of truth with _ROLE_SPECS; unknown roles raise."""
+    spec = _ROLE_SPECS[role]
+
+    def quantum(i):
+        entry = spec[i] if len(spec) > i else None
+        if entry is None:
+            return 1
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        n = 1
+        for ax in axes:
+            n *= mesh.shape[ax]
+        return n
+
+    return quantum(0), quantum(1)
 
 
 def distribute(

@@ -562,7 +562,7 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         filter_matrix(out, filter_eps)
     from dbcsr_tpu.core import stats
 
-    stats.record_stack(bm, bn, bk, len(rows_t))
+    stats.record_stack(bm, bn, bk, len(rows_t), driver="mesh")
     stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
     # collective-traffic accounting (ref count_mpi_statistics,
     # dbcsr_mm_common.F:135): each tick ppermutes every device's A and B
@@ -801,7 +801,7 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
 
     from dbcsr_tpu.core import stats
 
-    stats.record_stack(bm, bn, bk, len(rows_t))
+    stats.record_stack(bm, bn, bk, len(rows_t), driver="mesh")
     stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
     ndev = g * s * s
     itemsize = dtype.itemsize

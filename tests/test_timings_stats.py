@@ -68,9 +68,9 @@ def test_hooks_override():
 
 
 def test_stats_counters_and_print():
-    stats.record_stack(23, 23, 23, 100)
-    stats.record_stack(23, 23, 23, 50)
-    stats.record_stack(5, 5, 5, 10)
+    stats.record_stack(23, 23, 23, 100, driver="xla")
+    stats.record_stack(23, 23, 23, 50, driver="xla")
+    stats.record_stack(5, 5, 5, 10, driver="pallas")
     stats.record_multiply(12345)
     stats.record_comm("ppermute", 4, 1024)
     assert stats.total_flops() == 2 * 23**3 * 150 + 2 * 5**3 * 10
@@ -82,3 +82,17 @@ def test_stats_counters_and_print():
     assert "marketing" in text
     stats.reset()
     assert stats.total_flops() == 0
+
+
+def test_stats_driver_breakdown():
+    """The reference's per-backend flop split (BLAS/SMM/ACC,
+    dbcsr_mm_sched.F:390-546) maps to a per-driver breakdown here."""
+    stats.record_stack(4, 4, 4, 10, driver="xla")
+    stats.record_stack(4, 4, 4, 5, driver="xla_group")
+    st = stats._by_mnk[(4, 4, 4)]
+    assert st.by_driver["xla"] == 2 * 64 * 10
+    assert st.by_driver["xla_group"] == 2 * 64 * 5
+    lines = []
+    stats.print_statistics(out=lines.append)
+    text = "\n".join(lines)
+    assert "xla_group=" in text
