@@ -1,0 +1,21 @@
+#!/bin/bash
+# Capture the headline TPU number FIRST THING in a round (PERF_NOTES.md
+# lesson: do this before any experiment that could wedge the shared
+# axon terminal).  Probes the tunnel with a hard timeout, then runs
+# bench.py and appends the JSON line to BENCH_CAPTURES.jsonl.
+set -u
+cd "$(dirname "$0")/.."
+probe() {
+  timeout "${1:-90}" python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.arange(8.0); assert float(np.asarray(x)[3]) == 3.0
+" >/dev/null 2>&1
+}
+if probe 90; then
+  echo "tunnel healthy; capturing bench..."
+  timeout 1500 python bench.py | tee -a BENCH_CAPTURES.jsonl
+else
+  echo "tunnel unreachable (probe timed out); NOT queuing more work on it."
+  echo "re-run this script later; bench.py itself degrades to CPU fallback."
+  exit 1
+fi
