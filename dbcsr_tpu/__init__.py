@@ -118,3 +118,97 @@ from dbcsr_tpu.ops.tests import TEST_BINARY_IO, TEST_MM, run_tests
 from dbcsr_tpu.parallel.dist_matrix import replicate as replicate_all
 
 __version__ = "0.1.0"
+
+# the public surface (~88 symbols; the dbcsr_api.F analog list,
+# see PARITY.md for the name-by-name mapping)
+__all__ = [
+    "BlockIterator",
+    "BlockSparseMatrix",
+    "CSR_DBCSR_BLKROW_DIST",
+    "CSR_EQROW_CEIL_DIST",
+    "CSR_EQROW_FLOOR_DIST",
+    "CsrMatrix",
+    "Distribution",
+    "FUNC_ARTANH",
+    "FUNC_ASIN",
+    "FUNC_COS",
+    "FUNC_DDSIN",
+    "FUNC_DDTANH",
+    "FUNC_DSIN",
+    "FUNC_DTANH",
+    "FUNC_INVERSE",
+    "FUNC_INVERSE_SPECIAL",
+    "FUNC_SIN",
+    "FUNC_SPREAD_FROM_ZERO",
+    "FUNC_TANH",
+    "FUNC_TRUNCATE",
+    "ProcessGrid",
+    "TEST_BINARY_IO",
+    "TEST_MM",
+    "add",
+    "add_on_diag",
+    "binary_read",
+    "binary_write",
+    "checksum",
+    "clear",
+    "column_norms",
+    "complete_redistribute",
+    "convert_offsets_to_sizes",
+    "convert_sizes_to_offsets",
+    "copy",
+    "copy_into_existing",
+    "create",
+    "crop_matrix",
+    "csr_create_from_matrix",
+    "csr_from_matrix",
+    "csr_print_sparsity",
+    "csr_write",
+    "dbcsr_type_complex_4",
+    "dbcsr_type_complex_8",
+    "dbcsr_type_real_4",
+    "dbcsr_type_real_8",
+    "desymmetrize",
+    "dist_bin",
+    "dot",
+    "dtype_of",
+    "filter_matrix",
+    "finalize_lib",
+    "frobenius_norm",
+    "from_dense",
+    "function_of_elements",
+    "gershgorin_norm",
+    "get_block_diag",
+    "get_config",
+    "get_default_config",
+    "get_diag",
+    "hadamard_product",
+    "init_lib",
+    "make_random_matrix",
+    "matrix_from_csr",
+    "maxabs_norm",
+    "multiply",
+    "new_transposed",
+    "print_block_sum",
+    "print_config",
+    "print_matrix",
+    "print_statistics",
+    "redistribute",
+    "replicate_all",
+    "reserve_all_blocks",
+    "reserve_blocks",
+    "reserve_diag_blocks",
+    "reset_randmat_seed",
+    "run_tests",
+    "scale",
+    "scale_by_vector",
+    "set_config",
+    "set_diag",
+    "set_value",
+    "submatrix",
+    "to_csr_filter",
+    "to_dense",
+    "trace",
+    "triu",
+    "verify_matrix",
+]
+
