@@ -97,6 +97,15 @@ class Distribution:
         h.update(self.col_dist.tobytes())
         return int.from_bytes(h.digest()[:8], "little")
 
+    def fingerprint(self) -> int:
+        """Memoized `checksum()` (maps are treated as immutable once the
+        distribution is attached to a matrix — nothing in the package
+        mutates them in place).  Used to key mesh plan caches."""
+        fp = getattr(self, "_fp", None)
+        if fp is None:
+            fp = self._fp = self.checksum()
+        return fp
+
     def transposed(self) -> "Distribution":
         """Ref `dbcsr_transpose_distribution` (`dbcsr_dist_operations.F:55`)."""
         grid = ProcessGrid(self.grid.npcols, self.grid.nprows, self.grid.mesh)

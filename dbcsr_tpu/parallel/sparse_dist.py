@@ -393,30 +393,217 @@ def sparse_multiply_distributed(
         )
 
 
-def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
-                          limits=(None,) * 6, retain_sparsity=False,
-                          filter_eps=None):
-    kl, s = mesh.shape["kl"], mesh.shape["pr"]
-    if mesh.shape["pc"] != s:
-        raise ValueError("sparse Cannon needs a square ('pr','pc') grid")
-    # accumulate in C's dtype when C is given (host-path convention)
-    a, b, matrix_c, dtype, bm, bk, bn = _prepare_operands(
-        matrix_a, matrix_b, matrix_c
+# --------------------------------------------------------------------------
+# Rank-resident mesh multiplies (ref: a dbcsr matrix's data areas live on
+# their owning ranks permanently, `dbcsr_types.F:363-461`, backed by
+# mempools `dbcsr_mem_methods.F`; a multiply moves only panels).  The
+# single-controller analog: all pattern-derived index work (symbolic
+# product, stack fill, panel/collect maps) is cached per pattern
+# (`_mesh_plan_cache`, the mesh sibling of `mm/multiply._plan_cache`),
+# panel assembly and C collection run ON DEVICE from the matrices' shape
+# bins (no `_dense_blocks_host` d2h fetch, no h2d panel upload), and the
+# assembled sharded panels themselves are cached keyed by the operands'
+# bin data-array identities (the `_dense_canvas_cached` trick) so a
+# repeated same-pattern, same-data multiply uploads nothing at all.
+# --------------------------------------------------------------------------
+
+import dataclasses
+from collections import OrderedDict as _OrderedDict
+
+
+@functools.partial(jax.jit, static_argnames=("nflat", "bm", "bn", "dtype_name"))
+def _assemble_flat(bin_datas, flat_pos, src_slots, *, nflat, bm, bn, dtype_name):
+    """Scatter shape-bin blocks into a zero (nflat, bm, bn) panel buffer
+    at precomputed flat positions — the device-side make_m2s data
+    movement (`dbcsr_mm_cannon.F:146,292`).  Unwritten rows (bucket pads,
+    the r0 guaranteed-zero row) stay zero.  Index arrays are padded to
+    bucketed lengths with out-of-range destinations (dropped) so evolving
+    patterns reuse the compiled program."""
+    out = jnp.zeros((nflat, bm, bn), jnp.dtype(dtype_name))
+    for data, fp, ss in zip(bin_datas, flat_pos, src_slots):
+        blk = jnp.take(data, ss, axis=0).astype(out.dtype)
+        out = out.at[fp, : data.shape[1], : data.shape[2]].set(blk, mode="drop")
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("caps", "shapes"))
+def _collect_bins(c_flat, gather_pos, bin_slots, *, caps, shapes):
+    """Carve the flat C panel buffer into per-shape bins on device (the
+    collect half of `dbcsr_merge_all`, `dbcsr_work_operations.F:1393`,
+    without the host round-trip `_adopt_panels` pays).  Padded index
+    rows carry an out-of-range bin slot and are dropped."""
+    outs = []
+    for fp, sl, cap, (bmb, bnb) in zip(gather_pos, bin_slots, caps, shapes):
+        blk = jnp.take(c_flat, fp, axis=0)[:, :bmb, :bnb]
+        outs.append(
+            jnp.zeros((cap, bmb, bnb), c_flat.dtype).at[sl].set(blk, mode="drop")
+        )
+    return tuple(outs)
+
+
+@dataclasses.dataclass
+class _BinAsm:
+    """Device-resident assembly indices for one operand: which bin each
+    contributing entry lives in, its flat panel destination, and its
+    in-bin source slot."""
+
+    bin_ids: tuple  # operand bin ids, one per non-empty scatter group
+    flat_pos: tuple  # jnp int32 arrays, destinations in the flat buffer
+    src_slots: tuple  # jnp int32 arrays, gather slots within the bin
+    nflat: int
+    bm: int
+    bn: int
+
+    def nbytes(self) -> int:
+        return sum(int(x.nbytes) for x in self.flat_pos) + sum(
+            int(x.nbytes) for x in self.src_slots
+        )
+
+
+def _make_bin_asm(m: BlockSparseMatrix, flat: np.ndarray, nflat: int,
+                  bm: int, bn: int) -> _BinAsm:
+    """Build a `_BinAsm` from per-entry flat destinations (key order).
+    Index arrays are padded to bucketed lengths (pad destinations point
+    past the buffer and scatter with mode="drop") so same-size evolving
+    patterns reuse the compiled assembly."""
+    bin_ids, fps, sss = [], [], []
+    for b_id in range(len(m.bins)):
+        sel = np.nonzero(m.ent_bin == b_id)[0]
+        if not len(sel):
+            continue
+        bin_ids.append(b_id)
+        cap = bucket_size(len(sel))
+        fp = np.full(cap, nflat, np.int32)  # pads: out of range -> dropped
+        fp[: len(sel)] = flat[sel]
+        ss = np.zeros(cap, np.int32)  # pads: any in-range gather slot
+        ss[: len(sel)] = m.ent_slot[sel]
+        fps.append(jnp.asarray(fp))
+        sss.append(jnp.asarray(ss))
+    return _BinAsm(tuple(bin_ids), tuple(fps), tuple(sss), nflat, bm, bn)
+
+
+def _run_bin_asm(asm: _BinAsm, m: BlockSparseMatrix, dtype) -> object:
+    datas = tuple(m.bins[b].data for b in asm.bin_ids)
+    return _assemble_flat(
+        datas, asm.flat_pos, asm.src_slots,
+        nflat=asm.nflat, bm=asm.bm, bn=asm.bn, dtype_name=np.dtype(dtype).name,
     )
 
-    # ---- symbolic product on host (ref dbcsr_mm_csr.F C-index build) ----
+
+@dataclasses.dataclass
+class _MeshPlan:
+    """Everything about a mesh multiply that only depends on the
+    operands' patterns, distributions, dtype and product options."""
+
+    s: int
+    kl: int
+    r0: int
+    xtr: int
+    cap_a: int
+    cap_b: int
+    cap_c: int
+    bm: int
+    bk: int
+    bn: int
+    dtype: object
+    acc_name: str
+    true_flops: int
+    n_cand: int
+    stacks_dev: object  # sharded (kl, s, s, s, cap, w) int32
+    a_asm: _BinAsm
+    b_asm: _BinAsm
+    cinit_asm: Optional[_BinAsm]  # None when C had no stored blocks
+    has_window: bool
+    inside_all: bool
+    inside_dev: object  # (s, s, cap_c) bool device array, or None
+    c_keys: np.ndarray
+    c_binning: tuple  # (_bin_entries result) for c_keys
+    collect_pos: tuple  # per-out-bin jnp gather positions into flat C
+    collect_slots: tuple  # per-out-bin jnp in-bin slots
+    collect_caps: tuple
+    collect_counts: tuple
+    collect_shapes: tuple
+    out_dist: object
+    upload_bytes: int
+    # (bin-data ids, sharded panels, keepalive) per operand; the ids are
+    # sound because the keepalive holds the arrays (no id recycling)
+    panel_cache: dict = dataclasses.field(default_factory=dict)
+
+    def nbytes(self) -> int:
+        """Device bytes this plan pins: stacks, index maps, and the
+        cached panels.  The panel keepalives are NOT counted — they
+        alias the owning matrix's live bin data, not extra copies."""
+        n = int(self.stacks_dev.nbytes) + self.a_asm.nbytes() + self.b_asm.nbytes()
+        if self.cinit_asm is not None:
+            n += self.cinit_asm.nbytes()
+        n += sum(int(x.nbytes) for x in self.collect_pos)
+        n += sum(int(x.nbytes) for x in self.collect_slots)
+        if self.inside_dev is not None:
+            n += int(self.inside_dev.nbytes)
+        for _, panels, _ in self.panel_cache.values():
+            n += int(panels.nbytes)
+        return n
+
+
+_mesh_plan_cache: "_OrderedDict[tuple, _MeshPlan]" = _OrderedDict()
+_MESH_PLAN_MAX = 8
+_MESH_PLAN_MAX_BYTES = 512 * 1024 * 1024
+
+
+def clear_mesh_plans() -> None:
+    """Release all cached mesh plans and their device-resident panels."""
+    _mesh_plan_cache.clear()
+
+
+def _mesh_cache_evict() -> None:
+    while len(_mesh_plan_cache) > _MESH_PLAN_MAX or (
+        len(_mesh_plan_cache) > 1
+        and sum(p.nbytes() for p in _mesh_plan_cache.values())
+        > _MESH_PLAN_MAX_BYTES
+    ):
+        _mesh_plan_cache.popitem(last=False)
+
+
+def _mesh_plan_insert(key, plan: _MeshPlan) -> None:
+    _mesh_plan_cache[key] = plan
+    _mesh_cache_evict()
+
+
+def _cached_panels(plan: _MeshPlan, which: str, m: BlockSparseMatrix,
+                   mesh, panel_shape, spec) -> object:
+    """Sharded panels for one operand, rebuilt on device only when the
+    operand's bin data changed since the cached assembly."""
+    ids = tuple(id(bb.data) for bb in m.bins)
+    hit = plan.panel_cache.get(which)
+    if hit is not None and hit[0] == ids:
+        return hit[1]
+    asm = {"a": plan.a_asm, "b": plan.b_asm}[which]
+    flat = _run_bin_asm(asm, m, plan.dtype)
+    panels = jax.device_put(
+        flat.reshape(panel_shape), NamedSharding(mesh, spec)
+    )
+    plan.panel_cache[which] = (ids, panels, [bb.data for bb in m.bins])
+    # panels are the big rows in the byte budget and land AFTER the
+    # plan's insert — re-check the cap every time one is stored
+    _mesh_cache_evict()
+    return panels
+
+
+def _build_mesh_plan(a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
+                     limits, retain_sparsity, filter_eps) -> _MeshPlan:
+    """The host-side half of a mesh multiply: symbolic product, device
+    and tick assignment, stack fill, panel/collect index maps — all of
+    it pattern-determined and device-uploaded exactly once."""
     from dbcsr_tpu.mm.multiply import _candidates
 
     shell_c = matrix_c if matrix_c is not None else BlockSparseMatrix(
-        name or f"{a.name}*{b.name}", a.row_blk_sizes, b.col_blk_sizes, dtype
+        f"{a.name}*{b.name}", a.row_blk_sizes, b.col_blk_sizes, dtype
     )
     rows_t, cols_t, a_ent, b_ent = _candidates(
         a, b, shell_c, filter_eps, *limits
     )
     old_keys = matrix_c.keys if matrix_c is not None else np.empty(0, np.int64)
     if retain_sparsity:
-        # product restricted to C's existing pattern (ref retain_sparsity,
-        # dbcsr_mm.F; shared masking helper with the single-chip engine)
         from dbcsr_tpu.mm.multiply import mask_in_sorted
 
         ok = mask_in_sorted(rows_t * shell_c.nblkcols + cols_t, old_keys)
@@ -433,29 +620,25 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         )
     )
 
-    # ---- block→process maps (honor the matrices' distributions) ----
     rdist, cdist, k_layer, k_col = _resolve_maps(a, b, matrix_c, s, kl)
 
-    # ---- device/tick assignment ----
     i_dev = rdist[rows_t]
     j_dev = cdist[cols_t]
     layer, kc = k_layer[k_t], k_col[k_t]
     tick_t = (kc - i_dev - j_dev) % s
 
-    # ---- panel ids + slots ----
     ar, ac = a.entry_coords()
     a_layer, a_kc = k_layer[ac], k_col[ac]
     a_panel = ((a_layer * s) + rdist[ar]) * s + a_kc  # (l, i, kc)
     a_slots = _panel_slots(a_panel)
-    cap_a = max(int(np.bincount(a_panel, minlength=kl * s * s).max()), 1) if a.nblks else 1
+    cap_a = bucket_size(max(int(np.bincount(a_panel, minlength=kl * s * s).max()), 1) if a.nblks else 1)
 
     br, bc = b.entry_coords()
     b_layer, b_kr = k_layer[br], k_col[br]
     b_panel = ((b_layer * s) + b_kr) * s + cdist[bc]  # (l, kr, j)
     b_slots = _panel_slots(b_panel)
-    cap_b = max(int(np.bincount(b_panel, minlength=kl * s * s).max()), 1) if b.nblks else 1
+    cap_b = bucket_size(max(int(np.bincount(b_panel, minlength=kl * s * s).max()), 1) if b.nblks else 1)
 
-    # C pattern = old C pattern ∪ product pattern (old only, if retained)
     if retain_sparsity:
         c_keys = old_keys
     else:
@@ -465,35 +648,39 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     c_cols = (c_keys % shell_c.nblkcols).astype(np.int64)
     c_panel = rdist[c_rows] * s + cdist[c_cols]
     c_slots = _panel_slots(c_panel)
-    cap_c = max(int(np.bincount(c_panel, minlength=s * s).max()), 1) if len(c_keys) else 1
+    cap_c = bucket_size(max(int(np.bincount(c_panel, minlength=s * s).max()), 1) if len(c_keys) else 1)
 
-    # ---- per-(device, tick) stacks ----
     ent_c = np.searchsorted(c_keys, rows_t * shell_c.nblkcols + cols_t)
     group = (((layer * s + i_dev) * s + j_dev) * s) + tick_t
-    r0 = _stack_r0(dtype)
     stacks = _fill_stacks(
         group, a_slots[a_ent], b_slots[b_ent], c_slots[ent_c],
         kl * s * s * s, cap_c, r0=r0, pad_a=cap_a, pad_b=cap_b,
     )
     stacks = stacks.reshape(kl, s, s, s, -1, stacks.shape[-1])
+    stacks_dev = jax.device_put(stacks, NamedSharding(mesh, P("kl", "pr", "pc")))
 
-    # ---- panel data, placed at the skewed start position ----
-    # r0-tiled stacks reference a guaranteed-zero pad row at cap_a/cap_b
+    # ---- device-side panel assembly maps (skewed start positions) ----
     xtr = 1 if r0 else 0
-    a_host = _dense_blocks_host(a, bm, bk)
-    a_panels = np.zeros((kl, s, s, cap_a + xtr, bm, bk), dtype)
     al, ai_, akc = a_panel // (s * s), (a_panel // s) % s, a_panel % s
     aj0 = (akc - ai_) % s  # device col initially holding panel (i, kc)
-    a_panels[al, ai_, aj0, a_slots] = a_host
+    a_flat = ((al * s + ai_) * s + aj0) * (cap_a + xtr) + a_slots
+    a_asm = _make_bin_asm(a, a_flat, kl * s * s * (cap_a + xtr), bm, bk)
 
-    b_host = _dense_blocks_host(b, bk, bn)
-    b_panels = np.zeros((kl, s, s, cap_b + xtr, bk, bn), dtype)
     bl, bkr, bj = b_panel // (s * s), (b_panel // s) % s, b_panel % s
     bi0 = (bkr - bj) % s  # device row initially holding panel (kr, j)
-    b_panels[bl, bi0, bj, b_slots] = b_host
+    b_flat = ((bl * s + bi0) * s + bj) * (cap_b + xtr) + b_slots
+    b_asm = _make_bin_asm(b, b_flat, kl * s * s * (cap_b + xtr), bk, bn)
 
-    # windowed-beta semantics (shared with the single-chip engine): C
-    # blocks outside the row/col limit window keep their old values
+    cinit_asm = None
+    if matrix_c is not None and matrix_c.nblks:
+        pos_old = np.searchsorted(c_keys, old_keys)
+        cinit_flat = (
+            rdist[c_rows[pos_old]] * s + cdist[c_cols[pos_old]]
+        ) * cap_c + c_slots[pos_old]
+        cinit_asm = _make_bin_asm(matrix_c, cinit_flat, s * s * cap_c, bm, bn)
+
+    # windowed-beta semantics: C blocks outside the limit window keep
+    # their old values (factor 1.0 instead of beta)
     fr_l, lr_l, fc_l, lc_l = limits[0], limits[1], limits[2], limits[3]
     has_window = any(x is not None for x in (fr_l, lr_l, fc_l, lc_l))
     inside = np.ones(len(c_keys), bool)
@@ -506,38 +693,36 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
             inside &= c_cols >= fc_l
         if lc_l is not None:
             inside &= c_cols <= lc_l
+    inside_dev = None
+    inside_bytes = 0
+    if has_window and not inside.all():
+        canvas = np.ones((s, s, cap_c), bool)
+        canvas[rdist[c_rows], cdist[c_cols], c_slots] = inside
+        inside_dev = jax.device_put(canvas, NamedSharding(mesh, P("pr", "pc")))
+        inside_bytes = canvas.nbytes
 
-    c_init = np.zeros((s, s, cap_c, bm, bn), dtype)
-    keep_old = beta != 0 or (has_window and not inside.all())
-    if matrix_c is not None and matrix_c.nblks and keep_old:
-        c_host = _dense_blocks_host(matrix_c, bm, bn)
-        pos_old = np.searchsorted(c_keys, old_keys)
-        c_init[rdist[c_rows[pos_old]], cdist[c_cols[pos_old]], c_slots[pos_old]] = c_host
+    # ---- device-side C collection maps ----
+    from dbcsr_tpu.core.matrix import _bin_entries
 
-    beta_fac = np.full((s, s, cap_c), beta, dtype)
-    if has_window:
-        out_sel = np.nonzero(~inside)[0]
-        beta_fac[rdist[c_rows[out_sel]], cdist[c_cols[out_sel]], c_slots[out_sel]] = 1.0
+    nb, nsl, shapes = _bin_entries(a.row_blk_sizes, b.col_blk_sizes, c_rows, c_cols)
+    collect_pos, collect_slots, collect_caps, collect_counts = [], [], [], []
+    c_flat_pos = c_panel * cap_c + c_slots
+    for b_id in range(len(shapes)):
+        sel = np.nonzero(nb == b_id)[0]
+        cap = bucket_size(len(sel))
+        # padded index rows: gather position 0 (any), bin slot cap
+        # (out of range -> dropped by the mode="drop" scatter)
+        fp = np.zeros(cap, np.int32)
+        fp[: len(sel)] = c_flat_pos[sel]
+        sl = np.full(cap, cap, np.int32)
+        sl[: len(sel)] = nsl[sel]
+        collect_pos.append(jnp.asarray(fp))
+        collect_slots.append(jnp.asarray(sl))
+        collect_caps.append(cap)
+        collect_counts.append(len(sel))
 
-    # ---- run on the mesh ----
-    dev = lambda arr, spec: jax.device_put(arr, NamedSharding(mesh, spec))
-    # bf16 data accumulates in f32 (the acc layer's _accum_dtype
-    # convention, smm.py); everything else in its own precision
-    acc_name = "float32" if dtype.name == "bfloat16" else dtype.name
-    c_out = _run_sparse_cannon(
-        dev(a_panels, P("kl", "pr", "pc")),
-        dev(b_panels, P("kl", "pr", "pc")),
-        dev(stacks, P("kl", "pr", "pc")),
-        dev(c_init, P("pr", "pc")),
-        jnp.asarray(alpha, dtype), dev(beta_fac, P("pr", "pc")),
-        s=s, cap_c=cap_c, acc_name=acc_name,
-        mesh_ref=_HashableMesh(mesh), r0=r0,
-    )
-
-    # ---- collect back into a host-indexed matrix ----
     from dbcsr_tpu.core.dist import Distribution, ProcessGrid
 
-    c_np = np.asarray(c_out)
     out_dist = (
         matrix_c.dist
         if matrix_c is not None and matrix_c.dist.grid.nprows == s
@@ -547,12 +732,130 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
             ProcessGrid(s, s, mesh),
         )
     )
+
+    upload_bytes = (
+        stacks.nbytes + a_asm.nbytes() + b_asm.nbytes() + inside_bytes
+        + (cinit_asm.nbytes() if cinit_asm is not None else 0)
+        + sum(int(x.nbytes) for x in collect_pos)
+        + sum(int(x.nbytes) for x in collect_slots)
+    )
+    acc_name = "float32" if np.dtype(dtype).name == "bfloat16" else np.dtype(dtype).name
+    return _MeshPlan(
+        s=s, kl=kl, r0=r0, xtr=xtr, cap_a=cap_a, cap_b=cap_b, cap_c=cap_c,
+        bm=bm, bk=bk, bn=bn, dtype=np.dtype(dtype), acc_name=acc_name,
+        true_flops=true_flops, n_cand=len(rows_t), stacks_dev=stacks_dev,
+        a_asm=a_asm, b_asm=b_asm, cinit_asm=cinit_asm,
+        has_window=has_window, inside_all=bool(inside.all()),
+        inside_dev=inside_dev, c_keys=c_keys,
+        c_binning=(nb, nsl, shapes),
+        collect_pos=tuple(collect_pos), collect_slots=tuple(collect_slots),
+        collect_caps=tuple(collect_caps), collect_counts=tuple(collect_counts),
+        collect_shapes=tuple(shapes), out_dist=out_dist,
+        upload_bytes=int(upload_bytes),
+    )
+
+
+def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
+                          limits=(None,) * 6, retain_sparsity=False,
+                          filter_eps=None):
+    kl, s = mesh.shape["kl"], mesh.shape["pr"]
+    if mesh.shape["pc"] != s:
+        raise ValueError("sparse Cannon needs a square ('pr','pc') grid")
+    # accumulate in C's dtype when C is given (host-path convention)
+    a, b, matrix_c, dtype, bm, bk, bn = _prepare_operands(
+        matrix_a, matrix_b, matrix_c
+    )
+
+    r0 = _stack_r0(dtype)
+    from dbcsr_tpu.core import stats
+
+    # ---- plan lookup (pattern-keyed; filtered products depend on
+    # VALUES via the norm skip, so they rebuild every time — the
+    # single-chip `_plan_cache` convention) ----
+    plan = None
+    plan_key = None
+    if filter_eps is None:
+        plan_key = (
+            a.pattern_fingerprint(), b.pattern_fingerprint(),
+            matrix_c.pattern_fingerprint() if matrix_c is not None else None,
+            a.dist.fingerprint(), b.dist.fingerprint(),
+            matrix_c.dist.fingerprint() if matrix_c is not None else None,
+            np.dtype(dtype).name, retain_sparsity, limits,
+            _HashableMesh(mesh), r0,
+        )
+        plan = _mesh_plan_cache.get(plan_key)
+        if plan is not None:
+            _mesh_plan_cache.move_to_end(plan_key)
+    if plan is None:
+        with timed("mesh_plan_build"):
+            plan = _build_mesh_plan(
+                a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
+                limits, retain_sparsity, filter_eps,
+            )
+        if plan_key is not None:
+            _mesh_plan_insert(plan_key, plan)
+        # the plan build is the ONLY host->device traffic of a mesh
+        # multiply now; plan-cache hits upload nothing
+        stats.record_comm("host2dev", 1, plan.upload_bytes)
+    cap_a, cap_b, cap_c = plan.cap_a, plan.cap_b, plan.cap_c
+    xtr = plan.xtr
+
+    # ---- device-side panel assembly (cached by bin data identity) ----
+    spec3 = P("kl", "pr", "pc")
+    a_panels = _cached_panels(
+        plan, "a", a, mesh, (kl, s, s, cap_a + xtr, bm, bk), spec3
+    )
+    b_panels = _cached_panels(
+        plan, "b", b, mesh, (kl, s, s, cap_b + xtr, bk, bn), spec3
+    )
+
+    keep_old = beta != 0 or (plan.has_window and not plan.inside_all)
+    if plan.cinit_asm is not None and keep_old:
+        c_flat = _run_bin_asm(plan.cinit_asm, matrix_c, dtype)
+    else:
+        c_flat = jnp.zeros((s * s * cap_c, bm, bn), dtype)
+    c_init = jax.device_put(
+        c_flat.reshape(s, s, cap_c, bm, bn), NamedSharding(mesh, P("pr", "pc"))
+    )
+
+    if plan.inside_dev is not None:
+        beta_fac = jnp.where(
+            plan.inside_dev,
+            jnp.asarray(beta, dtype), jnp.asarray(1, dtype),
+        )
+    else:
+        beta_fac = jnp.full((s, s, cap_c), beta, dtype)
+    beta_fac = jax.device_put(beta_fac, NamedSharding(mesh, P("pr", "pc")))
+
+    # ---- run on the mesh ----
+    c_out = _run_sparse_cannon(
+        a_panels, b_panels, plan.stacks_dev, c_init,
+        jnp.asarray(alpha, dtype), beta_fac,
+        s=s, cap_c=cap_c, acc_name=plan.acc_name,
+        mesh_ref=_HashableMesh(mesh), r0=r0,
+    )
+
+    # ---- device-side collect into shape bins (C stays resident) ----
     out = BlockSparseMatrix(
         name or (matrix_c.name if matrix_c is not None else f"{a.name}*{b.name}"),
         a.row_blk_sizes, b.col_blk_sizes, dtype,
-        dist=out_dist,
+        dist=plan.out_dist,
     )
-    _adopt_panels(out, c_keys, c_np[rdist[c_rows], cdist[c_cols], c_slots])
+    if len(plan.c_keys):
+        bin_datas = _collect_bins(
+            c_out.reshape(s * s * cap_c, bm, bn),
+            plan.collect_pos, plan.collect_slots,
+            caps=plan.collect_caps, shapes=plan.collect_shapes,
+        )
+        bins = [
+            _mk_bin(shape, data, count)
+            for shape, data, count in zip(
+                plan.collect_shapes, bin_datas, plan.collect_counts
+            )
+        ]
+    else:
+        bins = []
+    out.set_structure_from_device(plan.c_keys, bins, binning=plan.c_binning)
     if filter_eps is not None and not retain_sparsity:
         # final ||C|| >= eps pass (ref multrec_filtering,
         # dbcsr_mm_multrec.F:694-748) — shared criterion with the
@@ -560,15 +863,14 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         from dbcsr_tpu.ops.operations import filter_matrix
 
         filter_matrix(out, filter_eps)
-    from dbcsr_tpu.core import stats
 
-    stats.record_stack(bm, bn, bk, len(rows_t), driver="mesh")
+    stats.record_stack(bm, bn, bk, plan.n_cand, driver="mesh")
     stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
     # collective-traffic accounting (ref count_mpi_statistics,
     # dbcsr_mm_common.F:135): each tick ppermutes every device's A and B
     # panel; the layer reduction psums each device's C panel
     ndev = kl * s * s
-    itemsize = dtype.itemsize
+    itemsize = np.dtype(dtype).itemsize
     if s > 1:
         stats.record_comm(
             "ppermute", 2 * s * ndev,
@@ -581,12 +883,14 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
             "psum", (kl - 1) * s * s,
             (kl - 1) * s * s * cap_c * bm * bn * itemsize,
         )
-    stats.record_comm(
-        "host2dev", 4,
-        a_panels.nbytes + b_panels.nbytes + stacks.nbytes + c_init.nbytes,
-    )
-    out._last_flops = true_flops  # true flop count of this product
+    out._last_flops = plan.true_flops  # true flop count of this product
     return out
+
+
+def _mk_bin(shape, data, count):
+    from dbcsr_tpu.core.matrix import _Bin
+
+    return _Bin((int(shape[0]), int(shape[1])), data, int(count))
 
 
 @functools.partial(

@@ -315,6 +315,77 @@ def test_sparse_cannon_r_tiled_stacks(mesh8):
     np.testing.assert_allclose(to_dense(c_plain), want, rtol=1e-12, atol=1e-12)
 
 
+def test_mesh_residency_no_restaging(mesh8):
+    """A second same-pattern mesh multiply must upload NOTHING: the plan
+    (stacks + index maps) is pattern-cached and the panels are cached by
+    bin data identity (the rank-resident data-area analog,
+    `dbcsr_types.F:363-461` / mempools `dbcsr_mem_methods.F`)."""
+    from dbcsr_tpu.core import stats
+    from dbcsr_tpu.parallel.sparse_dist import clear_mesh_plans
+
+    clear_mesh_plans()
+    rbs = [4] * 10
+    a = _rand("A", rbs, rbs, 0.4, 50)
+    b = _rand("B", rbs, rbs, 0.4, 51)
+    stats.reset()
+    c1 = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh8)
+    assert stats._comm["host2dev"].nbytes > 0  # plan build uploads indices
+    stats.reset()
+    c2 = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh8)
+    assert stats._comm["host2dev"].nbytes == 0  # fully resident repeat
+    assert checksum(c1) == checksum(c2)
+    stats.reset()
+    clear_mesh_plans()
+
+
+def test_mesh_residency_data_change_same_pattern(mesh8):
+    """Changing operand VALUES (same pattern) must reassemble panels on
+    device — the plan cache may hit but the data-identity panel cache
+    must miss — and still upload nothing from host."""
+    from dbcsr_tpu.core import stats
+    from dbcsr_tpu.parallel.sparse_dist import clear_mesh_plans
+
+    clear_mesh_plans()
+    rbs = [3] * 9
+    a = _rand("A", rbs, rbs, 0.5, 52)
+    b = _rand("B", rbs, rbs, 0.5, 53)
+    c1 = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh8)
+    a.map_bin_data(lambda d: 2.0 * d)  # values change, pattern unchanged
+    stats.reset()
+    c2 = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh8)
+    assert stats._comm["host2dev"].nbytes == 0
+    np.testing.assert_allclose(
+        to_dense(c2), 2.0 * to_dense(c1), rtol=1e-12, atol=1e-12
+    )
+    stats.reset()
+    clear_mesh_plans()
+
+
+def test_mesh_residency_c_feedback_loop(mesh8):
+    """SCF-style loop: C feeds back as the accumulate operand.  After
+    the pattern converges (rep 2), further reps are fully resident."""
+    from dbcsr_tpu.core import stats
+    from dbcsr_tpu.parallel.sparse_dist import clear_mesh_plans
+
+    clear_mesh_plans()
+    rbs = [4] * 8
+    a = _rand("A", rbs, rbs, 0.5, 54)
+    b = _rand("B", rbs, rbs, 0.5, 55)
+    c = None
+    dense_c = np.zeros((sum(rbs), sum(rbs)))
+    for rep in range(4):
+        c = sparse_multiply_distributed(1.0, a, b, 0.5, c, mesh8)
+        dense_c = to_dense(a) @ to_dense(b) + 0.5 * dense_c
+        if rep == 3:
+            stats.reset()
+            c = sparse_multiply_distributed(1.0, a, b, 0.5, c, mesh8)
+            dense_c = to_dense(a) @ to_dense(b) + 0.5 * dense_c
+            assert stats._comm["host2dev"].nbytes == 0
+    np.testing.assert_allclose(to_dense(c), dense_c, rtol=1e-12, atol=1e-12)
+    stats.reset()
+    clear_mesh_plans()
+
+
 def test_sparse_cannon_r_tiled_filtering(mesh8):
     """R-tiled layout + on-the-fly filtering/retain_sparsity agree with
     the single-chip engine."""
