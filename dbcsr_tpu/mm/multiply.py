@@ -478,7 +478,18 @@ def _dense_multiply_general(a, b, c, alpha, beta) -> int:
     cd = alpha_dev * cd
     if beta != 0 and c.nblks:
         cd = cd + beta_dev * _to_dense_device(c)
-    # carve into C's full pattern, bin by bin
+    carve_full_pattern(c, cd)
+    # marketing flops = the dense work performed; the RETURN value is the
+    # true flops of the sparse product (comparable across algorithms,
+    # ref marketing-vs-true `dbcsr_mm.F:664-667`)
+    stats.record_multiply(2 * c.nfullrows * c.nfullcols * a.nfullcols)
+    return _true_product_flops(a, b)
+
+
+def carve_full_pattern(c, cd) -> None:
+    """Carve a dense device canvas into ``c``'s FULL block pattern, bin
+    by bin (`dbcsr_make_undense`, `dbcsr_mm.F:770-810`); shared by the
+    single-chip and mesh dense modes."""
     nbr, nbc = c.nblkrows, c.nblkcols
     new_keys = np.arange(nbr * nbc, dtype=np.int64)
     rows = new_keys // nbc
@@ -504,11 +515,6 @@ def _dense_multiply_general(a, b, c, alpha, beta) -> int:
             )
         bins.append(_Bin((int(bm), int(bn)), data, count))
     c.set_structure_from_device(new_keys, bins, binning=(nb, nsl, shapes))
-    # marketing flops = the dense work performed; the RETURN value is the
-    # true flops of the sparse product (comparable across algorithms,
-    # ref marketing-vs-true `dbcsr_mm.F:664-667`)
-    stats.record_multiply(2 * c.nfullrows * c.nfullcols * a.nfullcols)
-    return _true_product_flops(a, b)
 
 
 def _dense_multiply(a, b, c, alpha, beta) -> int:

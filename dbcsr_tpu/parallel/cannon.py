@@ -84,11 +84,13 @@ def _local_cannon(a_loc, b_loc, s: int, acc_dtype):
     return c_loc
 
 
-def cannon_multiply_dense(mesh: Mesh, a, b):
+def cannon_multiply_dense(mesh: Mesh, a, b, acc_dtype=None):
     """C = A @ B with A (M,K), B (K,N) dense arrays, distributed
     A: P('pr', ('kl','pc')), B: P(('kl','pr'), 'pc'), C: P('pr','pc').
 
-    M, N must divide by s = mesh pr size; K by kl*s.
+    M, N must divide by s = mesh pr size; K by kl*s.  ``acc_dtype``
+    overrides the accumulator dtype (bf16 data accumulates in f32, the
+    acc layer's convention).
     """
     kl = mesh.shape["kl"]
     s = mesh.shape["pr"]
@@ -102,7 +104,9 @@ def cannon_multiply_dense(mesh: Mesh, a, b):
     b = jax.device_put(b, NamedSharding(mesh, P(("kl", "pr"), "pc")))
     fn = jax.jit(
         jax.shard_map(
-            functools.partial(_local_cannon, s=s, acc_dtype=a.dtype),
+            functools.partial(
+                _local_cannon, s=s, acc_dtype=acc_dtype or a.dtype
+            ),
             mesh=mesh,
             in_specs=(P("pr", ("kl", "pc")), P(("kl", "pr"), "pc")),
             out_specs=P("pr", "pc"),

@@ -766,6 +766,22 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         matrix_a, matrix_b, matrix_c
     )
 
+    # ---- dense-mode decision, shared cost model with the single-chip
+    # engine (ref the generic driver's make_dense gate used by EVERY
+    # parallel path, `dbcsr_mm.F:593-617`): high-fill (or emulated-dtype
+    # high-fill) products run as the dense Cannon over the same mesh ----
+    from dbcsr_tpu.mm.multiply import _dense_mode_wanted
+
+    no_limits = all(x is None for x in limits)
+    shell_for_gate = matrix_c if matrix_c is not None else BlockSparseMatrix(
+        name or f"{a.name}*{b.name}", a.row_blk_sizes, b.col_blk_sizes, dtype
+    )
+    if _dense_mode_wanted(a, b, shell_for_gate, filter_eps, retain_sparsity,
+                          no_limits):
+        return _dense_multiply_mesh(
+            alpha, a, b, beta, matrix_c, mesh, name, dtype, s, kl
+        )
+
     r0 = _stack_r0(dtype)
     from dbcsr_tpu.core import stats
 
@@ -884,6 +900,7 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
             (kl - 1) * s * s * cap_c * bm * bn * itemsize,
         )
     out._last_flops = plan.true_flops  # true flop count of this product
+    out._mm_algorithm = "stack"
     return out
 
 
@@ -891,6 +908,68 @@ def _mk_bin(shape, data, count):
     from dbcsr_tpu.core.matrix import _Bin
 
     return _Bin((int(shape[0]), int(shape[1])), data, int(count))
+
+
+def _dense_multiply_mesh(alpha, a, b, beta, matrix_c, mesh, name, dtype,
+                         s, kl) -> BlockSparseMatrix:
+    """Mesh dense mode: densify the operands on device (cached element
+    canvases, no host staging), run the dense 2.5D Cannon over the SAME
+    ('kl','pr','pc') mesh, and carve C back into its full block pattern
+    (`dbcsr_make_dense` + `use_dense_mult`, `dbcsr_mm.F:593-617,770-810`,
+    inside the parallel driver).  GFLOP/s reporting stays honest: the
+    true sparse-product flops are returned, the dense work lands in the
+    marketing counter (`dbcsr_mm.F:664-667`)."""
+    from dbcsr_tpu.core import stats
+    from dbcsr_tpu.core.dist import Distribution, ProcessGrid
+    from dbcsr_tpu.mm.multiply import (
+        _dense_canvas_cached, _to_dense_device, _true_product_flops,
+        carve_full_pattern,
+    )
+    from dbcsr_tpu.parallel.cannon import cannon_multiply_dense
+
+    ad = _dense_canvas_cached(a, lambda: _to_dense_device(a)).astype(dtype)
+    bd = _dense_canvas_cached(b, lambda: _to_dense_device(b)).astype(dtype)
+    m_el, k_el = ad.shape
+    n_el = bd.shape[1]
+    mp = -(-m_el // s) * s
+    np_ = -(-n_el // s) * s
+    kp = -(-k_el // (kl * s)) * (kl * s)
+    if (mp, kp) != (m_el, k_el):
+        ad = jnp.pad(ad, ((0, mp - m_el), (0, kp - k_el)))
+    if (kp, np_) != (k_el, n_el):
+        bd = jnp.pad(bd, ((0, kp - k_el), (0, np_ - n_el)))
+    acc_name = "float32" if np.dtype(dtype).name == "bfloat16" else None
+    cd = cannon_multiply_dense(
+        mesh, ad, bd, acc_dtype=jnp.dtype(acc_name) if acc_name else None
+    )[:m_el, :n_el].astype(dtype)
+    cd = jnp.asarray(alpha, dtype) * cd
+    if beta != 0 and matrix_c is not None and matrix_c.nblks:
+        cd = cd + jnp.asarray(beta, dtype) * _to_dense_device(matrix_c).astype(dtype)
+
+    out_dist = (
+        matrix_c.dist
+        if matrix_c is not None and matrix_c.dist.grid.nprows == s
+        and matrix_c.dist.grid.npcols == s
+        else Distribution(
+            (np.arange(a.nblkrows) % s).astype(np.int32),
+            (np.arange(b.nblkcols) % s).astype(np.int32),
+            ProcessGrid(s, s, mesh),
+        )
+    )
+    out = BlockSparseMatrix(
+        name or (matrix_c.name if matrix_c is not None else f"{a.name}*{b.name}"),
+        a.row_blk_sizes, b.col_blk_sizes, dtype, dist=out_dist,
+    )
+    carve_full_pattern(out, cd)
+    bm = int(a.row_blk_sizes.max()) if a.nblkrows else 1
+    bk = int(a.col_blk_sizes.max()) if a.nblkcols else 1
+    bn = int(b.col_blk_sizes.max()) if b.nblkcols else 1
+    stats.record_stack(bm, bn, bk, a.nblkrows * b.nblkcols * a.nblkcols,
+                       driver="dense")
+    stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
+    out._last_flops = _true_product_flops(a, b)
+    out._mm_algorithm = "dense"
+    return out
 
 
 @functools.partial(

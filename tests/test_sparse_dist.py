@@ -386,6 +386,70 @@ def test_mesh_residency_c_feedback_loop(mesh8):
     clear_mesh_plans()
 
 
+def test_mesh_dense_mode_high_fill_routes_dense(mesh8):
+    """High-fill products on the mesh route through the dense 2.5D
+    Cannon (the parallel-driver make_dense gate, `dbcsr_mm.F:593-617`)
+    and match the stack path exactly in pattern-union terms."""
+    from dbcsr_tpu import set_config
+
+    rbs = [4] * 8
+    a = _rand("A", rbs, rbs, 0.95, 60)
+    b = _rand("B", rbs, rbs, 0.95, 61)
+    c0 = _rand("C", rbs, rbs, 0.3, 62)
+    # occupation >= dense_occ_threshold (0.8) routes dense on any platform
+    c_dense = sparse_multiply_distributed(1.5, a, b, 0.5, c0, mesh8)
+    assert c_dense._mm_algorithm == "dense"
+    set_config(mm_dense=False)
+    try:
+        c_stack = sparse_multiply_distributed(1.5, a, b, 0.5, c0, mesh8)
+    finally:
+        set_config(mm_dense=None)
+    assert c_stack._mm_algorithm == "stack"
+    want = 1.5 * (to_dense(a) @ to_dense(b)) + 0.5 * to_dense(c0)
+    np.testing.assert_allclose(to_dense(c_dense), want, rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(to_dense(c_stack), want, rtol=1e-12, atol=1e-12)
+    # true-flop reporting is algorithm-independent (marketing vs true,
+    # dbcsr_mm.F:664-667)
+    assert c_dense._last_flops == c_stack._last_flops
+
+
+def test_mesh_dense_mode_mixed_blockings(mesh4):
+    """Non-uniform blockings run the general canvas path under the mesh
+    dense Cannon (padded to grid divisibility)."""
+    from dbcsr_tpu import set_config
+
+    rng = np.random.default_rng(63)
+    rbs = list(rng.choice([3, 5], 7))
+    kbs = list(rng.choice([2, 4], 6))
+    cbs = list(rng.choice([3, 6], 5))
+    a = _rand("A", rbs, kbs, 0.9, 64)
+    b = _rand("B", kbs, cbs, 0.9, 65)
+    set_config(mm_dense=True)
+    try:
+        c = sparse_multiply_distributed(-2.0, a, b, 0.0, None, mesh4)
+    finally:
+        set_config(mm_dense=None)
+    assert c._mm_algorithm == "dense"
+    np.testing.assert_allclose(
+        to_dense(c), -2.0 * (to_dense(a) @ to_dense(b)), rtol=1e-12, atol=1e-12
+    )
+
+
+def test_mesh_dense_mode_never_on_filtered_products(mesh4):
+    """filter_eps / retain_sparsity / limits keep the stack path (dense
+    mode must not silently densify a filtered C)."""
+    rbs = [4] * 8
+    a = _rand("A", rbs, rbs, 0.95, 66)
+    b = _rand("B", rbs, rbs, 0.95, 67)
+    c = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh4, filter_eps=1e-8)
+    assert c._mm_algorithm == "stack"
+    c0 = _rand("C", rbs, rbs, 0.3, 68)
+    c2 = sparse_multiply_distributed(
+        1.0, a, b, 1.0, c0, mesh4, retain_sparsity=True
+    )
+    assert c2._mm_algorithm == "stack"
+
+
 def test_sparse_cannon_r_tiled_filtering(mesh8):
     """R-tiled layout + on-the-fly filtering/retain_sparsity agree with
     the single-chip engine."""
