@@ -1038,9 +1038,10 @@ def tas_grouped_multiply(
     mesh: Mesh,
     name: Optional[str] = None,
     filter_eps: Optional[float] = None,
+    nsplit: Optional[int] = None,
 ) -> BlockSparseMatrix:
     """Group-parallel tall-and-skinny multiply: C's (long) row dimension
-    is partitioned over the mesh's 'kl' axis into nsplit = kl groups,
+    is partitioned into ``nsplit`` groups (default: the mesh 'kl' size),
     each group runs an independent s x s sparse Cannon concurrently, and
     the small matrix B is replicated into every group.
 
@@ -1048,19 +1049,25 @@ def tas_grouped_multiply(
     (`dbcsr_tas_mm.F:79-806`, `dbcsr_tas_split.F:304`): the reference
     splits its MPI grid into row groups, replicates the small matrix
     per group (`dbcsr_tas_replicate`) and merges with
-    `redistribute_and_sum` (:783); here the 'kl' mesh axis IS the group
-    axis, replication is an unsharded in_spec, and since row groups are
-    disjoint the merge is a pure collect.  A column-long C is handled
-    by the caller via transposition (C^T row-grouped).
+    `redistribute_and_sum` (:783); here groups map onto the 'kl' mesh
+    axis x in-slot chunks (``nsplit`` need NOT equal the physical kl
+    size, matching the reference's nnz-driven nsplit choice,
+    `dbcsr_tas_split.F:207-304`), replication is an unsharded in_spec,
+    and since row groups are disjoint the merge is a pure collect.
+    Chunks sharing a kl position run inside one device's buffers with
+    per-chunk slot offsets; their Cannons advance in lockstep under the
+    same metronome.  A column-long C is handled by the caller via
+    transposition (C^T row-grouped).
     """
     with timed("tas_grouped_cannon"):
         return _tas_grouped_impl(
-            alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name, filter_eps
+            alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name, filter_eps,
+            nsplit=nsplit,
         )
 
 
 def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
-                      filter_eps):
+                      filter_eps, nsplit=None):
     g, s = mesh.shape["kl"], mesh.shape["pr"]
     if mesh.shape["pc"] != s:
         raise ValueError("grouped Cannon needs a square ('pr','pc') grid")
@@ -1086,25 +1093,35 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     )
 
     # ---- group + in-group maps ----
+    # ngroups honors the COMPUTED nsplit (ref nnz-driven split choice,
+    # `dbcsr_tas_split.F:207-304`), independent of the physical kl size:
+    # group gr lives at kl position gr // q, in-slot chunk gr % q, with
+    # q = ceil(ngroups / kl).  Chunks sharing a kl position occupy
+    # disjoint slot ranges of the same device buffers and their Cannons
+    # advance under one metronome.
+    ngroups = g if nsplit is None else max(int(nsplit), 1)
+    ngroups = min(ngroups, max(a.nblkrows, 1))
+    q = -(-ngroups // g)
     # balance groups by actual per-row work (candidate count), the
     # analog of the reference's nnz-driven split estimation (:1427)
     row_work = np.bincount(rows_t, minlength=a.nblkrows).astype(np.float64) + 1.0
-    row_group = _balanced_groups(row_work, g)
+    row_group = _balanced_groups(row_work, ngroups)
+    row_kl = row_group // q       # physical kl position of a row's group
+    row_ch = row_group % q        # in-slot chunk at that position
     rdist_in = _panel_slots(row_group) % s  # round-robin rows within a group
     cdist = np.arange(b.nblkcols, dtype=np.int64) % s
     k_col = np.arange(a.nblkcols, dtype=np.int64) % s  # no k images: one layer
 
     i_dev = rdist_in[rows_t]
     j_dev = cdist[cols_t]
-    grp = row_group[rows_t]
     kc = k_col[k_t]
     tick_t = (kc - i_dev - j_dev) % s
 
-    # ---- panels ----
+    # ---- panels (capacities are PER GROUP; chunk slots are offset) ----
     ar, ac = a.entry_coords()
     a_panel = (row_group[ar] * s + rdist_in[ar]) * s + k_col[ac]  # (grp, i, kc)
     a_slots = _panel_slots(a_panel)
-    cap_a = max(int(np.bincount(a_panel, minlength=g * s * s).max()), 1) if a.nblks else 1
+    cap_a = max(int(np.bincount(a_panel, minlength=ngroups * s * s).max()), 1) if a.nblks else 1
 
     br, bc = b.entry_coords()
     b_panel = k_col[br] * s + cdist[bc]  # (kr, j) — replicated over groups
@@ -1118,26 +1135,31 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     c_cols = (c_keys % shell_c.nblkcols).astype(np.int64)
     c_panel = (row_group[c_rows] * s + rdist_in[c_rows]) * s + cdist[c_cols]
     c_slots = _panel_slots(c_panel)
-    cap_c = max(int(np.bincount(c_panel, minlength=g * s * s).max()), 1) if len(c_keys) else 1
+    cap_c = max(int(np.bincount(c_panel, minlength=ngroups * s * s).max()), 1) if len(c_keys) else 1
 
-    # ---- per-(group, device, tick) stacks ----
+    # ---- per-(kl, device, tick) stacks; chunk offsets in the slots ----
     ent_c = np.searchsorted(c_keys, rows_t * shell_c.nblkcols + cols_t)
-    group_id = (((grp * s + i_dev) * s + j_dev) * s) + tick_t
+    grp_kl = row_kl[rows_t]
+    grp_ch = row_ch[rows_t]
+    group_id = (((grp_kl * s + i_dev) * s + j_dev) * s) + tick_t
     r0 = _stack_r0(dtype)
+    st_a = (row_ch[ar][a_ent] * cap_a + a_slots[a_ent]).astype(np.int64)
+    st_c = (grp_ch * cap_c + c_slots[ent_c]).astype(np.int64)
     stacks = _fill_stacks(
-        group_id, a_slots[a_ent], b_slots[b_ent], c_slots[ent_c],
-        g * s * s * s, cap_c, r0=r0, pad_a=cap_a, pad_b=cap_b,
+        group_id, st_a, b_slots[b_ent], st_c,
+        g * s * s * s, q * cap_c, r0=r0, pad_a=q * cap_a, pad_b=cap_b,
     )
     stacks = stacks.reshape(g, s, s, s, -1, stacks.shape[-1])
 
     # ---- panel data at skewed start positions ----
-    # r0-tiled stacks reference a guaranteed-zero pad row at cap_a/cap_b
+    # r0-tiled stacks reference a guaranteed-zero pad row at the end of
+    # the chunked buffer (q*cap_a) / the replicated buffer (cap_b)
     xtr = 1 if r0 else 0
     a_host = _dense_blocks_host(a, bm, bk)
-    a_panels = np.zeros((g, s, s, cap_a + xtr, bm, bk), dtype)
+    a_panels = np.zeros((g, s, s, q * cap_a + xtr, bm, bk), dtype)
     agr, ai_, akc = a_panel // (s * s), (a_panel // s) % s, a_panel % s
     aj0 = (akc - ai_) % s
-    a_panels[agr, ai_, aj0, a_slots] = a_host
+    a_panels[agr // q, ai_, aj0, (agr % q) * cap_a + a_slots] = a_host
 
     b_host = _dense_blocks_host(b, bk, bn)
     b_panels = np.zeros((s, s, cap_b + xtr, bk, bn), dtype)
@@ -1145,13 +1167,14 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     bi0 = (bkr - bj) % s
     b_panels[bi0, bj, b_slots] = b_host
 
-    c_init = np.zeros((g, s, s, cap_c, bm, bn), dtype)
+    c_init = np.zeros((g, s, s, q * cap_c, bm, bn), dtype)
     if matrix_c is not None and matrix_c.nblks and beta != 0:
         c_host = _dense_blocks_host(matrix_c, bm, bn)
         pos_old = np.searchsorted(c_keys, old_keys)
         c_init[
-            row_group[c_rows[pos_old]], rdist_in[c_rows[pos_old]],
-            cdist[c_cols[pos_old]], c_slots[pos_old],
+            row_kl[c_rows[pos_old]], rdist_in[c_rows[pos_old]],
+            cdist[c_cols[pos_old]],
+            row_ch[c_rows[pos_old]] * cap_c + c_slots[pos_old],
         ] = c_host
 
     dev = lambda arr, spec: jax.device_put(arr, NamedSharding(mesh, spec))
@@ -1162,7 +1185,7 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         dev(stacks, P("kl", "pr", "pc")),
         dev(c_init, P("kl", "pr", "pc")),
         jnp.asarray(alpha, dtype), jnp.asarray(beta, dtype),
-        s=s, cap_c=cap_c, acc_name=acc_name,
+        s=s, cap_c=q * cap_c, acc_name=acc_name,
         mesh_ref=_HashableMesh(mesh), r0=r0,
     )
 
@@ -1175,8 +1198,10 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     )
     _adopt_panels(
         out, c_keys,
-        c_np[row_group[c_rows], rdist_in[c_rows], cdist[c_cols], c_slots],
+        c_np[row_kl[c_rows], rdist_in[c_rows], cdist[c_cols],
+             row_ch[c_rows] * cap_c + c_slots],
     )
+    out._tas_ngroups = int(row_group.max()) + 1 if len(row_group) else 0
     if filter_eps is not None:
         from dbcsr_tpu.ops.operations import filter_matrix
 
@@ -1195,7 +1220,7 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         # the long C, sparse_multiply_distributed's 'psum' record)
         stats.record_comm(
             "ppermute", 2 * s * ndev,
-            s * ndev * (cap_a * bm * bk + cap_b * bk * bn) * itemsize,
+            s * ndev * (q * cap_a * bm * bk + cap_b * bk * bn) * itemsize,
         )
     stats.record_comm(
         "host2dev", 4,

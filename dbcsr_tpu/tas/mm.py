@@ -187,14 +187,14 @@ def _tas_multiply_mesh(transa, transb, alpha, a, b, beta, c, filter_eps,
     if grouped and long_dim == "m":
         acc = tas_grouped_multiply(
             alpha, a_op, b_op, beta, c, mesh, name=c.name,
-            filter_eps=filter_eps,
+            filter_eps=filter_eps, nsplit=nsplit,
         )
     elif grouped:
         # column-long C: C^T = op(B)^T op(A)^T is row-long, group its rows
         acc_t = tas_grouped_multiply(
             alpha, new_transposed(b_op), new_transposed(a_op), beta,
             new_transposed(c), mesh, name=c.name + "^T",
-            filter_eps=filter_eps,
+            filter_eps=filter_eps, nsplit=nsplit,
         )
         flops_t = getattr(acc_t, "_last_flops", 0)
         acc = new_transposed(acc_t)

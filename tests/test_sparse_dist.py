@@ -242,6 +242,52 @@ def test_tas_grouped_multiply_tall_matrix(mesh8):
     assert grp_bytes < ungrp_bytes, (grp_bytes, ungrp_bytes)
 
 
+def test_tas_grouped_nsplit_decoupled_from_kl(mesh8):
+    """nsplit=8 on a kl=2 mesh runs 8 distinct groups (kl position x
+    in-slot chunk) and matches the oracle exactly — the computed nsplit
+    is honored independent of the physical grid
+    (ref `dbcsr_tas_split.F:207-304`)."""
+    from dbcsr_tpu.parallel import tas_grouped_multiply
+
+    assert mesh8.shape["kl"] == 2
+    rbs = [4] * 64
+    kbs = [4] * 5
+    a = _rand("A", rbs, kbs, 0.35, 70)
+    b = _rand("B", kbs, kbs, 0.7, 71)
+    want = to_dense(a) @ to_dense(b)
+    for nsplit in (1, 2, 3, 8):
+        c = tas_grouped_multiply(1.0, a, b, 0.0, None, mesh8, nsplit=nsplit)
+        assert c._tas_ngroups == nsplit, (nsplit, c._tas_ngroups)
+        np.testing.assert_allclose(to_dense(c), want, rtol=1e-12, atol=1e-12)
+    # beta-accumulate through the chunked layout too
+    c0 = _rand("C", rbs, kbs, 0.2, 72)
+    c = tas_grouped_multiply(2.0, a, b, 0.5, c0, mesh8, nsplit=8)
+    np.testing.assert_allclose(
+        to_dense(c), 2.0 * want + 0.5 * to_dense(c0), rtol=1e-12, atol=1e-12
+    )
+
+
+def test_tas_grouped_nsplit_r_tiled(mesh8):
+    """Chunked groups compose with the R-tiled stack layout (slot
+    offsets + the guaranteed-zero pad row at the chunked buffer end)."""
+    from dbcsr_tpu import set_config
+    from dbcsr_tpu.parallel import tas_grouped_multiply
+
+    rbs = [3, 5] * 16
+    kbs = [4] * 4
+    a = _rand("A", rbs, kbs, 0.4, 73)
+    b = _rand("B", kbs, kbs, 0.8, 74)
+    set_config(mm_driver="xla_group")
+    try:
+        c = tas_grouped_multiply(1.0, a, b, 0.0, None, mesh8, nsplit=6)
+    finally:
+        set_config(mm_driver="auto")
+    assert c._tas_ngroups == 6
+    np.testing.assert_allclose(
+        to_dense(c), to_dense(a) @ to_dense(b), rtol=1e-12, atol=1e-12
+    )
+
+
 def test_tas_grouped_beta_accumulate(mesh8):
     from dbcsr_tpu.parallel import tas_grouped_multiply
 
