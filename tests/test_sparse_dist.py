@@ -432,6 +432,67 @@ def test_mesh_residency_c_feedback_loop(mesh8):
     clear_mesh_plans()
 
 
+def test_sparse_cannon_complex128(mesh8):
+    """c128 with complex alpha/beta through the mesh Cannon (CPU
+    backend; the chip rejects C128) vs the dense oracle, incl. a
+    Hermitian operand (ref `dbcsr_unittest1.F` complex type coverage)."""
+    rbs = [3, 4] * 5
+    rng = np.random.default_rng(80)
+    a = make_random_matrix("A", rbs, rbs, dtype=np.complex128,
+                           occupation=0.4, rng=rng)
+    b = make_random_matrix("B", rbs, rbs, dtype=np.complex128,
+                           occupation=0.4, rng=rng, matrix_type="H")
+    c0 = make_random_matrix("C", rbs, rbs, dtype=np.complex128,
+                            occupation=0.3, rng=rng)
+    alpha, beta = 1.5 - 0.5j, 0.25 + 1.0j
+    c = sparse_multiply_distributed(alpha, a, b, beta, c0, mesh8)
+    want = alpha * (to_dense(a) @ to_dense(b)) + beta * to_dense(c0)
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-12, atol=1e-12)
+    # determinism with complex data
+    c2 = sparse_multiply_distributed(alpha, a, b, beta, c0, mesh8)
+    assert checksum(c) == checksum(c2)
+
+
+def test_sparse_cannon_complex128_r_tiled(mesh8):
+    """c128 through the R-tiled (r0) mesh layout — mm_driver='xla_group'
+    forces on CPU the layout auto mode would pick for c128 on TPU
+    (`_stack_r0`); previously untested on any backend with complex
+    data."""
+    from dbcsr_tpu import set_config
+
+    rbs = [3, 5, 4] * 3
+    rng = np.random.default_rng(81)
+    a = make_random_matrix("A", rbs, rbs, dtype=np.complex128,
+                           occupation=0.45, rng=rng)
+    b = make_random_matrix("B", rbs, rbs, dtype=np.complex128,
+                           occupation=0.45, rng=rng)
+    c0 = make_random_matrix("C", rbs, rbs, dtype=np.complex128,
+                            occupation=0.3, rng=rng)
+    alpha, beta = -0.5 + 2.0j, 0.5 - 0.25j
+    set_config(mm_driver="xla_group")
+    try:
+        c_tiled = sparse_multiply_distributed(alpha, a, b, beta, c0, mesh8)
+        cs = checksum(c_tiled)
+        c_rep = sparse_multiply_distributed(alpha, a, b, beta, c0, mesh8)
+        assert checksum(c_rep) == cs  # bit-identical repeats
+    finally:
+        set_config(mm_driver="auto")
+    want = alpha * (to_dense(a) @ to_dense(b)) + beta * to_dense(c0)
+    np.testing.assert_allclose(to_dense(c_tiled), want, rtol=1e-12, atol=1e-12)
+    # grouped TAS with complex + r0 as well
+    from dbcsr_tpu.parallel import tas_grouped_multiply
+
+    set_config(mm_driver="xla_group")
+    try:
+        c_grp = tas_grouped_multiply(alpha, a, b, 0.0, None, mesh8, nsplit=4)
+    finally:
+        set_config(mm_driver="auto")
+    np.testing.assert_allclose(
+        to_dense(c_grp), alpha * (to_dense(a) @ to_dense(b)),
+        rtol=1e-12, atol=1e-12,
+    )
+
+
 def test_mesh_dense_mode_high_fill_routes_dense(mesh8):
     """High-fill products on the mesh route through the dense 2.5D
     Cannon (the parallel-driver make_dense gate, `dbcsr_mm.F:593-617`)
