@@ -66,6 +66,51 @@ def record_multiply(marketing_flops: int) -> None:
     _totals["marketing_flops"] += marketing_flops
 
 
+# memory high-water meter (analog of `m_memory`, `dbcsr_machine.F`, and
+# the `max_memory` line `dbcsr_lib.F:326` prints): host side reads the
+# OS-tracked process peak (VmHWM) and current RSS; device side polls the
+# PJRT client's allocator stats where the backend provides them (TPU
+# does; the CPU backend usually returns nothing).
+_memory = {"host_peak": 0, "host_current": 0, "device_peak": 0,
+           "device_in_use": 0}
+
+
+def sample_memory() -> None:
+    """Update the high-water meters; called at the end of every multiply
+    (cheap: one /proc read + one local allocator-stats call)."""
+    from dbcsr_tpu.core.config import get_config
+
+    if not get_config().keep_stats:
+        return
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    _memory["host_peak"] = int(line.split()[1]) * 1024
+                elif line.startswith("VmRSS:"):
+                    _memory["host_current"] = int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import jax
+
+        ms = jax.devices()[0].memory_stats()
+        if ms:
+            in_use = int(ms.get("bytes_in_use", 0))
+            _memory["device_in_use"] = in_use
+            _memory["device_peak"] = max(
+                _memory["device_peak"],
+                int(ms.get("peak_bytes_in_use", in_use)),
+            )
+    except Exception:  # backend without allocator stats / remote hiccup
+        pass
+
+
+def memory_high_water() -> dict:
+    """Current meter values (bytes); see `sample_memory`."""
+    return dict(_memory)
+
+
 def total_flops() -> int:
     return sum(s.flops for s in _by_mnk.values())
 
@@ -75,6 +120,10 @@ def reset() -> None:
     _comm.clear()
     for k in _totals:
         _totals[k] = 0
+    for k in _memory:
+        # host peaks re-read the (monotone) OS VmHWM at the next sample;
+        # the device peak restarts from the next observation
+        _memory[k] = 0
 
 
 def print_statistics(out=print) -> None:
@@ -101,4 +150,12 @@ def print_statistics(out=print) -> None:
         out(f" {'collective':>24} {'messages':>14} {'MB':>12}")
         for kind, st in sorted(_comm.items()):
             out(f" {kind:>24} {st.nmessages:>14} {st.nbytes / 1e6:>12.2f}")
+    if _memory["host_peak"]:
+        # ref the `max_memory` line of the lib print (`dbcsr_lib.F:326`)
+        out(" -" + "MEMORY USAGE".center(68) + "-")
+        out(f" {'host peak (VmHWM)':>24} {_memory['host_peak'] / 1e6:>14.1f} MB")
+        out(f" {'host current (VmRSS)':>24} {_memory['host_current'] / 1e6:>14.1f} MB")
+        if _memory["device_peak"]:
+            out(f" {'device peak':>24} {_memory['device_peak'] / 1e6:>14.1f} MB")
+            out(f" {'device in use':>24} {_memory['device_in_use'] / 1e6:>14.1f} MB")
     out(" " + "-" * 70)

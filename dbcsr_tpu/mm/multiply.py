@@ -237,6 +237,7 @@ def multiply(
 
         mflops = 2 * c.nfullrows * c.nfullcols * a.nfullcols
         stats.record_multiply(mflops)
+        stats.sample_memory()
         return int(flops)
 
 

@@ -882,6 +882,7 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
 
     stats.record_stack(bm, bn, bk, plan.n_cand, driver="mesh")
     stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
+    stats.sample_memory()
     # collective-traffic accounting (ref count_mpi_statistics,
     # dbcsr_mm_common.F:135): each tick ppermutes every device's A and B
     # panel; the layer reduction psums each device's C panel
@@ -967,6 +968,7 @@ def _dense_multiply_mesh(alpha, a, b, beta, matrix_c, mesh, name, dtype,
     stats.record_stack(bm, bn, bk, a.nblkrows * b.nblkcols * a.nblkcols,
                        driver="dense")
     stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
+    stats.sample_memory()
     out._last_flops = _true_product_flops(a, b)
     out._mm_algorithm = "dense"
     return out
@@ -1211,6 +1213,7 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
 
     stats.record_stack(bm, bn, bk, len(rows_t), driver="mesh")
     stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
+    stats.sample_memory()
     ndev = g * s * s
     itemsize = dtype.itemsize
     if s > 1:

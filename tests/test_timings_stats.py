@@ -96,3 +96,29 @@ def test_stats_driver_breakdown():
     stats.print_statistics(out=lines.append)
     text = "\n".join(lines)
     assert "xla_group=" in text
+
+
+def test_memory_high_water_sampled_and_printed():
+    """A multiply samples the memory meters (m_memory analog,
+    dbcsr_machine.F) and print_statistics shows the max_memory block
+    (dbcsr_lib.F:326)."""
+    import numpy as np
+
+    from dbcsr_tpu import create, make_random_matrix, multiply
+
+    stats.reset()
+    rbs = [4] * 6
+    rng = np.random.default_rng(0)
+    a = make_random_matrix("A", rbs, rbs, occupation=0.5, rng=rng)
+    b = make_random_matrix("B", rbs, rbs, occupation=0.5, rng=rng)
+    c = create("C", rbs, rbs)
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    hw = stats.memory_high_water()
+    assert hw["host_peak"] > 0  # VmHWM read succeeded
+    assert hw["host_current"] > 0
+    lines = []
+    stats.print_statistics(out=lines.append)
+    text = "\n".join(lines)
+    assert "MEMORY USAGE" in text and "host peak" in text
+    stats.reset()
+    assert stats.memory_high_water()["host_peak"] == 0
