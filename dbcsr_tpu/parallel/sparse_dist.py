@@ -337,7 +337,9 @@ def _run_sparse_cannon(a_panels, b_panels, stacks, c_init, alpha, beta_fac,
         b = b_p.reshape(b_p.shape[3:])
         st = st.reshape(st.shape[3:])  # (s, s_cap, 3) or (s, G_cap, 2*r0+1)
         c_in = c_in.reshape(c_in.shape[2:])  # (cap_c, bm, bn)
-        fac = beta_fac.reshape(beta_fac.shape[2:])[:, None, None]  # (cap_c,1,1)
+        fac = beta_fac.reshape(beta_fac.shape[2:])  # (cap_c,) or (cap_c,bm,bn)
+        if fac.ndim == 1:
+            fac = fac[:, None, None]
         c = _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=r0)
         c = jax.lax.psum(c, "kl")
         c = (alpha * c + fac * c_in.astype(acc_dtype)).astype(c_in.dtype)
@@ -372,6 +374,7 @@ def sparse_multiply_distributed(
     first_row=None, last_row=None,
     first_col=None, last_col=None,
     first_k=None, last_k=None,
+    element_limits=None,
 ) -> BlockSparseMatrix:
     """C = alpha*A@B + beta*C on the mesh with block-sparse panels.
 
@@ -390,6 +393,7 @@ def sparse_multiply_distributed(
             alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
             (first_row, last_row, first_col, last_col, first_k, last_k),
             retain_sparsity=retain_sparsity, filter_eps=filter_eps,
+            element_limits=element_limits,
         )
 
 
@@ -590,7 +594,8 @@ def _cached_panels(plan: _MeshPlan, which: str, m: BlockSparseMatrix,
 
 
 def _build_mesh_plan(a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
-                     limits, retain_sparsity, filter_eps) -> _MeshPlan:
+                     limits, retain_sparsity, filter_eps,
+                     beta_window=None) -> _MeshPlan:
     """The host-side half of a mesh multiply: symbolic product, device
     and tick assignment, stack fill, panel/collect index maps — all of
     it pattern-determined and device-uploaded exactly once."""
@@ -695,7 +700,31 @@ def _build_mesh_plan(a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
             inside &= c_cols <= lc_l
     inside_dev = None
     inside_bytes = 0
-    if has_window and not inside.all():
+    if beta_window is not None:
+        # ELEMENT-granular beta window (unaligned limits): straddling C
+        # blocks get a per-element factor mask — beta inside the window,
+        # 1 outside — the mesh analog of the windowed-beta scatter
+        # (`_scatter_scaled_window`, ref `dbcsr_test_multiply.F:631-633`)
+        fr_e, lr_e, fc_e, lc_e = beta_window
+        roff = np.concatenate([[0], np.cumsum(a.row_blk_sizes)]).astype(np.int64)
+        coff = np.concatenate([[0], np.cumsum(b.col_blk_sizes)]).astype(np.int64)
+        lo_r = np.clip(fr_e - roff[c_rows], 0, bm)
+        hi_r = np.clip(lr_e - roff[c_rows] + 1, 0, bm)
+        lo_c = np.clip(fc_e - coff[c_cols], 0, bn)
+        hi_c = np.clip(lc_e - coff[c_cols] + 1, 0, bn)
+        ri = np.arange(bm)[None, :]
+        ci = np.arange(bn)[None, :]
+        mrow = (ri >= lo_r[:, None]) & (ri < hi_r[:, None])
+        mcol = (ci >= lo_c[:, None]) & (ci < hi_c[:, None])
+        canvas = np.ones((s, s, cap_c, bm, bn), bool)
+        canvas[rdist[c_rows], cdist[c_cols], c_slots] = (
+            mrow[:, :, None] & mcol[:, None, :]
+        )
+        inside_dev = jax.device_put(canvas, NamedSharding(mesh, P("pr", "pc")))
+        inside_bytes = canvas.nbytes
+        has_window = True
+        inside = np.zeros(1, bool)  # keep_old must stay on
+    elif has_window and not inside.all():
         canvas = np.ones((s, s, cap_c), bool)
         canvas[rdist[c_rows], cdist[c_cols], c_slots] = inside
         inside_dev = jax.device_put(canvas, NamedSharding(mesh, P("pr", "pc")))
@@ -757,7 +786,7 @@ def _build_mesh_plan(a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
 
 def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
                           limits=(None,) * 6, retain_sparsity=False,
-                          filter_eps=None):
+                          filter_eps=None, element_limits=None):
     kl, s = mesh.shape["kl"], mesh.shape["pr"]
     if mesh.shape["pc"] != s:
         raise ValueError("sparse Cannon needs a square ('pr','pc') grid")
@@ -765,6 +794,24 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     a, b, matrix_c, dtype, bm, bk, bn = _prepare_operands(
         matrix_a, matrix_b, matrix_c
     )
+    beta_window = None
+    if element_limits is not None:
+        # exact element-granular limits (ref `dbcsr_crop_matrix` inside
+        # make_m2s, `dbcsr_mm_cannon.F:194-220`): crop op(A)/op(B) at
+        # element level, reduce to block limits, and remember the
+        # element window for windowed beta on straddling C blocks —
+        # the same helper the single-chip engine uses
+        if any(x is not None for x in limits):
+            raise ValueError("give block-index OR element limits, not both")
+        from dbcsr_tpu.mm.multiply import _apply_element_limits
+
+        shell = matrix_c if matrix_c is not None else BlockSparseMatrix(
+            name or f"{a.name}*{b.name}", a.row_blk_sizes, b.col_blk_sizes,
+            dtype,
+        )
+        a, b, limits, beta_window = _apply_element_limits(
+            a, b, shell, element_limits
+        )
 
     # ---- dense-mode decision, shared cost model with the single-chip
     # engine (ref the generic driver's make_dense gate used by EVERY
@@ -796,7 +843,7 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
             matrix_c.pattern_fingerprint() if matrix_c is not None else None,
             a.dist.fingerprint(), b.dist.fingerprint(),
             matrix_c.dist.fingerprint() if matrix_c is not None else None,
-            np.dtype(dtype).name, retain_sparsity, limits,
+            np.dtype(dtype).name, retain_sparsity, limits, beta_window,
             _HashableMesh(mesh), r0,
         )
         plan = _mesh_plan_cache.get(plan_key)
@@ -806,7 +853,7 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         with timed("mesh_plan_build"):
             plan = _build_mesh_plan(
                 a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
-                limits, retain_sparsity, filter_eps,
+                limits, retain_sparsity, filter_eps, beta_window,
             )
         if plan_key is not None:
             _mesh_plan_insert(plan_key, plan)

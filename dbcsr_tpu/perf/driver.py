@@ -139,28 +139,6 @@ def expand_block_sizes(total: int, pattern: List[Tuple[int, int]]) -> np.ndarray
     return np.asarray(sizes, np.int32)
 
 
-def _block_aligned_limits(el, moff, noff, koff):
-    """Element -> block limits for the mesh engine, which takes block
-    granularity; unaligned limits are rejected rather than silently
-    widened."""
-    out = []
-    for (lo, hi), off in zip(((el[0], el[1]), (el[2], el[3]), (el[4], el[5])),
-                             (moff, noff, koff)):
-        if lo is None and hi is None:
-            out.extend((None, None))
-            continue
-        lo = 0 if lo is None else lo
-        hi = int(off[-1]) - 1 if hi is None else hi
-        b0 = int(np.searchsorted(off, lo, side="right") - 1)
-        b1 = int(np.searchsorted(off, hi, side="right") - 1)
-        if off[b0] != lo or off[b1 + 1] - 1 != hi:
-            raise NotImplementedError(
-                "the mesh driver supports block-aligned limits only"
-            )
-        out.extend((b0, b1))
-    return tuple(out)
-
-
 def _element_limits(lim_lo, lim_hi) -> Tuple[Optional[int], Optional[int]]:
     """1-based .perf limits (0 = open) -> 0-based inclusive element
     limits for `multiply(element_limits=...)` (exact, incl. limits that
@@ -229,9 +207,6 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
                            occupation=1.0 - cfg.sparsity_c,
                            matrix_type=cfg.symm_c, rng=rng)
 
-    moff = np.concatenate([[0], np.cumsum(m_sizes)])
-    noff = np.concatenate([[0], np.cumsum(n_sizes)])
-    koff = np.concatenate([[0], np.cumsum(k_sizes)])
     el = (*_element_limits(cfg.limits[0], cfg.limits[1]),
           *_element_limits(cfg.limits[2], cfg.limits[3]),
           *_element_limits(cfg.limits[4], cfg.limits[5]))
@@ -270,13 +245,10 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
                 a_eff, b_eff = _op(a, cfg.transa), _op(b, cfg.transb)
             else:
                 a_eff, b_eff = a, b
-            blk = _block_aligned_limits(el, moff, noff, koff) if has_limits \
-                else (None,) * 6
             c_run = sparse_multiply_distributed(
                 cfg.alpha, a_eff, b_eff, cfg.beta, c_run, mesh,
                 retain_sparsity=cfg.retain_sparsity,
-                first_row=blk[0], last_row=blk[1], first_col=blk[2],
-                last_col=blk[3], first_k=blk[4], last_k=blk[5],
+                element_limits=el if has_limits else None,
             )
             flops = int(getattr(c_run, "_last_flops", 0))
         else:

@@ -106,3 +106,21 @@ def test_transpose_config_on_mesh():
     cfg.npcols = 2
     res = run_perf(cfg, verbose=False, n_devices=4)
     assert res["grid"] == {"kl": 1, "pr": 2, "pc": 2}
+
+
+def test_unaligned_limits_on_mesh_match_single_chip():
+    """Deliberately block-UNaligned element limits through the mesh
+    driver (previously a NotImplementedError): exact via the engine's
+    element_limits path (ref `dbcsr_crop_matrix`,
+    `dbcsr_mm_cannon.F:194-220`)."""
+    import numpy as np
+
+    cfg = parse_perf_file(os.path.join(INPUTS, "test_square_sparse.perf"))
+    cfg.nrep = 1
+    cfg.limits = (3, 742, 7, 638, 2, 529)  # 1-based, not multiples of 5
+    cfg.check = False  # file refs are for the unlimited product
+    r1 = run_perf(cfg, verbose=False, n_devices=1)
+    cfg.npcols = 2
+    r4 = run_perf(cfg, verbose=False, n_devices=4)
+    assert np.isclose(r1["checksum"], r4["checksum"], rtol=1e-10)
+    assert r1["flops"] == r4["flops"]  # same true flop count both paths

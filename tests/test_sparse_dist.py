@@ -361,6 +361,52 @@ def test_sparse_cannon_r_tiled_stacks(mesh8):
     np.testing.assert_allclose(to_dense(c_plain), want, rtol=1e-12, atol=1e-12)
 
 
+def test_mesh_element_limits_unaligned_match_single_chip(mesh4):
+    """Element-granular limits that do NOT align with block boundaries
+    are exact on the mesh path (crop + elementwise windowed beta, ref
+    `dbcsr_crop_matrix` inside make_m2s, `dbcsr_mm_cannon.F:194-220`),
+    matching the single-chip engine bit-for-bit in pattern and to
+    rounding in values."""
+    from dbcsr_tpu import multiply
+
+    rbs = [3, 5, 4, 6] * 2  # 36 elements, uneven boundaries
+    a = _rand("A", rbs, rbs, 0.6, 90)
+    b = _rand("B", rbs, rbs, 0.6, 91)
+    c0 = _rand("C", rbs, rbs, 0.4, 92)
+    el = (2, 31, 4, 33, 1, 30)  # 0-based inclusive, straddles blocks
+    c_mesh = sparse_multiply_distributed(
+        1.5, a, b, 0.5, c0, mesh4, element_limits=el
+    )
+    c_host = c0.copy()
+    multiply("N", "N", 1.5, a, b, 0.5, c_host, element_limits=el)
+    np.testing.assert_allclose(
+        to_dense(c_mesh), to_dense(c_host), rtol=1e-12, atol=1e-12
+    )
+    # repeats are bit-identical (plan + elementwise window cached)
+    c_rep = sparse_multiply_distributed(
+        1.5, a, b, 0.5, c0, mesh4, element_limits=el
+    )
+    assert checksum(c_rep) == checksum(c_mesh)
+
+
+def test_mesh_element_limits_k_window(mesh4):
+    """A k-only element window (crops both operands, no beta window)."""
+    from dbcsr_tpu import multiply
+
+    rbs = [4, 3, 5] * 3
+    a = _rand("A", rbs, rbs, 0.5, 93)
+    b = _rand("B", rbs, rbs, 0.5, 94)
+    el = (None, None, None, None, 2, 26)
+    c_mesh = sparse_multiply_distributed(
+        1.0, a, b, 0.0, None, mesh4, element_limits=el
+    )
+    c_host = _rand("Ch", rbs, rbs, 0.0, 95)
+    multiply("N", "N", 1.0, a, b, 0.0, c_host, element_limits=el)
+    np.testing.assert_allclose(
+        to_dense(c_mesh), to_dense(c_host), rtol=1e-12, atol=1e-12
+    )
+
+
 def test_mesh_residency_no_restaging(mesh8):
     """A second same-pattern mesh multiply must upload NOTHING: the plan
     (stacks + index maps) is pattern-cached and the panels are cached by
