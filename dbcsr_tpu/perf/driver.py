@@ -182,12 +182,14 @@ def _mesh_for(cfg: PerfConfig, n_devices: int):
 
 
 def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
-             n_devices: Optional[int] = None):
+             n_devices: Optional[int] = None, mesh=None):
     """Run the configured multiply nrep times; returns a result dict
     (ref `perf_multiply`, `dbcsr_performance_multiply.F:452-515`).
 
     ``n_devices`` > 1 (or npcols > 1 in the input) runs on the device
     mesh via the distributed sparse Cannon; default is single-chip.
+    ``mesh`` overrides the grid entirely (the multi-process mode passes
+    the jax.distributed world mesh).
     """
     dtype = dtype_of(cfg.data_type)
     rng = np.random.default_rng(seed)
@@ -214,7 +216,8 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
 
     if n_devices is None:
         n_devices = int(os.environ.get("DBCSR_TPU_PERF_DEVICES", "1"))
-    mesh = _mesh_for(cfg, n_devices)
+    if mesh is None:
+        mesh = _mesh_for(cfg, n_devices)
 
     chksum_a = matrix_checksum(a)
     chksum_b = matrix_checksum(b)
@@ -338,15 +341,153 @@ def _force_completion(matrix: BlockSparseMatrix) -> float:
     return total
 
 
+def _mp_worker(cfg_path: str, port: int, nproc: int, pid: int,
+               ndev: int, nrep: int) -> int:
+    """One rank of the multi-process driver world (internal; spawned by
+    `run_perf_multiproc`).  Joins the `jax.distributed` world, builds
+    the multihost ('kl','pr','pc') mesh, runs the config over it, and
+    emits an MPRESULT line for the parent to aggregate — each rank of
+    the reference driver is an MPI process doing exactly this
+    (`dbcsr_performance_driver.F:47-56`)."""
+    import json
+
+    jax.config.update(
+        "jax_platforms", os.environ.get("DBCSR_TPU_MP_PLATFORM", "cpu")
+    )
+    from dbcsr_tpu.parallel import multihost
+
+    ok = multihost.init_multihost(f"localhost:{port}", nproc, pid)
+    if not ok:
+        print("MPERROR world join failed")
+        return 1
+    mesh = multihost.make_multihost_grid()
+    cfg = parse_perf_file(cfg_path)
+    if nrep:
+        cfg.nrep = nrep
+    try:
+        res = run_perf(cfg, verbose=(pid == 0), mesh=mesh)
+    except PerfChecksumError as exc:
+        print(f"MPERROR {exc}")
+        return 1
+    print("MPRESULT " + json.dumps({
+        "pid": pid, "checksum": res["checksum"],
+        "checksum_pos": res["checksum_pos"],
+        "flops": res["flops"], "gflops_mean": res["gflops_mean"],
+        "time_best_s": min(res["times_s"]),
+    }))
+    multihost.shutdown_multihost()
+    return 0
+
+
+def run_perf_multiproc(cfg_path: str, nproc: int, devices_per_proc: int = 4,
+                       nrep: Optional[int] = None, timeout: float = 600,
+                       verbose: bool = True) -> dict:
+    """Spawn an ``nproc``-process `jax.distributed` world running the
+    config over the combined multihost mesh (the mpiexec-driven
+    reference driver, `dbcsr_performance_driver.F:47-56`).  Returns the
+    rank-aggregated result and verifies every rank computed the
+    identical checksum (cross-rank determinism, the `dbcsr_checksum`
+    contract)."""
+    import json
+    import socket
+    import subprocess
+
+    def _spawn():
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = dict(
+            os.environ,
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={devices_per_proc}",
+        )
+        env.pop("JAX_PLATFORMS", None)  # the worker sets the platform
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "dbcsr_tpu.perf.driver", cfg_path,
+                 "--worker", str(port), str(nproc), str(i),
+                 str(devices_per_proc), str(nrep or 0)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                env=env,
+            )
+            for i in range(nproc)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                outs.append(p.communicate(timeout=timeout)[0])
+        except subprocess.TimeoutExpired:
+            outs = None  # port race / hung join: retry with a new port
+        finally:
+            for p in procs:
+                p.kill()
+            for p in procs:
+                try:
+                    p.communicate(timeout=10)
+                except Exception:
+                    pass
+        return procs, outs
+
+    procs, outs = _spawn()
+    if outs is None:
+        procs, outs = _spawn()
+    if outs is None:
+        raise RuntimeError(f"{nproc}-process world never formed (twice)")
+    results = []
+    for i, (p, o) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise RuntimeError(f"rank {i} failed:\n{o[-3000:]}")
+        for line in o.splitlines():
+            if line.startswith("MPRESULT "):
+                results.append(json.loads(line[len("MPRESULT "):]))
+    if len(results) != nproc:
+        raise RuntimeError(f"got {len(results)}/{nproc} rank results:\n"
+                           + "\n".join(o[-800:] for o in outs))
+    checksums = {r["checksum"] for r in results}
+    if len(checksums) != 1:
+        raise RuntimeError(f"rank checksums differ: {sorted(checksums)}")
+    flops = results[0]["flops"]
+    t_max = max(r["time_best_s"] for r in results)
+    agg = {
+        "nproc": nproc,
+        "checksum": results[0]["checksum"],
+        "flops": flops,
+        # conservative world rate: slowest rank's best repeat
+        "gflops_world": flops / t_max / 1e9 if t_max > 0 else 0.0,
+        "gflops_mean_ranks": float(
+            np.mean([r["gflops_mean"] for r in results])
+        ),
+        "per_rank": results,
+    }
+    if verbose:
+        print(f" {nproc}-process world: {agg['gflops_world']:.3f} GFLOP/s "
+              f"(slowest-rank best), checksum {agg['checksum']:.9e} "
+              f"identical on all ranks")
+    return agg
+
+
 def main(argv=None):
-    argv = argv if argv is not None else sys.argv[1:]
+    argv = list(argv if argv is not None else sys.argv[1:])
     if not argv:
         print(__doc__)
         return 1
+    if "--worker" in argv:
+        i = argv.index("--worker")
+        cfg_path = argv[0]
+        port, nproc, pid, ndev, nrep = (int(x) for x in argv[i + 1: i + 6])
+        return _mp_worker(cfg_path, port, nproc, pid, ndev, nrep)
+    nproc = None
+    if "--nproc" in argv:
+        i = argv.index("--nproc")
+        nproc = int(argv[i + 1])
+        del argv[i: i + 2]
     cfg = parse_perf_file(argv[0])
     n_devices = int(argv[1]) if len(argv) > 1 else None
     try:
-        run_perf(cfg, n_devices=n_devices)
+        if nproc and nproc > 1:
+            run_perf_multiproc(argv[0], nproc)
+        else:
+            run_perf(cfg, n_devices=n_devices)
     except PerfChecksumError as exc:
         print(f" {exc}")
         print(" Wrong Checksums. Test failed!")

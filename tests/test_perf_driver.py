@@ -124,3 +124,21 @@ def test_unaligned_limits_on_mesh_match_single_chip():
     r4 = run_perf(cfg, verbose=False, n_devices=4)
     assert np.isclose(r1["checksum"], r4["checksum"], rtol=1e-10)
     assert r1["flops"] == r4["flops"]  # same true flop count both paths
+
+
+def test_multiproc_driver_two_ranks():
+    """--nproc mode: a 2-process jax.distributed world runs the config
+    over the combined multihost mesh with rank-identical checksums and
+    a rank-aggregated GFLOP/s (the mpiexec-driven reference driver,
+    `dbcsr_performance_driver.F:47-56`)."""
+    from dbcsr_tpu.perf.driver import run_perf_multiproc
+
+    agg = run_perf_multiproc(
+        os.path.join(INPUTS, "smoke.perf"), 2, nrep=1, verbose=False
+    )
+    assert agg["nproc"] == 2
+    assert len(agg["per_rank"]) == 2
+    assert agg["gflops_world"] > 0
+    # every rank computed the identical checksum (enforced internally;
+    # assert the reported value is the common one)
+    assert all(r["checksum"] == agg["checksum"] for r in agg["per_rank"])
