@@ -58,3 +58,37 @@ def make_grid(
     kl, s = grid_shape(n, layers)
     arr = np.asarray(devices).reshape(kl, s, s)
     return Mesh(arr, axis_names=("kl", "pr", "pc"))
+
+
+def optimize_grid(mesh: Mesh, nsplit: int, long_dim: str) -> Mesh:
+    """Re-factor the SAME devices into the ('kl','pr','pc') shape that
+    best fits a batch of contractions — the mesh analog of the
+    reference's batched pgrid re-optimization
+    (`dbcsr_tensor.F:1964-2186` re-chooses process-grid dims between
+    tensor batches).
+
+    m/n-long (grouped TAS) batches want the group axis as large as the
+    computed nsplit can fill: kl positions beyond nsplit would idle, so
+    pick the largest kl <= nsplit (falling back to the smallest
+    factorization if every candidate exceeds it).  k-long batches run
+    2.5D k-layers, whose replication optimum scales like n^(1/3)
+    (communication-avoiding Cannon): pick kl nearest that.
+    Returns the input mesh unchanged when it already matches.
+    """
+    devs = list(mesh.devices.flat)
+    n = len(devs)
+    cands = [
+        (n // (s * s), s)
+        for s in range(1, int(round(n ** 0.5)) + 1)
+        if n % (s * s) == 0
+    ]
+    if long_dim in ("m", "n"):
+        ok = [c for c in cands if c[0] <= max(int(nsplit), 1)]
+        kl, s = max(ok) if ok else min(cands)
+    else:
+        target = max(int(round(n ** (1.0 / 3.0))), 1)
+        kl, s = min(cands, key=lambda c: (abs(c[0] - target), -c[1]))
+    if (kl, s) == (mesh.shape["kl"], mesh.shape["pr"] ) and s == mesh.shape["pc"]:
+        return mesh
+    return Mesh(np.asarray(devs).reshape(kl, s, s),
+                axis_names=("kl", "pr", "pc"))

@@ -128,6 +128,27 @@ def tas_multiply(
         dims = {"m": m_full, "n": n_full, "k": k_full}
         long_dim = max(dims, key=dims.get)
         if mesh is not None:
+            if batch is not None:
+                # batched pgrid re-optimization (ref the reference
+                # re-choosing process-grid dims between tensor batches,
+                # `dbcsr_tensor.F:1964-2186`): re-factor the same
+                # devices to fit the batch's nsplit/long-dim, cached in
+                # the batch state and re-evaluated only when the
+                # (acceptance-ratio-gated) nsplit decision changes
+                from dbcsr_tpu.parallel.mesh import optimize_grid
+
+                key = (id(mesh), max(nsplit, 1), long_dim)
+                if batch.get("pgrid_key") != key:
+                    batch["pgrid_key"] = key
+                    batch["pgrid_src"] = mesh  # keepalive for id(mesh)
+                    batch["pgrid"] = optimize_grid(
+                        mesh, max(nsplit, 1), long_dim
+                    )
+                    if batch["pgrid"] is not mesh:
+                        batch["repgrid_count"] = (
+                            batch.get("repgrid_count", 0) + 1
+                        )
+                mesh = batch["pgrid"]
             return _tas_multiply_mesh(
                 transa, transb, alpha, a, b, beta, c, filter_eps,
                 max(nsplit, 1), long_dim, nblk_k, mesh,

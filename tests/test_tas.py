@@ -131,3 +131,38 @@ def test_tensor_contract_on_mesh():
     np.testing.assert_allclose(
         c.to_dense(), a.to_dense() @ b.to_dense(), rtol=1e-12, atol=1e-12
     )
+
+
+def test_batched_pgrid_reoptimization():
+    """Batched multiplies re-factor the device set to fit the batch's
+    nsplit/long-dim (the pgrid re-optimization between tensor batches,
+    ref `dbcsr_tensor.F:1964-2186`), cached in the batch state."""
+    import numpy as np
+
+    from dbcsr_tpu import make_random_matrix, to_dense
+    from dbcsr_tpu.parallel import make_grid
+    from dbcsr_tpu.parallel.mesh import optimize_grid
+    from dbcsr_tpu.tas import tas_multiply
+    from dbcsr_tpu.tas.batched import batched_mm
+
+    mesh = make_grid(8)  # (kl=2, 2x2)
+    # factorization unit checks
+    assert optimize_grid(mesh, 8, "m").shape == {"kl": 8, "pr": 1, "pc": 1}
+    assert optimize_grid(mesh, 2, "m") is mesh  # already optimal
+    assert optimize_grid(mesh, 1, "k") is mesh  # 2.5D optimum ~ n^(1/3)
+
+    rng = np.random.default_rng(7)
+    rbs = [4] * 40
+    kbs = [4] * 4
+    a = make_random_matrix("A", rbs, kbs, occupation=0.4, rng=rng)
+    b = make_random_matrix("B", kbs, kbs, occupation=0.7, rng=rng)
+    c = make_random_matrix("C", rbs, kbs, occupation=0.0, rng=rng)
+    want = to_dense(a) @ to_dense(b)
+    with batched_mm(c, nsplit=8):
+        tas_multiply("N", "N", 1.0, a, b, 0.0, c, mesh=mesh)
+        st = c._tas_batched_state
+        assert st["pgrid"].shape == {"kl": 8, "pr": 1, "pc": 1}
+        assert st.get("repgrid_count", 0) == 1
+        tas_multiply("N", "N", 1.0, a, b, 1.0, c, mesh=mesh)
+        assert st.get("repgrid_count", 0) == 1  # cached across the batch
+    np.testing.assert_allclose(to_dense(c), 2.0 * want, rtol=1e-12, atol=1e-12)
