@@ -113,7 +113,7 @@ def _c_map(s, ai, bi, ci):
     return (ci[s], 0, 0)
 
 
-def _smm_kernel(ai_ref, bi_ref, ci_ref, *refs, r_grp):
+def _smm_kernel(ai_ref, bi_ref, ci_ref, *refs, r_grp, kmerge):
     a_refs = refs[:r_grp]
     b_refs = refs[r_grp : 2 * r_grp]
     alpha_ref = refs[2 * r_grp]
@@ -124,19 +124,35 @@ def _smm_kernel(ai_ref, bi_ref, ci_ref, *refs, r_grp):
     cur = ci_ref[s]
     prev = ci_ref[jnp.maximum(s - 1, 0)]
     first = jnp.logical_or(s == 0, cur != prev)
-    contrib = jnp.zeros(acc_ref.shape, jnp.float32)
-    for r in range(r_grp):
-        # HIGHEST: true-f32 MXU passes for f32 inputs (default would be
-        # one bf16 pass, ~1e-3 relative error — caught by the
-        # validate_kernels gate on real hardware); bf16 inputs stay
-        # single-pass with f32 accumulation either way
-        contrib = contrib + jax.lax.dot_general(
-            a_refs[r][0],
-            b_refs[r][0],
-            (((1,), (0,)), ((), ())),
+    # HIGHEST: true-f32 MXU passes for f32 inputs (default would be
+    # one bf16 pass, ~1e-3 relative error — caught by the
+    # validate_kernels gate on real hardware); bf16 inputs stay
+    # single-pass with f32 accumulation either way
+    if kmerge and r_grp > 1:
+        # k-merged variant (the in-kernel sibling of the engine's
+        # xla_group R-tiling): ONE (R*k, m)^T x (R*k, n) MXU dot per
+        # grid step instead of R small dots — deeper MXU pipeline,
+        # R-fold fewer matmul ops.  A arrives TRANSPOSED (k, m) per
+        # block so both concatenations run along the cheap sublane
+        # axis, never the lane axis.
+        a_cat = jnp.concatenate([a_refs[r][0] for r in range(r_grp)], axis=0)
+        b_cat = jnp.concatenate([b_refs[r][0] for r in range(r_grp)], axis=0)
+        contrib = jax.lax.dot_general(
+            a_cat, b_cat,
+            (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
         )
+    else:
+        contrib = jnp.zeros(acc_ref.shape, jnp.float32)
+        for r in range(r_grp):
+            contrib = contrib + jax.lax.dot_general(
+                a_refs[r][0],
+                b_refs[r][0],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
     contrib = alpha_ref[0, 0] * contrib
 
     @pl.when(first)
@@ -152,20 +168,30 @@ def _smm_kernel(ai_ref, bi_ref, ci_ref, *refs, r_grp):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("r_grp", "interpret"),
+    static_argnames=("r_grp", "interpret", "kmerge"),
     donate_argnums=(0,),
 )
-def _pallas_process(c_data, a_data, b_data, ai2, bi2, ci2, alpha, *, r_grp, interpret):
-    """One launch: ai2/bi2 flat (nsteps*R,), ci2 (nsteps,), all int32."""
+def _pallas_process(c_data, a_data, b_data, ai2, bi2, ci2, alpha, *, r_grp,
+                    interpret, kmerge=False):
+    """One launch: ai2/bi2 flat (nsteps*R,), ci2 (nsteps,), all int32.
+    With ``kmerge`` the A operand is consumed TRANSPOSED per block
+    ((k, m) tiles) so the kernel's k-concatenations stay on the sublane
+    axis; the transpose happens here, device-side, once per launch."""
     nsteps = ci2.shape[0]
     m, k = a_data.shape[1:]
     n = b_data.shape[2]
+    kmerge = bool(kmerge and r_grp > 1)
+    if kmerge:
+        a_data = jnp.swapaxes(a_data, 1, 2)  # (N, k, m)
+        a_block = (1, k, m)
+    else:
+        a_block = (1, m, k)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(nsteps,),
         in_specs=[
             *[
-                pl.BlockSpec((1, m, k), functools.partial(_a_map, r=r, r_grp=r_grp))
+                pl.BlockSpec(a_block, functools.partial(_a_map, r=r, r_grp=r_grp))
                 for r in range(r_grp)
             ],
             *[
@@ -178,7 +204,7 @@ def _pallas_process(c_data, a_data, b_data, ai2, bi2, ci2, alpha, *, r_grp, inte
         out_specs=pl.BlockSpec((1, m, n), _c_map),
         scratch_shapes=[pltpu.VMEM((m, n), jnp.float32)],
     )
-    kernel = functools.partial(_smm_kernel, r_grp=r_grp)
+    kernel = functools.partial(_smm_kernel, r_grp=r_grp, kmerge=kmerge)
     # operand positions (incl. the 3 scalar-prefetch args):
     # 0..2 = ai2/bi2/ci2, 3..3+2R-1 = A/B, 3+2R = alpha, 3+2R+1 = c_data
     return pl.pallas_call(
@@ -215,6 +241,7 @@ def process_stack_pallas(
     a_pad_row: int | None = None,
     b_pad_row: int | None = None,
     grouping: int | None = None,
+    variant: str | None = None,
 ):
     """Process a flat stack (host int arrays, sorted by ``c_idx``).
 
@@ -222,7 +249,8 @@ def process_stack_pallas(
     arrays; when None, a zero row is appended on the fly.  ``grouping``
     forces R (otherwise chosen from the run-length heuristic; the
     caller passes the tuned value from `dbcsr_tpu.acc.params` when one
-    exists).
+    exists).  ``variant="kmerge"`` selects the k-merged single-dot
+    kernel (one (R*k, m)^T x (R*k, n) MXU dot per step).
     """
     if len(a_idx) == 0:
         return c_data
@@ -248,6 +276,7 @@ def process_stack_pallas(
                 c_data, a_data, b_data,
                 jnp.asarray(a_c), jnp.asarray(b_c), jnp.asarray(c_c),
                 alpha_arr, r_grp=r_grp, interpret=interpret,
+                kmerge=(variant == "kmerge"),
             )
     return c_data
 

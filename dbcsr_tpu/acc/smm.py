@@ -245,7 +245,8 @@ class KernelValidationError(RuntimeError):
 
 
 def _validate_pallas_kernel(c_data, a_data, b_data, a_idx, b_idx, c_idx,
-                            a_pad_row, b_pad_row, grouping) -> None:
+                            a_pad_row, b_pad_row, grouping,
+                            variant=None) -> None:
     """First-use validation of the Pallas kernel for this shape/dtype.
 
     Runs a prefix of the actual stack (still sorted by c_idx) on a
@@ -263,6 +264,7 @@ def _validate_pallas_kernel(c_data, a_data, b_data, a_idx, b_idx, c_idx,
     got = process_stack_pallas(
         c0, a_data, b_data, ai, bi, ci, 1.0,
         a_pad_row=a_pad_row, b_pad_row=b_pad_row, grouping=grouping,
+        variant=variant,
     )
     got = np.asarray(got)
     a_h = np.asarray(a_data)[ai].astype(np.float64)
@@ -290,7 +292,7 @@ class StackPlan:
 
     __slots__ = ("driver", "nseg", "xla_idx", "launches", "r_grp",
                  "a_pad_row", "b_pad_row", "append_a_pad", "append_b_pad",
-                 "val_idx", "group_idx")
+                 "val_idx", "group_idx", "kmerge")
 
     def __init__(self):
         self.driver = "xla"
@@ -304,6 +306,7 @@ class StackPlan:
         self.append_b_pad = False
         self.val_idx = None      # host prefix for first-use validation
         self.group_idx = None    # xla_group: (ga, gb, gc) device arrays
+        self.kmerge = False      # pallas: k-merged MXU dot variant
 
     def nbytes(self) -> int:
         """Approximate device bytes pinned by this plan (cache budget)."""
@@ -381,8 +384,11 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
             from dbcsr_tpu.acc import pallas_smm
 
             grouping = None
-            if tuned and tuned.get("driver") == "pallas" and tuned.get("grouping"):
-                grouping = int(tuned["grouping"])
+            kmerge = False
+            if tuned and tuned.get("driver") == "pallas":
+                if tuned.get("grouping"):
+                    grouping = int(tuned["grouping"])
+                kmerge = tuned.get("variant") == "kmerge"
             # no guaranteed-zero row in the data array: the plan indexes
             # a virtual row one past the end, appended at execute time
             # (capacities are pattern-deterministic, so cached plans
@@ -399,6 +405,7 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
             )
             plan.driver = "pallas"
             plan.r_grp = r_grp
+            plan.kmerge = kmerge
             plan.a_pad_row = a_pad_row
             plan.b_pad_row = b_pad_row
             plan.launches = [
@@ -479,7 +486,7 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0):
                     c_data, a_data, b_data, ai, bi, ci,
                     None if plan.append_a_pad else plan.a_pad_row,
                     None if plan.append_b_pad else plan.b_pad_row,
-                    grouping,
+                    grouping, variant="kmerge" if plan.kmerge else None,
                 )
                 _validated_kernels.add(key)
         if plan.append_a_pad:
@@ -497,6 +504,7 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0):
                 c_data = _pallas_process(
                     c_data, a_data, b_data, dai, dbi, dci,
                     alpha_arr, r_grp=plan.r_grp, interpret=interpret,
+                    kmerge=plan.kmerge,
                 )
         return c_data
     alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)

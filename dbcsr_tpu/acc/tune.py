@@ -153,27 +153,34 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
             alpha = jnp.asarray([[1.0]], jnp.float32)
             interpret = jax.devices()[0].platform != "tpu"
 
-            def run_pallas(r=r, launches=launches):
-                # x64 off during trace: see process_stack_pallas (Mosaic
-                # cannot legalize i64 scalar-prefetch index loads)
-                c = jnp.zeros((nc, m, n), dtype)
-                with jax.enable_x64(False):
-                    for dai2, dbi2, dci2 in launches:
-                        c = pallas_smm._pallas_process(
-                            c, a, b, dai2, dbi2, dci2,
-                            alpha, r_grp=r, interpret=interpret,
-                        )
-                return c
+            # both kernel variants: looped R small dots, and the
+            # k-merged single (R*k,m)^T x (R*k,n) dot per step
+            for variant in ((None, "kmerge") if r > 1 else (None,)):
+                def run_pallas(r=r, launches=launches, variant=variant):
+                    # x64 off during trace: see process_stack_pallas
+                    # (Mosaic cannot legalize i64 scalar-prefetch loads)
+                    c = jnp.zeros((nc, m, n), dtype)
+                    with jax.enable_x64(False):
+                        for dai2, dbi2, dci2 in launches:
+                            c = pallas_smm._pallas_process(
+                                c, a, b, dai2, dbi2, dci2,
+                                alpha, r_grp=r, interpret=interpret,
+                                kmerge=(variant == "kmerge"),
+                            )
+                    return c
 
-            try:
-                t = _time_config(run_pallas, nrep)
-            except Exception as exc:  # config failed to compile/run
-                out(f"  pallas R={r}: failed ({type(exc).__name__})")
-                continue
-            candidates.append(
-                {"driver": "pallas", "grouping": r, "gflops": flops / t / 1e9}
-            )
-            out(f"  pallas R={r}: {flops / t / 1e9:.1f} GFLOP/s")
+                tag = f"pallas R={r}" + (" kmerge" if variant else "")
+                try:
+                    t = _time_config(run_pallas, nrep)
+                except Exception as exc:  # config failed to compile/run
+                    out(f"  {tag}: failed ({type(exc).__name__})")
+                    continue
+                cand = {"driver": "pallas", "grouping": r,
+                        "gflops": flops / t / 1e9}
+                if variant:
+                    cand["variant"] = variant
+                candidates.append(cand)
+                out(f"  {tag}: {flops / t / 1e9:.1f} GFLOP/s")
 
     best = max(candidates, key=lambda c: c["gflops"])
     entry = {
