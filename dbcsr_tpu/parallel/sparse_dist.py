@@ -593,6 +593,52 @@ def _cached_panels(plan: _MeshPlan, which: str, m: BlockSparseMatrix,
     return panels
 
 
+@dataclasses.dataclass
+class _GroupedPlan:
+    """Pattern-determined artifacts of a grouped TAS mesh multiply
+    (the `_MeshPlan` sibling for `tas_grouped_multiply`)."""
+
+    s: int
+    g: int
+    q: int
+    r0: int
+    xtr: int
+    cap_a: int
+    cap_b: int
+    cap_c: int
+    bm: int
+    bk: int
+    bn: int
+    dtype: object
+    acc_name: str
+    true_flops: int
+    n_cand: int
+    ngroups: int
+    stacks_dev: object
+    a_asm: _BinAsm
+    b_asm: _BinAsm
+    cinit_asm: Optional[_BinAsm]
+    c_keys: np.ndarray
+    c_binning: tuple
+    collect_pos: tuple
+    collect_slots: tuple
+    collect_caps: tuple
+    collect_counts: tuple
+    collect_shapes: tuple
+    upload_bytes: int
+    panel_cache: dict = dataclasses.field(default_factory=dict)
+
+    def nbytes(self) -> int:
+        n = int(self.stacks_dev.nbytes) + self.a_asm.nbytes() + self.b_asm.nbytes()
+        if self.cinit_asm is not None:
+            n += self.cinit_asm.nbytes()
+        n += sum(int(x.nbytes) for x in self.collect_pos)
+        n += sum(int(x.nbytes) for x in self.collect_slots)
+        for _, panels, _ in self.panel_cache.values():
+            n += int(panels.nbytes)
+        return n
+
+
 def _build_mesh_plan(a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
                      limits, retain_sparsity, filter_eps,
                      beta_window=None) -> _MeshPlan:
@@ -1115,19 +1161,14 @@ def tas_grouped_multiply(
         )
 
 
-def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
-                      filter_eps, nsplit=None):
-    g, s = mesh.shape["kl"], mesh.shape["pr"]
-    if mesh.shape["pc"] != s:
-        raise ValueError("grouped Cannon needs a square ('pr','pc') grid")
-    a, b, matrix_c, dtype, bm, bk, bn = _prepare_operands(
-        matrix_a, matrix_b, matrix_c
-    )
-
+def _build_grouped_plan(a, b, matrix_c, mesh, g, s, dtype, bm, bk, bn, r0,
+                        filter_eps, nsplit) -> _GroupedPlan:
+    """Host-side half of a grouped TAS mesh multiply; everything here is
+    pattern-determined and uploaded once per plan."""
     from dbcsr_tpu.mm.multiply import _candidates
 
     shell_c = matrix_c if matrix_c is not None else BlockSparseMatrix(
-        name or f"{a.name}*{b.name}", a.row_blk_sizes, b.col_blk_sizes, dtype
+        f"{a.name}*{b.name}", a.row_blk_sizes, b.col_blk_sizes, dtype
     )
     rows_t, cols_t, a_ent, b_ent = _candidates(a, b, shell_c, filter_eps,
                                                *(None,) * 6)
@@ -1191,7 +1232,6 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     grp_kl = row_kl[rows_t]
     grp_ch = row_ch[rows_t]
     group_id = (((grp_kl * s + i_dev) * s + j_dev) * s) + tick_t
-    r0 = _stack_r0(dtype)
     st_a = (row_ch[ar][a_ent] * cap_a + a_slots[a_ent]).astype(np.int64)
     st_c = (grp_ch * cap_c + c_slots[ent_c]).astype(np.int64)
     stacks = _fill_stacks(
@@ -1200,69 +1240,161 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     )
     stacks = stacks.reshape(g, s, s, s, -1, stacks.shape[-1])
 
-    # ---- panel data at skewed start positions ----
-    # r0-tiled stacks reference a guaranteed-zero pad row at the end of
-    # the chunked buffer (q*cap_a) / the replicated buffer (cap_b)
+    stacks_dev = jax.device_put(stacks, NamedSharding(mesh, P("kl", "pr", "pc")))
+
+    # ---- device-side panel assembly maps (skewed start positions) ----
     xtr = 1 if r0 else 0
-    a_host = _dense_blocks_host(a, bm, bk)
-    a_panels = np.zeros((g, s, s, q * cap_a + xtr, bm, bk), dtype)
     agr, ai_, akc = a_panel // (s * s), (a_panel // s) % s, a_panel % s
     aj0 = (akc - ai_) % s
-    a_panels[agr // q, ai_, aj0, (agr % q) * cap_a + a_slots] = a_host
+    a_flat = (
+        ((agr // q) * s + ai_) * s + aj0
+    ) * (q * cap_a + xtr) + (agr % q) * cap_a + a_slots
+    a_asm = _make_bin_asm(a, a_flat, g * s * s * (q * cap_a + xtr), bm, bk)
 
-    b_host = _dense_blocks_host(b, bk, bn)
-    b_panels = np.zeros((s, s, cap_b + xtr, bk, bn), dtype)
     bkr, bj = b_panel // s, b_panel % s
     bi0 = (bkr - bj) % s
-    b_panels[bi0, bj, b_slots] = b_host
+    b_flat = (bi0 * s + bj) * (cap_b + xtr) + b_slots
+    b_asm = _make_bin_asm(b, b_flat, s * s * (cap_b + xtr), bk, bn)
 
-    c_init = np.zeros((g, s, s, q * cap_c, bm, bn), dtype)
-    if matrix_c is not None and matrix_c.nblks and beta != 0:
-        c_host = _dense_blocks_host(matrix_c, bm, bn)
+    cinit_asm = None
+    if matrix_c is not None and matrix_c.nblks:
         pos_old = np.searchsorted(c_keys, old_keys)
-        c_init[
-            row_kl[c_rows[pos_old]], rdist_in[c_rows[pos_old]],
-            cdist[c_cols[pos_old]],
-            row_ch[c_rows[pos_old]] * cap_c + c_slots[pos_old],
-        ] = c_host
+        cinit_flat = (
+            (row_kl[c_rows[pos_old]] * s + rdist_in[c_rows[pos_old]]) * s
+            + cdist[c_cols[pos_old]]
+        ) * (q * cap_c) + row_ch[c_rows[pos_old]] * cap_c + c_slots[pos_old]
+        cinit_asm = _make_bin_asm(matrix_c, cinit_flat, g * s * s * q * cap_c,
+                                  bm, bn)
 
-    dev = lambda arr, spec: jax.device_put(arr, NamedSharding(mesh, spec))
-    acc_name = "float32" if dtype.name == "bfloat16" else dtype.name
+    # ---- device-side C collection maps ----
+    from dbcsr_tpu.core.matrix import _bin_entries
+
+    nb, nsl, shapes = _bin_entries(a.row_blk_sizes, b.col_blk_sizes,
+                                   c_rows, c_cols)
+    c_flat_pos = (
+        (row_kl[c_rows] * s + rdist_in[c_rows]) * s + cdist[c_cols]
+    ) * (q * cap_c) + row_ch[c_rows] * cap_c + c_slots
+    collect_pos, collect_slots, collect_caps, collect_counts = [], [], [], []
+    for b_id in range(len(shapes)):
+        sel = np.nonzero(nb == b_id)[0]
+        cap = bucket_size(len(sel))
+        fp = np.zeros(cap, np.int32)
+        fp[: len(sel)] = c_flat_pos[sel]
+        sl = np.full(cap, cap, np.int32)
+        sl[: len(sel)] = nsl[sel]
+        collect_pos.append(jnp.asarray(fp))
+        collect_slots.append(jnp.asarray(sl))
+        collect_caps.append(cap)
+        collect_counts.append(len(sel))
+
+    upload_bytes = (
+        stacks.nbytes + a_asm.nbytes() + b_asm.nbytes()
+        + (cinit_asm.nbytes() if cinit_asm is not None else 0)
+        + sum(int(x.nbytes) for x in collect_pos)
+        + sum(int(x.nbytes) for x in collect_slots)
+    )
+    acc_name = "float32" if np.dtype(dtype).name == "bfloat16" else np.dtype(dtype).name
+    return _GroupedPlan(
+        s=s, g=g, q=q, r0=r0, xtr=xtr, cap_a=cap_a, cap_b=cap_b, cap_c=cap_c,
+        bm=bm, bk=bk, bn=bn, dtype=np.dtype(dtype), acc_name=acc_name,
+        true_flops=true_flops, n_cand=len(rows_t),
+        ngroups=int(row_group.max()) + 1 if len(row_group) else 0,
+        stacks_dev=stacks_dev, a_asm=a_asm, b_asm=b_asm, cinit_asm=cinit_asm,
+        c_keys=c_keys, c_binning=(nb, nsl, shapes),
+        collect_pos=tuple(collect_pos), collect_slots=tuple(collect_slots),
+        collect_caps=tuple(collect_caps), collect_counts=tuple(collect_counts),
+        collect_shapes=tuple(shapes), upload_bytes=int(upload_bytes),
+    )
+
+
+def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
+                      filter_eps, nsplit=None):
+    g, s = mesh.shape["kl"], mesh.shape["pr"]
+    if mesh.shape["pc"] != s:
+        raise ValueError("grouped Cannon needs a square ('pr','pc') grid")
+    a, b, matrix_c, dtype, bm, bk, bn = _prepare_operands(
+        matrix_a, matrix_b, matrix_c
+    )
+    r0 = _stack_r0(dtype)
+    from dbcsr_tpu.core import stats
+
+    plan = None
+    plan_key = None
+    if filter_eps is None:
+        plan_key = (
+            "tas", a.pattern_fingerprint(), b.pattern_fingerprint(),
+            matrix_c.pattern_fingerprint() if matrix_c is not None else None,
+            np.dtype(dtype).name, nsplit, _HashableMesh(mesh), r0,
+        )
+        plan = _mesh_plan_cache.get(plan_key)
+        if plan is not None:
+            _mesh_plan_cache.move_to_end(plan_key)
+    if plan is None:
+        with timed("mesh_plan_build"):
+            plan = _build_grouped_plan(
+                a, b, matrix_c, mesh, g, s, dtype, bm, bk, bn, r0,
+                filter_eps, nsplit,
+            )
+        if plan_key is not None:
+            _mesh_plan_insert(plan_key, plan)
+        stats.record_comm("host2dev", 1, plan.upload_bytes)
+    q, cap_a, cap_b, cap_c, xtr = plan.q, plan.cap_a, plan.cap_b, plan.cap_c, plan.xtr
+
+    a_panels = _cached_panels(
+        plan, "a", a, mesh, (g, s, s, q * cap_a + xtr, bm, bk),
+        P("kl", "pr", "pc"),
+    )
+    b_panels = _cached_panels(
+        plan, "b", b, mesh, (s, s, cap_b + xtr, bk, bn), P("pr", "pc")
+    )
+    if plan.cinit_asm is not None and beta != 0:
+        c_flat = _run_bin_asm(plan.cinit_asm, matrix_c, dtype)
+    else:
+        c_flat = jnp.zeros((g * s * s * q * cap_c, bm, bn), dtype)
+    c_init = jax.device_put(
+        c_flat.reshape(g, s, s, q * cap_c, bm, bn),
+        NamedSharding(mesh, P("kl", "pr", "pc")),
+    )
+
     c_out = _run_grouped_cannon(
-        dev(a_panels, P("kl", "pr", "pc")),
-        dev(b_panels, P("pr", "pc")),
-        dev(stacks, P("kl", "pr", "pc")),
-        dev(c_init, P("kl", "pr", "pc")),
+        a_panels, b_panels, plan.stacks_dev, c_init,
         jnp.asarray(alpha, dtype), jnp.asarray(beta, dtype),
-        s=s, cap_c=q * cap_c, acc_name=acc_name,
+        s=s, cap_c=q * cap_c, acc_name=plan.acc_name,
         mesh_ref=_HashableMesh(mesh), r0=r0,
     )
 
-    # ---- collect (groups disjoint: no reduction) ----
-    c_np = np.asarray(c_out)
+    # ---- device-side collect (groups disjoint: no reduction) ----
     out = BlockSparseMatrix(
         name or (matrix_c.name if matrix_c is not None else f"{a.name}*{b.name}"),
         a.row_blk_sizes, b.col_blk_sizes, dtype,
         dist=matrix_c.dist if matrix_c is not None else None,
     )
-    _adopt_panels(
-        out, c_keys,
-        c_np[row_kl[c_rows], rdist_in[c_rows], cdist[c_cols],
-             row_ch[c_rows] * cap_c + c_slots],
-    )
-    out._tas_ngroups = int(row_group.max()) + 1 if len(row_group) else 0
+    if len(plan.c_keys):
+        bin_datas = _collect_bins(
+            c_out.reshape(g * s * s * q * cap_c, bm, bn),
+            plan.collect_pos, plan.collect_slots,
+            caps=plan.collect_caps, shapes=plan.collect_shapes,
+        )
+        bins = [
+            _mk_bin(shape, data, count)
+            for shape, data, count in zip(
+                plan.collect_shapes, bin_datas, plan.collect_counts
+            )
+        ]
+    else:
+        bins = []
+    out.set_structure_from_device(plan.c_keys, bins, binning=plan.c_binning)
+    out._tas_ngroups = plan.ngroups
     if filter_eps is not None:
         from dbcsr_tpu.ops.operations import filter_matrix
 
         filter_matrix(out, filter_eps)
 
-    from dbcsr_tpu.core import stats
-
-    stats.record_stack(bm, bn, bk, len(rows_t), driver="mesh")
+    stats.record_stack(bm, bn, bk, plan.n_cand, driver="mesh")
     stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
     stats.sample_memory()
     ndev = g * s * s
-    itemsize = dtype.itemsize
+    itemsize = np.dtype(dtype).itemsize
     if s > 1:
         # per-group panels: cap_a is the per-group maximum, cap_b the
         # replicated short matrix — the traffic the group split saves
@@ -1272,11 +1404,7 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
             "ppermute", 2 * s * ndev,
             s * ndev * (q * cap_a * bm * bk + cap_b * bk * bn) * itemsize,
         )
-    stats.record_comm(
-        "host2dev", 4,
-        a_panels.nbytes + b_panels.nbytes + stacks.nbytes + c_init.nbytes,
-    )
-    out._last_flops = true_flops
+    out._last_flops = plan.true_flops
     return out
 
 

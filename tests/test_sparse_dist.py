@@ -623,3 +623,24 @@ def test_sparse_cannon_r_tiled_filtering(mesh8):
     assert np.array_equal(c_mesh.keys, c_ref.keys)
     np.testing.assert_allclose(to_dense(c_mesh), to_dense(c_ref),
                                rtol=1e-12, atol=1e-12)
+
+
+def test_tas_grouped_residency_no_restaging(mesh8):
+    """The grouped TAS path is rank-resident too: a repeated
+    same-pattern grouped multiply uploads nothing."""
+    from dbcsr_tpu.core import stats
+    from dbcsr_tpu.parallel import tas_grouped_multiply
+    from dbcsr_tpu.parallel.sparse_dist import clear_mesh_plans
+
+    clear_mesh_plans()
+    rbs = [4] * 32
+    kbs = [4] * 4
+    a = _rand("A", rbs, kbs, 0.4, 96)
+    b = _rand("B", kbs, kbs, 0.7, 97)
+    c1 = tas_grouped_multiply(1.0, a, b, 0.0, None, mesh8, nsplit=4)
+    stats.reset()
+    c2 = tas_grouped_multiply(1.0, a, b, 0.0, None, mesh8, nsplit=4)
+    assert stats._comm["host2dev"].nbytes == 0
+    assert checksum(c1) == checksum(c2)
+    stats.reset()
+    clear_mesh_plans()
