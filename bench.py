@@ -77,8 +77,14 @@ def main():
         m_sizes=[(1, 23)], n_sizes=[(1, 23)], k_sizes=[(1, 23)],
     )
     res = run_perf(cfg, verbose=False)
+    from dbcsr_tpu.core.kinds import dtype_of
+
+    dname = {"float64": "dreal", "float32": "sreal"}.get(
+        str(__import__("numpy").dtype(dtype_of(dtype_enum))),
+        str(__import__("numpy").dtype(dtype_of(dtype_enum))),
+    )
     out = {
-        "metric": "dbcsr_performance_multiply GFLOP/s (10k^2 BCSR, 23x23 blocks, occ=0.1, dreal)",
+        "metric": f"dbcsr_performance_multiply GFLOP/s (10k^2 BCSR, 23x23 blocks, occ=0.1, {dname})",
         "value": round(res["gflops_best"], 3),
         "unit": "GFLOP/s",
         "vs_baseline": round(res["gflops_best"] / CPU_BASELINE_GFLOPS, 3),

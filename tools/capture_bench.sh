@@ -14,6 +14,10 @@ x = jnp.arange(8.0); assert float(np.asarray(x)[3]) == 3.0
 if probe 90; then
   echo "tunnel healthy; capturing bench..."
   timeout 1500 python bench.py | tee -a BENCH_CAPTURES.jsonl
+  echo "capturing bf16 north-star variant (enum 9)..."
+  timeout 1500 env DBCSR_TPU_BENCH_DTYPE=9 python bench.py | tee -a BENCH_CAPTURES.jsonl
+  echo "capturing f32 north-star variant (enum 1)..."
+  timeout 1500 env DBCSR_TPU_BENCH_DTYPE=1 python bench.py | tee -a BENCH_CAPTURES.jsonl
 else
   echo "tunnel unreachable (probe timed out); NOT queuing more work on it."
   echo "re-run this script later; bench.py itself degrades to CPU fallback."
