@@ -420,7 +420,6 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
                     np.asarray(a_idx[:s], np.int32),
                     np.asarray(b_idx[:s], np.int32),
                     np.asarray(c_idx[:s], np.int32),
-                    grouping,
                 )
             return plan
     elif cfg.mm_driver == "pallas":
@@ -476,17 +475,23 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0):
 
         cfg = get_config()
         if cfg.validate_kernels and plan.val_idx is not None:
+            # keyed per compiled kernel VARIANT: kmerge and grouping
+            # select different Pallas lowerings, each of which must pass
+            # its own first-use validation (ADVICE r3)
             key = (
                 a_data.shape[1], b_data.shape[2], a_data.shape[2],
-                str(jnp.dtype(c_data.dtype)),
+                str(jnp.dtype(c_data.dtype)), plan.kmerge, plan.r_grp,
             )
             if key not in _validated_kernels:
-                ai, bi, ci, grouping = plan.val_idx
+                ai, bi, ci = plan.val_idx
+                # force the plan's RESOLVED r_grp so the validator
+                # exercises the exact compiled variant being launched
+                # (not one re-derived from the 512-entry prefix)
                 _validate_pallas_kernel(
                     c_data, a_data, b_data, ai, bi, ci,
                     None if plan.append_a_pad else plan.a_pad_row,
                     None if plan.append_b_pad else plan.b_pad_row,
-                    grouping, variant="kmerge" if plan.kmerge else None,
+                    plan.r_grp, variant="kmerge" if plan.kmerge else None,
                 )
                 _validated_kernels.add(key)
         if plan.append_a_pad:

@@ -73,6 +73,25 @@ def record_multiply(marketing_flops: int) -> None:
 # does; the CPU backend usually returns nothing).
 _memory = {"host_peak": 0, "host_current": 0, "device_peak": 0,
            "device_in_use": 0}
+# VmHWM at the last reset(): the OS meter is process-lifetime monotone,
+# so "host peak since reset" is VmHWM only when it has grown past this
+# baseline; otherwise the best observable bound is max(RSS samples).
+_hwm_at_reset = 0
+
+
+def _read_proc_status(*fields: str):
+    """Read byte values for the given `/proc/self/status` prefixes (kB
+    fields); returns a tuple in `fields` order, or None on any failure."""
+    vals = {f: 0 for f in fields}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                for field in fields:
+                    if line.startswith(field):
+                        vals[field] = int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        return None
+    return tuple(vals[f] for f in fields)
 
 
 def sample_memory() -> None:
@@ -82,15 +101,14 @@ def sample_memory() -> None:
 
     if not get_config().keep_stats:
         return
-    try:
-        with open("/proc/self/status") as f:
-            for line in f:
-                if line.startswith("VmHWM:"):
-                    _memory["host_peak"] = int(line.split()[1]) * 1024
-                elif line.startswith("VmRSS:"):
-                    _memory["host_current"] = int(line.split()[1]) * 1024
-    except (OSError, ValueError, IndexError):
-        pass
+    meters = _read_proc_status("VmHWM:", "VmRSS:")
+    if meters is not None:
+        hwm, rss = meters
+        _memory["host_current"] = rss
+        if hwm > _hwm_at_reset:
+            _memory["host_peak"] = hwm
+        else:  # peak predates the reset; bound by RSS seen since
+            _memory["host_peak"] = max(_memory["host_peak"], rss)
     try:
         import jax
 
@@ -116,14 +134,17 @@ def total_flops() -> int:
 
 
 def reset() -> None:
+    global _hwm_at_reset
     _by_mnk.clear()
     _comm.clear()
     for k in _totals:
         _totals[k] = 0
     for k in _memory:
-        # host peaks re-read the (monotone) OS VmHWM at the next sample;
-        # the device peak restarts from the next observation
         _memory[k] = 0
+    # record the monotone OS high-water mark so later samples report the
+    # peak SINCE this reset, not the process-lifetime peak (ADVICE r3)
+    meters = _read_proc_status("VmHWM:")
+    _hwm_at_reset = meters[0] if meters is not None else 0
 
 
 def print_statistics(out=print) -> None:

@@ -399,7 +399,10 @@ def run_perf_multiproc(cfg_path: str, nproc: int, devices_per_proc: int = 4,
         s.close()
         env = dict(
             os.environ,
-            XLA_FLAGS=f"--xla_force_host_platform_device_count={devices_per_proc}",
+            XLA_FLAGS=(
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={devices_per_proc}"
+            ).strip(),
         )
         env.pop("JAX_PLATFORMS", None)  # the worker sets the platform
         procs = [

@@ -151,11 +151,15 @@ def test_validate_kernels_catches_corrupted_kernel(monkeypatch):
         return real(c_data, a_data, b_data, *args, **kw) + 1.0
 
     monkeypatch.setattr(pallas_smm, "process_stack_pallas", corrupted)
-    smm._validated_kernels.discard((8, 8, 8, "float32"))
+    # validation keys are (m, n, k, dtype, kmerge, r_grp): one per
+    # compiled kernel variant (ADVICE r3)
+    smm._validated_kernels.difference_update(
+        {k for k in smm._validated_kernels if k[:4] == (8, 8, 8, "float32")}
+    )
     set_config(validate_kernels=True)
     with pytest.raises(smm.KernelValidationError):
         process_stack(c.astype(np.float32), a, b, ai, bi, ci)
-    assert (8, 8, 8, "float32") not in smm._validated_kernels
+    assert not any(k[:4] == (8, 8, 8, "float32") for k in smm._validated_kernels)
 
 
 def test_validate_kernels_passes_and_caches():
@@ -163,10 +167,12 @@ def test_validate_kernels_passes_and_caches():
 
     rng = np.random.default_rng(17)
     a, b, c, ai, bi, ci = _random_stack(rng, 8, 8, 6, 100, 9, 9, 9, np.float32)
-    smm._validated_kernels.discard((9, 9, 9, "float32"))
+    smm._validated_kernels.difference_update(
+        {k for k in smm._validated_kernels if k[:4] == (9, 9, 9, "float32")}
+    )
     got = np.asarray(process_stack(c, a, b, ai, bi, ci))
     np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.0), rtol=1e-4, atol=1e-4)
-    assert (9, 9, 9, "float32") in smm._validated_kernels
+    assert any(k[:4] == (9, 9, 9, "float32") for k in smm._validated_kernels)
 
 
 def test_forced_pallas_unsupported_dtype_warns():
