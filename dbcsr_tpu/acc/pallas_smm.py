@@ -281,6 +281,368 @@ def process_stack_pallas(
     return c_data
 
 
+# --------------------------------------------------------------------------
+# Cross-packed kernel ("crosspack"): P x R MXU tiling
+#
+# The looped kernel runs one (m,k)x(k,n) dot per stack entry — a 23x23
+# block uses <4% of one 128x128x128 MXU pass.  kmerge packs R entries
+# along the CONTRACTION axis (depth R*k).  crosspack adds the spatial
+# axes: P independent C-runs are packed side by side, lane p occupying
+# rows [p*m, (p+1)*m) / cols [p*n, (p+1)*n) of one big
+# (R*k, P*m)^T x (R*k, P*n) -> (P*m, P*n) dot whose BLOCK-DIAGONAL
+# holds each lane's k-merged contribution (off-diagonal products are
+# discarded — the price of packing, paid in FLOPs the idle MXU had
+# anyway).  One pass now advances P*R stack entries (25 at 23^3 vs 1),
+# the spatial sibling the round-3 verdict asked for next to kmerge's
+# k-packing.  Reference analog: the tile_m/tile_n register-tiling knobs
+# of the CUDA kernel families (`kernels/smm_acc_dnt_medium.h` tiling
+# parameters) — redesigned around the MXU's fixed 128x128 geometry.
+#
+# Scheduling: runs (one per C block; the stack arrives sorted) are
+# dealt greedily onto P lanes; each lane is the existing one-column
+# state machine (f32 VMEM accumulator persisting across a run,
+# write-back every step).  Lanes own DISJOINT C blocks, so each lane
+# writes its own output array (Pallas multiple-outputs), and the engine
+# scatters lane outputs back into c_data afterwards — no atomics, and
+# bit-reproducible per-run summation order, like the base kernel.
+# --------------------------------------------------------------------------
+
+
+def choose_pack(m: int, n: int, k: int, max_streams: int = 40):
+    """Pick (P, R): spatial lanes P and k-depth R for one MXU pass.
+
+    P*max(m,n) and R*k each aim to fill (not exceed) 128; the stream
+    count 2*P*R (+2P for C) is capped so VMEM double-buffers and the
+    SMEM prefetch budget stay comfortable."""
+    P = max(1, min(8, 128 // max(m, n)))
+    R = max(1, min(8, 128 // k))
+    while P * R * 2 + 2 * P > max_streams:
+        if R >= P and R > 1:
+            R -= 1
+        elif P > 1:
+            P -= 1
+        else:
+            break
+    return P, R
+
+
+def build_crosspack_stack(c_idx: np.ndarray, a_idx: np.ndarray,
+                          b_idx: np.ndarray, a_pad_row: int, b_pad_row: int,
+                          P: int, R: int):
+    """Deal the (sorted-by-c) stack onto P lanes of R-deep grid steps.
+
+    Returns (ai (nsteps,P,R), bi (nsteps,P,R), cg (nsteps,P) global C
+    block ids, cl (nsteps,P) lane-local output slots, lane_c: list of P
+    int32 arrays — lane p's global C ids in lane-slot order).  Padded
+    slots point at the zero rows / a dummy output slot.
+    """
+    s_total = len(c_idx)
+    run_first = np.flatnonzero(np.diff(c_idx)) + 1
+    run_starts = np.concatenate([[0], run_first])
+    run_lens = np.diff(np.concatenate([run_starts, [s_total]]))
+    run_steps = -(-run_lens // R)
+    nruns = len(run_lens)
+    # greedy: next run to the least-loaded lane (runs are near-uniform
+    # in length for real stacks, so this stays well balanced)
+    lane_loads = np.zeros(P, np.int64)
+    lane_runs: list = [[] for _ in range(P)]
+    order = np.argsort(-run_steps, kind="stable") if P > 1 else np.arange(nruns)
+    for j in order:
+        p = int(np.argmin(lane_loads))
+        lane_runs[p].append(j)
+        lane_loads[p] += run_steps[j]
+    nsteps = int(lane_loads.max()) if nruns else 0
+    ai = np.full((nsteps, P, R), a_pad_row, np.int32)
+    bi = np.full((nsteps, P, R), b_pad_row, np.int32)
+    cg = np.zeros((nsteps, P), np.int32)
+    cl = np.empty((nsteps, P), np.int32)
+    lane_c = []
+    for p in range(P):
+        s0 = 0
+        cvals = []
+        for slot, j in enumerate(sorted(lane_runs[p])):
+            st, ln = run_starts[j], run_lens[j]
+            steps = int(run_steps[j])
+            entries_a = a_idx[st:st + ln]
+            entries_b = b_idx[st:st + ln]
+            flat_a = np.full(steps * R, a_pad_row, np.int32)
+            flat_b = np.full(steps * R, b_pad_row, np.int32)
+            flat_a[:ln] = entries_a
+            flat_b[:ln] = entries_b
+            ai[s0:s0 + steps, p, :] = flat_a.reshape(steps, R)
+            bi[s0:s0 + steps, p, :] = flat_b.reshape(steps, R)
+            cg[s0:s0 + steps, p] = c_idx[st]
+            cl[s0:s0 + steps, p] = slot
+            cvals.append(c_idx[st])
+            s0 += steps
+        # pad tail steps -> dummy slot len(cvals): zero contributions
+        # land there and the scatter never reads it
+        cl[s0:, p] = len(cvals)
+        lane_c.append(np.asarray(cvals, np.int32))
+    return ai, bi, cg, cl, lane_c
+
+
+def _cp_a_map(s, ai, bi, cg, cl, *, p, r, P, R):
+    return (ai[(s * P + p) * R + r], 0, 0)
+
+
+def _cp_b_map(s, ai, bi, cg, cl, *, p, r, P, R):
+    return (bi[(s * P + p) * R + r], 0, 0)
+
+
+def _cp_cin_map(s, ai, bi, cg, cl, *, p, P):
+    return (cg[s * P + p], 0, 0)
+
+
+def _cp_out_map(s, ai, bi, cg, cl, *, p, P):
+    return (cl[s * P + p], 0, 0)
+
+
+def _crosspack_kernel(ai_ref, bi_ref, cg_ref, cl_ref, *refs, P, R):
+    a_refs = refs[:P * R]
+    b_refs = refs[P * R:2 * P * R]
+    alpha_ref = refs[2 * P * R]
+    c_refs = refs[2 * P * R + 1:2 * P * R + 1 + P]
+    o_refs = refs[2 * P * R + 1 + P:2 * P * R + 1 + 2 * P]
+    acc_ref = refs[-1]  # VMEM (P, m, n) f32
+    s = pl.program_id(0)
+    m = a_refs[0].shape[2]  # A arrives transposed: (1, k, m)
+    n = b_refs[0].shape[2]
+    # lane strips: k-concats on the sublane axis (cheap), then the lane
+    # concat packs strips side by side on the lane axis
+    a_cols = [
+        jnp.concatenate([a_refs[p * R + r][0] for r in range(R)], axis=0)
+        if R > 1 else a_refs[p * R][0]
+        for p in range(P)
+    ]
+    b_cols = [
+        jnp.concatenate([b_refs[p * R + r][0] for r in range(R)], axis=0)
+        if R > 1 else b_refs[p * R][0]
+        for p in range(P)
+    ]
+    a_all = jnp.concatenate(a_cols, axis=1) if P > 1 else a_cols[0]
+    b_all = jnp.concatenate(b_cols, axis=1) if P > 1 else b_cols[0]
+    full = jax.lax.dot_general(
+        a_all, b_all,
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    alpha = alpha_ref[0, 0]
+    for p in range(P):
+        contrib = alpha * jax.lax.slice(
+            full, (p * m, p * n), ((p + 1) * m, (p + 1) * n)
+        )
+        cur = cl_ref[s * P + p]
+        prev = cl_ref[jnp.maximum(s - 1, 0) * P + p]
+        first = jnp.logical_or(s == 0, cur != prev)
+
+        @pl.when(first)
+        def _(p=p, contrib=contrib):
+            acc_ref[p] = c_refs[p][0].astype(jnp.float32) + contrib
+
+        @pl.when(jnp.logical_not(first))
+        def _(p=p, contrib=contrib):
+            acc_ref[p] = acc_ref[p] + contrib
+
+        o_refs[p][0] = acc_ref[p].astype(o_refs[p].dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("P", "R", "nc_out", "interpret"),
+)
+def _pallas_crosspack(c_data, a_data_t, b_data, ai, bi, cg, cl, alpha, *,
+                      P, R, nc_out, interpret):
+    """One crosspack launch.  ``a_data_t`` is (N, k, m) (pre-transposed,
+    like kmerge).  ai/bi flat (nsteps*P*R,), cg/cl flat (nsteps*P,).
+    Returns a tuple of P lane outputs, each (nc_out, m, n)."""
+    nsteps = cg.shape[0] // P
+    k, m = a_data_t.shape[1:]
+    n = b_data.shape[2]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(nsteps,),
+        in_specs=[
+            *[
+                pl.BlockSpec((1, k, m),
+                             functools.partial(_cp_a_map, p=p, r=r, P=P, R=R))
+                for p in range(P) for r in range(R)
+            ],
+            *[
+                pl.BlockSpec((1, k, n),
+                             functools.partial(_cp_b_map, p=p, r=r, P=P, R=R))
+                for p in range(P) for r in range(R)
+            ],
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            *[
+                pl.BlockSpec((1, m, n), functools.partial(_cp_cin_map, p=p, P=P))
+                for p in range(P)
+            ],
+        ],
+        out_specs=[
+            pl.BlockSpec((1, m, n), functools.partial(_cp_out_map, p=p, P=P))
+            for p in range(P)
+        ],
+        scratch_shapes=[pltpu.VMEM((P, m, n), jnp.float32)],
+    )
+    kernel = functools.partial(_crosspack_kernel, P=P, R=R)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((nc_out, m, n), c_data.dtype)
+            for _ in range(P)
+        ],
+        interpret=interpret,
+    )(
+        ai, bi, cg, cl,
+        *([a_data_t] * (P * R)),
+        *([b_data] * (P * R)),
+        alpha,
+        *([c_data] * P),
+    )
+
+
+def prepare_crosspack_launches(c_idx, a_idx, b_idx, a_pad_row, b_pad_row,
+                               P: int, R: int):
+    """Chop the stack at RUN boundaries into SMEM-sized crosspack
+    launches, then lane-deal each chunk.
+
+    Unlike the base kernel, a C run cannot span launches (lane outputs
+    are fresh arrays, so there is no partial sum to reload); chunk
+    boundaries therefore always align to run starts.  Returns a list of
+    launch dicts, or None if any single run exceeds the per-launch
+    entry budget (callers fall back to the base kernel).
+    """
+    from dbcsr_tpu.utils.rounding import bucket_size
+
+    s_total = len(c_idx)
+    run_first = np.flatnonzero(np.diff(c_idx)) + 1
+    run_starts = np.concatenate([[0], run_first, [s_total]])
+    if len(run_starts) > 1 and np.diff(run_starts).max() > _MAX_ENTRIES_PER_LAUNCH:
+        return None
+    launches = []
+    lo = 0
+    while lo < s_total:
+        # furthest run start within the entry budget
+        hi_idx = np.searchsorted(run_starts, lo + _MAX_ENTRIES_PER_LAUNCH,
+                                 side="right") - 1
+        hi = int(run_starts[max(hi_idx, 0)])
+        if hi <= lo:
+            hi = int(run_starts[min(hi_idx + 1, len(run_starts) - 1)])
+        ai, bi, cg, cl, lane_c = build_crosspack_stack(
+            c_idx[lo:hi], a_idx[lo:hi], b_idx[lo:hi],
+            a_pad_row, b_pad_row, P, R,
+        )
+        nsteps = ai.shape[0]
+        cap = bucket_size(max(nsteps, 1))
+        if cap > nsteps:  # pad steps: zero entries into the dummy slot
+            pad = cap - nsteps
+            ai = np.concatenate([ai, np.full((pad, P, R), a_pad_row, np.int32)])
+            bi = np.concatenate([bi, np.full((pad, P, R), b_pad_row, np.int32)])
+            cg = np.concatenate([cg, np.zeros((pad, P), np.int32)])
+            cl = np.concatenate(
+                [cl, np.repeat(cl[-1:] if nsteps else
+                               np.zeros((1, P), np.int32), pad, axis=0)]
+            )
+        # bucketed so the jitted launch shape recurs across patterns
+        nc_out = bucket_size(
+            (max(len(c) for c in lane_c) if lane_c else 0) + 1
+        )
+        launches.append({
+            "ai": np.ascontiguousarray(ai.reshape(-1)),
+            "bi": np.ascontiguousarray(bi.reshape(-1)),
+            "cg": np.ascontiguousarray(cg.reshape(-1)),
+            "cl": np.ascontiguousarray(cl.reshape(-1)),
+            "lane_c": lane_c,
+            "nc_out": nc_out,
+        })
+        lo = hi
+    return launches
+
+
+def process_stack_crosspack(
+    c_data,
+    a_data,
+    b_data,
+    a_idx: np.ndarray,
+    b_idx: np.ndarray,
+    c_idx: np.ndarray,
+    alpha,
+    a_pad_row: int | None = None,
+    b_pad_row: int | None = None,
+    pack: tuple | None = None,
+):
+    """Cross-packed stack processing (host entry point).
+
+    Semantics match `process_stack_pallas`: stack sorted by c_idx,
+    contributions added onto ``c_data``.  ``pack`` forces (P, R).
+    Returns updated c_data, or None if the stack is crosspack-ineligible
+    (degenerate packing or an over-long run) — callers then use the
+    base kernel.
+    """
+    if len(a_idx) == 0:
+        return c_data
+    m, k = a_data.shape[1:]
+    n = b_data.shape[2]
+    P, R = pack or choose_pack(m, n, k)
+    if P <= 1:
+        return None  # no spatial packing possible; base kernel is equal
+    if a_pad_row is None:
+        a_data = jnp.concatenate(
+            [a_data, jnp.zeros((1,) + a_data.shape[1:], a_data.dtype)])
+        a_pad_row = a_data.shape[0] - 1
+    if b_pad_row is None:
+        b_data = jnp.concatenate(
+            [b_data, jnp.zeros((1,) + b_data.shape[1:], b_data.dtype)])
+        b_pad_row = b_data.shape[0] - 1
+    launches = prepare_crosspack_launches(
+        np.asarray(c_idx), np.asarray(a_idx), np.asarray(b_idx),
+        a_pad_row, b_pad_row, P, R,
+    )
+    if launches is None:
+        return None
+    a_data_t = jnp.swapaxes(a_data, 1, 2)
+    interpret = jax.devices()[0].platform != "tpu"
+    alpha_arr = jnp.asarray([[alpha]], dtype=jnp.float32)
+    for lc in launches:
+        with jax.enable_x64(False):
+            outs = _pallas_crosspack(
+                c_data, a_data_t, b_data,
+                jnp.asarray(lc["ai"]), jnp.asarray(lc["bi"]),
+                jnp.asarray(lc["cg"]), jnp.asarray(lc["cl"]),
+                alpha_arr, P=P, R=R, nc_out=lc["nc_out"],
+                interpret=interpret,
+            )
+        c_data = scatter_lane_outputs(
+            c_data, outs, [len(c) for c in lc["lane_c"]],
+            lane_scatter_index(lc["lane_c"]),
+        )
+    return c_data
+
+
+def scatter_lane_outputs(c_data, outs, lane_len, idx):
+    """Write each lane's finished C blocks back into the global array.
+
+    Lanes own disjoint C blocks, so this is a plain scatter-set (no
+    accumulation).  ``lane_len[p]`` = lane p's valid slot count; ``idx``
+    = the concatenated global C indices in lane order (host or device).
+    """
+    parts = [outs[p][:ln] for p, ln in enumerate(lane_len) if ln]
+    if not parts:
+        return c_data
+    vals = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+    return c_data.at[jnp.asarray(idx)].set(vals)
+
+
+def lane_scatter_index(lane_c):
+    """Concatenated global C ids of the non-empty lanes (scatter order
+    matching `scatter_lane_outputs`)."""
+    arrs = [c for c in lane_c if len(c)]
+    return np.concatenate(arrs) if arrs else np.empty(0, np.int32)
+
+
 def prepare_launches(ai2, bi2, ci2, r_grp: int, a_pad_row: int, b_pad_row: int):
     """Chop a grouped stack into SMEM-sized launches.
 

@@ -246,7 +246,7 @@ class KernelValidationError(RuntimeError):
 
 def _validate_pallas_kernel(c_data, a_data, b_data, a_idx, b_idx, c_idx,
                             a_pad_row, b_pad_row, grouping,
-                            variant=None) -> None:
+                            variant=None, pack=None) -> None:
     """First-use validation of the Pallas kernel for this shape/dtype.
 
     Runs a prefix of the actual stack (still sorted by c_idx) on a
@@ -254,18 +254,32 @@ def _validate_pallas_kernel(c_data, a_data, b_data, a_idx, b_idx, c_idx,
     and hard-fails on mismatch — like `validate_kernel` in
     `libsmm_acc.cpp:216` (checksum vs CPU, exit(1) at :81-85).
     """
-    from dbcsr_tpu.acc.pallas_smm import process_stack_pallas
+    from dbcsr_tpu.acc.pallas_smm import (
+        process_stack_crosspack,
+        process_stack_pallas,
+    )
 
     s = min(len(a_idx), _VALIDATE_MAX_ENTRIES)
     ai = np.asarray(a_idx[:s], np.int32)
     bi = np.asarray(b_idx[:s], np.int32)
     ci = np.asarray(c_idx[:s], np.int32)
     c0 = jnp.zeros_like(c_data)
-    got = process_stack_pallas(
-        c0, a_data, b_data, ai, bi, ci, 1.0,
-        a_pad_row=a_pad_row, b_pad_row=b_pad_row, grouping=grouping,
-        variant=variant,
-    )
+    if variant == "crosspack":
+        got = process_stack_crosspack(
+            c0, a_data, b_data, ai, bi, ci, 1.0,
+            a_pad_row=a_pad_row, b_pad_row=b_pad_row, pack=pack,
+        )
+        if got is None:  # prefix ineligible: nothing to validate against
+            raise KernelValidationError(
+                "crosspack validation prefix was ineligible for the "
+                "crosspack kernel; refusing to run it unvalidated"
+            )
+    else:
+        got = process_stack_pallas(
+            c0, a_data, b_data, ai, bi, ci, 1.0,
+            a_pad_row=a_pad_row, b_pad_row=b_pad_row, grouping=grouping,
+            variant=variant,
+        )
     got = np.asarray(got)
     a_h = np.asarray(a_data)[ai].astype(np.float64)
     b_h = np.asarray(b_data)[bi].astype(np.float64)
@@ -292,7 +306,7 @@ class StackPlan:
 
     __slots__ = ("driver", "nseg", "xla_idx", "launches", "r_grp",
                  "a_pad_row", "b_pad_row", "append_a_pad", "append_b_pad",
-                 "val_idx", "group_idx", "kmerge")
+                 "val_idx", "group_idx", "kmerge", "pack", "cross_launches")
 
     def __init__(self):
         self.driver = "xla"
@@ -307,6 +321,8 @@ class StackPlan:
         self.val_idx = None      # host prefix for first-use validation
         self.group_idx = None    # xla_group: (ga, gb, gc) device arrays
         self.kmerge = False      # pallas: k-merged MXU dot variant
+        self.pack = None         # pallas_cross: (P, R) MXU packing
+        self.cross_launches = None  # pallas_cross: launch dicts
 
     def nbytes(self) -> int:
         """Approximate device bytes pinned by this plan (cache budget)."""
@@ -318,6 +334,12 @@ class StackPlan:
         if self.launches is not None:
             for lc in self.launches:
                 total += sum(int(x.size) * 4 for x in lc)
+        if self.cross_launches is not None:
+            for lc in self.cross_launches:
+                total += sum(
+                    int(lc[key].size) * 4
+                    for key in ("ai", "bi", "cg", "cl", "scatter_idx")
+                )
         return total
 
 
@@ -385,10 +407,12 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
 
             grouping = None
             kmerge = False
+            tuned_cross = False
             if tuned and tuned.get("driver") == "pallas":
                 if tuned.get("grouping"):
                     grouping = int(tuned["grouping"])
                 kmerge = tuned.get("variant") == "kmerge"
+                tuned_cross = tuned.get("variant") == "crosspack"
             # no guaranteed-zero row in the data array: the plan indexes
             # a virtual row one past the end, appended at execute time
             # (capacities are pattern-deterministic, so cached plans
@@ -399,6 +423,73 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
             if b_pad_row is None:
                 plan.append_b_pad = True
                 b_pad_row = b_data.shape[0]
+            # cross-packed variant: forced by config, or tuned-table
+            # choice under auto dispatch (see pallas_smm crosspack
+            # block comment); ineligible stacks fall through to the
+            # base kernel
+            want_cross = cfg.mm_driver == "pallas_cross" or (
+                cfg.mm_driver == "auto" and tuned_cross
+            )
+            if tuned_cross:
+                # a crosspack entry's "grouping" is the crosspack
+                # k-depth R (tuned jointly with pack_p); it must not
+                # leak into the base kernel if crosspack falls through
+                grouping = None
+            if want_cross:
+                m_blk, k_blk = a_data.shape[1:]
+                n_blk = b_data.shape[2]
+                pack = None
+                if (tuned and tuned.get("pack_p") and tuned.get("grouping")
+                        and "predicted_from" not in tuned):
+                    # exact tuned entry: accept, clamped to this shape's
+                    # MXU geometry (defensive against a hand-edited or
+                    # stale table row)
+                    pack = (
+                        min(int(tuned["pack_p"]),
+                            max(1, 128 // max(m_blk, n_blk))),
+                        min(int(tuned["grouping"]), max(1, 128 // k_blk)),
+                    )
+                else:
+                    # nearest-neighbor-predicted donor: its pack was
+                    # tuned for a DIFFERENT block shape; re-derive from
+                    # this shape's geometry instead
+                    pack = pallas_smm.choose_pack(m_blk, n_blk, k_blk)
+                cross = None
+                if pack[0] > 1:
+                    cross = pallas_smm.prepare_crosspack_launches(
+                        np.asarray(c_idx), np.asarray(a_idx),
+                        np.asarray(b_idx), a_pad_row, b_pad_row,
+                        pack[0], pack[1],
+                    )
+                if cross is not None:
+                    plan.driver = "pallas_cross"
+                    plan.pack = pack
+                    plan.a_pad_row = a_pad_row
+                    plan.b_pad_row = b_pad_row
+                    plan.cross_launches = [
+                        {
+                            "ai": jnp.asarray(lc["ai"]),
+                            "bi": jnp.asarray(lc["bi"]),
+                            "cg": jnp.asarray(lc["cg"]),
+                            "cl": jnp.asarray(lc["cl"]),
+                            # one concatenated scatter per launch: lanes
+                            # own disjoint C blocks, so set (not add)
+                            "scatter_idx": jnp.asarray(
+                                pallas_smm.lane_scatter_index(lc["lane_c"])
+                            ),
+                            "lane_len": [len(c) for c in lc["lane_c"]],
+                            "nc_out": lc["nc_out"],
+                        }
+                        for lc in cross
+                    ]
+                    if cfg.validate_kernels:
+                        s = min(S, _VALIDATE_MAX_ENTRIES)
+                        plan.val_idx = (
+                            np.asarray(a_idx[:s], np.int32),
+                            np.asarray(b_idx[:s], np.int32),
+                            np.asarray(c_idx[:s], np.int32),
+                        )
+                    return plan
             ai2, bi2, ci2, r_grp = pallas_smm.build_grouped_stack(
                 np.asarray(c_idx), np.asarray(a_idx), np.asarray(b_idx),
                 a_pad_row, b_pad_row, grouping=grouping,
@@ -422,12 +513,13 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
                     np.asarray(c_idx[:s], np.int32),
                 )
             return plan
-    elif cfg.mm_driver == "pallas":
+    elif cfg.mm_driver in ("pallas", "pallas_cross"):
         import warnings
 
         warnings.warn(
-            f"mm_driver='pallas' but dtype {jnp.dtype(c_data.dtype)} / block "
-            f"shape unsupported by the Pallas kernel; falling back to XLA path",
+            f"mm_driver={cfg.mm_driver!r} but dtype {jnp.dtype(c_data.dtype)}"
+            f" / block shape unsupported by the Pallas kernel; falling back"
+            f" to XLA path",
             RuntimeWarning,
             stacklevel=2,
         )
@@ -470,6 +562,48 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0):
         return _process_stack_xla_group(
             c_data, a_data, b_data, ga, gb, gc, alpha_dev
         )
+    if plan.driver == "pallas_cross":
+        from dbcsr_tpu.acc import pallas_smm
+
+        cfg = get_config()
+        if cfg.validate_kernels and plan.val_idx is not None:
+            key = (
+                a_data.shape[1], b_data.shape[2], a_data.shape[2],
+                str(jnp.dtype(c_data.dtype)), "crosspack", plan.pack,
+            )
+            if key not in _validated_kernels:
+                ai, bi, ci = plan.val_idx
+                _validate_pallas_kernel(
+                    c_data, a_data, b_data, ai, bi, ci,
+                    None if plan.append_a_pad else plan.a_pad_row,
+                    None if plan.append_b_pad else plan.b_pad_row,
+                    None, variant="crosspack", pack=plan.pack,
+                )
+                _validated_kernels.add(key)
+        if plan.append_a_pad:
+            a_data = jnp.concatenate(
+                [a_data, jnp.zeros((1,) + a_data.shape[1:], a_data.dtype)]
+            )
+        if plan.append_b_pad:
+            b_data = jnp.concatenate(
+                [b_data, jnp.zeros((1,) + b_data.shape[1:], b_data.dtype)]
+            )
+        a_data_t = jnp.swapaxes(a_data, 1, 2)
+        alpha_arr = jnp.asarray([[alpha]], dtype=jnp.float32)
+        interpret = jax.devices()[0].platform != "tpu"
+        P, R = plan.pack
+        for lc in plan.cross_launches:
+            with jax.enable_x64(False):
+                outs = pallas_smm._pallas_crosspack(
+                    c_data, a_data_t, b_data,
+                    lc["ai"], lc["bi"], lc["cg"], lc["cl"],
+                    alpha_arr, P=P, R=R, nc_out=lc["nc_out"],
+                    interpret=interpret,
+                )
+            c_data = pallas_smm.scatter_lane_outputs(
+                c_data, outs, lc["lane_len"], lc["scatter_idx"]
+            )
+        return c_data
     if plan.driver == "pallas":
         from dbcsr_tpu.acc.pallas_smm import _pallas_process
 
@@ -542,7 +676,7 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
 def _pallas_supported(cfg, c_data, a_data, b_data) -> bool:
     if cfg.mm_driver == "xla":
         return False
-    if not cfg.use_pallas and cfg.mm_driver != "pallas":
+    if not cfg.use_pallas and cfg.mm_driver not in ("pallas", "pallas_cross"):
         return False
     try:
         from dbcsr_tpu.acc.pallas_smm import supports

@@ -60,7 +60,8 @@ class Config:
     num_layers_3d: int = 0
 
     def validate(self) -> None:
-        if self.mm_driver not in ("auto", "xla", "xla_group", "pallas", "dense"):
+        if self.mm_driver not in ("auto", "xla", "xla_group", "pallas",
+                                  "pallas_cross", "dense"):
             raise ValueError(f"unknown mm_driver {self.mm_driver!r}")
         if self.mm_stack_size <= 0:
             raise ValueError("mm_stack_size must be positive")

@@ -244,3 +244,180 @@ def test_pallas_kmerge_bf16():
     for e in range(len(ci)):
         ref[ci[e]] += ah[ai[e]] @ bh[bi[e]]
     np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# Cross-packed kernel (P x R MXU tiling; pallas_smm crosspack)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype,mnk,pack", [
+    (np.float32, (23, 23, 23), None),       # north-star block shape
+    (np.float32, (8, 8, 8), None),
+    (np.float32, (16, 24, 12), (3, 5)),     # rectangular + forced pack
+    ("bfloat16", (23, 23, 23), None),
+    (np.float32, (64, 64, 64), None),       # P=R=2 regime
+])
+def test_crosspack_vs_oracle(dtype, mnk, pack):
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.acc import pallas_smm
+
+    m, n, k = mnk
+    dt = jnp.bfloat16 if dtype == "bfloat16" else dtype
+    rng = np.random.default_rng(31)
+    a_h = rng.standard_normal((30, m, k))
+    b_h = rng.standard_normal((30, k, n))
+    c_h = rng.standard_normal((22, m, n))
+    s = 400
+    ai = rng.integers(0, 30, s).astype(np.int32)
+    bi = rng.integers(0, 30, s).astype(np.int32)
+    ci = np.sort(rng.integers(0, 22, s)).astype(np.int32)
+    got = pallas_smm.process_stack_crosspack(
+        jnp.asarray(c_h, dt), jnp.asarray(a_h, dt), jnp.asarray(b_h, dt),
+        ai, bi, ci, 1.3, pack=pack,
+    )
+    assert got is not None
+    want = c_h.copy()
+    np.add.at(want, ci, 1.3 * np.einsum("sij,sjk->sik", a_h[ai], b_h[bi]))
+    scale = np.abs(want).max()
+    err = np.abs(np.asarray(got, np.float64) - want).max() / scale
+    assert err < (5e-2 if dtype == "bfloat16" else 1e-5), err
+
+
+def test_crosspack_engine_dispatch_and_validation():
+    """mm_driver='pallas_cross' routes through the planner, passes the
+    per-variant first-use validation gate, and matches the oracle."""
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.acc import smm
+    from dbcsr_tpu.core.config import set_config
+
+    rng = np.random.default_rng(33)
+    a, b, c, ai, bi, ci = _random_stack(rng, 40, 40, 25, 500, 23, 23, 23,
+                                        np.float32)
+    smm._validated_kernels.difference_update(
+        {kk for kk in smm._validated_kernels if kk[:4] == (23, 23, 23, "float32")}
+    )
+    set_config(mm_driver="pallas_cross", validate_kernels=True)
+    try:
+        got = np.asarray(process_stack(jnp.asarray(c), jnp.asarray(a),
+                                       jnp.asarray(b), ai, bi, ci, 1.5))
+    finally:
+        set_config(mm_driver="auto")
+    np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.5),
+                               rtol=2e-4, atol=2e-4)
+    assert any(
+        len(kk) > 4 and kk[4] == "crosspack" for kk in smm._validated_kernels
+    )
+
+
+def test_crosspack_big_blocks_fall_back():
+    """Blocks too large for spatial packing (P==1) must fall back to the
+    base kernel path and still be exact."""
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.core.config import set_config
+
+    rng = np.random.default_rng(35)
+    a, b, c, ai, bi, ci = _random_stack(rng, 10, 10, 8, 60, 72, 72, 16,
+                                        np.float32)
+    set_config(mm_driver="pallas_cross")
+    try:
+        got = np.asarray(process_stack(jnp.asarray(c), jnp.asarray(a),
+                                       jnp.asarray(b), ai, bi, ci, 1.0))
+    finally:
+        set_config(mm_driver="auto")
+    np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.0),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_crosspack_long_run_single_c_block():
+    """All entries hitting ONE C block exercises the single-run/lane-
+    imbalance path (one lane gets everything, others idle on pads)."""
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.acc import pallas_smm
+
+    rng = np.random.default_rng(37)
+    m = n = k = 16
+    a_h = rng.standard_normal((12, m, k))
+    b_h = rng.standard_normal((12, k, n))
+    c_h = rng.standard_normal((3, m, n))
+    s = 200
+    ai = rng.integers(0, 12, s).astype(np.int32)
+    bi = rng.integers(0, 12, s).astype(np.int32)
+    ci = np.full(s, 1, np.int32)
+    got = pallas_smm.process_stack_crosspack(
+        jnp.asarray(c_h, jnp.float32), jnp.asarray(a_h, jnp.float32),
+        jnp.asarray(b_h, jnp.float32), ai, bi, ci, 1.0,
+    )
+    assert got is not None
+    want = c_h.copy()
+    np.add.at(want, ci, np.einsum("sij,sjk->sik", a_h[ai], b_h[bi]))
+    err = np.abs(np.asarray(got, np.float64) - want).max() / np.abs(want).max()
+    assert err < 1e-5, err
+
+
+def test_crosspack_tuned_table_dispatch(tmp_path, monkeypatch):
+    """A tuned-table crosspack entry steers auto dispatch (the analog of
+    libsmm_acc.cpp:227-249 parameter lookup)."""
+    import json
+
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.acc import params as params_mod
+    from dbcsr_tpu.acc import smm
+    from dbcsr_tpu.core.config import set_config
+
+    monkeypatch.setenv("DBCSR_TPU_PARAMS_DIR", str(tmp_path))
+    entry = {"m": 12, "n": 12, "k": 12, "dtype": "float32",
+             "driver": "pallas", "variant": "crosspack", "grouping": 4,
+             "pack_p": 4, "gflops": 1.0}
+    with open(params_mod.params_path(), "w") as f:
+        json.dump([entry], f)
+    rng = np.random.default_rng(39)
+    a, b, c, ai, bi, ci = _random_stack(rng, 20, 20, 12, 300, 12, 12, 12,
+                                        np.float32)
+    set_config(mm_driver="auto", validate_kernels=True)
+    plan = smm.prepare_stack(jnp.asarray(c), jnp.asarray(a), jnp.asarray(b),
+                             ai, bi, ci)
+    assert plan.driver == "pallas_cross"
+    assert plan.pack == (4, 4)
+    got = np.asarray(smm.execute_stack(jnp.asarray(c), jnp.asarray(a),
+                                       jnp.asarray(b), plan, 1.0))
+    np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.0),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_crosspack_predicted_donor_rederives_pack(tmp_path, monkeypatch):
+    """A nearest-neighbor-predicted crosspack entry carries a pack tuned
+    for a DIFFERENT block shape; dispatch must re-derive (P, R) from the
+    target geometry instead of applying the donor's values verbatim."""
+    import json
+
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.acc import params as params_mod
+    from dbcsr_tpu.acc import pallas_smm, smm
+    from dbcsr_tpu.core.config import set_config
+
+    monkeypatch.setenv("DBCSR_TPU_PARAMS_DIR", str(tmp_path))
+    # donor tuned at 12^3 with the uncapped (8, 8) pack — legal there,
+    # degenerate for 23^3 (8*23 = 184 > 128)
+    entry = {"m": 12, "n": 12, "k": 12, "dtype": "float32",
+             "driver": "pallas", "variant": "crosspack", "grouping": 8,
+             "pack_p": 8, "gflops": 1.0}
+    with open(params_mod.params_path(), "w") as f:
+        json.dump([entry], f)
+    rng = np.random.default_rng(41)
+    a, b, c, ai, bi, ci = _random_stack(rng, 20, 20, 12, 300, 23, 23, 23,
+                                        np.float32)
+    set_config(mm_driver="auto")
+    plan = smm.prepare_stack(jnp.asarray(c), jnp.asarray(a), jnp.asarray(b),
+                             ai, bi, ci)
+    assert plan.driver == "pallas_cross"
+    assert plan.pack == pallas_smm.choose_pack(23, 23, 23)
+    got = np.asarray(smm.execute_stack(jnp.asarray(c), jnp.asarray(a),
+                                       jnp.asarray(b), plan, 1.0))
+    np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.0),
+                               rtol=2e-4, atol=2e-4)
