@@ -190,6 +190,10 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
         rmax = max(1, min(8, 128 // k))
         packs = {(p0, r0c), (pmax, rmax), (p0, max(1, r0c // 2)),
                  (max(2, p0 // 2), r0c)}
+        # only geometry-legal candidates: dispatch clamps tuned packs to
+        # the 128-tile bound, so a winner beyond it would be recorded
+        # but never actually run
+        packs = {(P, R) for P, R in packs if P <= pmax and R <= rmax}
         a_t = jnp.swapaxes(a, 1, 2)
         interpret = jax.devices()[0].platform != "tpu"
         for P, R in sorted(packs):

@@ -1,11 +1,17 @@
 """Mesh construction for the 2.5D process grid.
 
 Axes: ('kl', 'pr', 'pc') — kl = 3D k-layers (ref NUM_LAYERS_3D /
-`dbcsr_mm_3d.F:983-1134`), pr x pc = the square Cannon grid (ref
-`dbcsr_mp_type`, `dbcsr_types.F:110-134`).  Cannon needs pr == pc; the
-layer axis absorbs non-square device counts (8 devices -> 2 x 2x2),
-playing the role the reference gives to image distributions for grid
-mismatch (`dbcsr_types.F:188-223`).
+`dbcsr_mm_3d.F:983-1134`), pr x pc = the Cannon grid (ref
+`dbcsr_mp_type`, `dbcsr_types.F:110-134`).
+
+Shape policy (`grid_shape`): square pr == pc grids run the skewed
+sparse Cannon; when the device count has no usable square factor (6,
+10, 14, ...) or an explicit layer count forces it (8 devices, layers=1),
+the grid goes RECTANGULAR pr != pc and the sparse engine switches to
+the all-gather algorithm (`sparse_dist._run_sparse_allgather`) — the
+role the reference gives to image distributions over arbitrary
+nprows x npcols grids (`dbcsr_types.F:188-223`,
+`dbcsr_mm_dist_operations.F:58`).
 """
 
 from __future__ import annotations
@@ -17,31 +23,48 @@ import numpy as np
 from jax.sharding import Mesh
 
 
-def grid_shape(n_devices: int, layers: Optional[int] = None) -> Tuple[int, int]:
-    """Pick (kl, s) with kl * s * s == n_devices, preferring the largest
-    square grid (fewest layers).  ``layers=None`` consults the
-    NUM_LAYERS_3D analog (`config.num_layers_3d`, ref
-    `dbcsr_config.F:152`) before auto-choosing."""
+def _balanced_factor(q: int) -> Tuple[int, int]:
+    """(pr, pc) with pr * pc == q, pr <= pc, as close to square as
+    possible (pr = largest divisor <= sqrt(q))."""
+    pr = 1
+    for d in range(int(np.sqrt(q)), 0, -1):
+        if q % d == 0:
+            pr = d
+            break
+    return pr, q // pr
+
+
+def grid_shape(n_devices: int, layers: Optional[int] = None) -> Tuple[int, int, int]:
+    """Pick (kl, pr, pc) with kl * pr * pc == n_devices.
+
+    Preference order: the largest SQUARE pr == pc grid (fewest layers;
+    runs the skewed Cannon), else a rectangular balanced pr x pc (runs
+    the all-gather engine).  ``layers=None`` consults the NUM_LAYERS_3D
+    analog (`config.num_layers_3d`, ref `dbcsr_config.F:152`) before
+    auto-choosing; an explicit layer count is honored exactly, going
+    rectangular when n/layers is not a perfect square."""
     if layers is None:
         from dbcsr_tpu.core.config import get_config
 
         cfg_layers = get_config().num_layers_3d
         if cfg_layers >= 1:
-            # honored like an explicit argument, incl. 1 = "force a 2D
-            # grid" (raises when n_devices is not a square)
             layers = cfg_layers
     if layers is not None:
-        s2, rem = divmod(n_devices, layers)
-        s = int(round(np.sqrt(s2)))
-        if rem or s * s != s2:
-            raise ValueError(f"{n_devices} devices != {layers} * square")
-        return layers, s
-    best = None
-    for s in range(int(np.sqrt(n_devices)), 0, -1):
+        q, rem = divmod(n_devices, layers)
+        if rem:
+            raise ValueError(
+                f"{n_devices} devices not divisible by {layers} layers"
+            )
+        s = int(round(np.sqrt(q)))
+        if s * s == q:
+            return layers, s, s
+        pr, pc = _balanced_factor(q)
+        return layers, pr, pc
+    for s in range(int(np.sqrt(n_devices)), 1, -1):
         if n_devices % (s * s) == 0:
-            best = (n_devices // (s * s), s)
-            break
-    return best
+            return n_devices // (s * s), s, s
+    pr, pc = _balanced_factor(n_devices)
+    return 1, pr, pc
 
 
 def make_grid(
@@ -55,8 +78,8 @@ def make_grid(
     n = len(devices)
     if n_devices is not None and n < n_devices:
         raise ValueError(f"requested {n_devices} devices, have {n}")
-    kl, s = grid_shape(n, layers)
-    arr = np.asarray(devices).reshape(kl, s, s)
+    kl, pr, pc = grid_shape(n, layers)
+    arr = np.asarray(devices).reshape(kl, pr, pc)
     return Mesh(arr, axis_names=("kl", "pr", "pc"))
 
 

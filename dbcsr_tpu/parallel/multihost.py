@@ -92,11 +92,11 @@ def make_multihost_grid(layers: Optional[int] = None) -> Mesh:
     devices = jax.devices()  # all processes' devices, globally ordered
     if jax.process_count() == 1:
         return make_grid(devices=devices, layers=layers)
-    kl, s = grid_shape(len(devices), layers)
+    kl, pr, pc = grid_shape(len(devices), layers)
     from jax.experimental import mesh_utils
 
     try:
-        arr = mesh_utils.create_device_mesh((kl, s, s), devices=devices)
+        arr = mesh_utils.create_device_mesh((kl, pr, pc), devices=devices)
     except ValueError as exc:
         # unsupported topology: warn — enumeration order may put the
         # Cannon ring axes across DCN, which is correct but slow
@@ -107,5 +107,5 @@ def make_multihost_grid(layers: Optional[int] = None) -> Mesh:
             "enumeration order — ring axes may cross DCN",
             stacklevel=2,
         )
-        arr = np.asarray(devices).reshape(kl, s, s)
+        arr = np.asarray(devices).reshape(kl, pr, pc)
     return Mesh(arr, axis_names=("kl", "pr", "pc"))

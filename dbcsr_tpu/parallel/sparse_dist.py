@@ -203,11 +203,14 @@ def _stack_r0(dtype) -> int:
     return 8 if emulated_dtype_on_tpu(dtype) else 0
 
 
-def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=0):
-    """The shared Cannon metronome: s ticks of gather → batched matmul →
+def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=0, nticks=None):
+    """The shared Cannon metronome: ticks of gather → batched matmul →
     sorted segment-sum, ring-shifting A along 'pc' and B along 'pr'
     (ref the grouped_k_index loop, `dbcsr_mm_cannon.F:1345`).
-    ``r0 > 0``: R-tiled stacks (k-merged dots, `_fill_stacks` layout)."""
+    ``r0 > 0``: R-tiled stacks (k-merged dots, `_fill_stacks` layout).
+    ``s == 0`` disables the ring shifts (the all-gather engine's chunk
+    loop: operands already complete, ticks bound peak memory only);
+    ``nticks`` overrides the tick count (defaults to s)."""
     bm, bk, bn = a.shape[1], a.shape[2], b.shape[2]
     from dbcsr_tpu.parallel.cannon import mark_varying
 
@@ -244,7 +247,8 @@ def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=0):
             b = jax.lax.ppermute(b, ("pr",), shift_b)
         return a, b, c
 
-    _, _, c = jax.lax.fori_loop(0, s, tick, (a, b, c))
+    _, _, c = jax.lax.fori_loop(0, nticks if nticks is not None else s,
+                                tick, (a, b, c))
     return c
 
 
@@ -271,53 +275,73 @@ def _grid_map(dist_arr: Optional[np.ndarray], n: int, naxis: int) -> np.ndarray:
     return np.arange(n, dtype=np.int64) % naxis
 
 
-def _resolve_maps(a, b, matrix_c, s: int, kl: int):
+def _resolve_maps(a, b, matrix_c, pr: int, pc: int, kl: int):
     """Block→process maps honoring the matrices' `Distribution` objects
     (ref `dbcsr_distribution_new` row/col→proc arrays,
     `dbcsr_dist_methods.F:49`).
 
-    Returns (rdist, cdist, k_layer, k_col) over block indices:
-    C-row → 'pr', C-col → 'pc', k-block → (2.5D layer, 'pc' image).
-    Priority: C's distribution, then A's rows / B's cols; the k axis
-    uses A's column map when it spans the grid axis (must equal B's row
-    map for a legal Cannon), falling back to cyclic images.
+    Returns (rdist, cdist, k_layer, ka_col, kb_row) over block indices:
+    C-row → 'pr', C-col → 'pc', k-block → (2.5D layer, A's 'pc' image,
+    B's 'pr' image).  Priority: C's distribution, then A's rows / B's
+    cols; falling back to cyclic images.
+
+    Square grids (Cannon) need ONE k map shared by A's columns and B's
+    rows (ref `dbcsr_mm.F:585-590` compatible-distribution rule):
+    ka_col == kb_row there.  Rectangular grids run the all-gather
+    engine, where A's k home (over 'pc') and B's k home (over 'pr')
+    are independent (the freedom image distributions give the
+    reference, `dbcsr_mm_dist_operations.F:58`).
     """
     rdist = None
     cdist = None
-    for cand_dist, attr, naxis in (
-        (matrix_c.dist if matrix_c is not None else None, "row_dist", s),
-        (a.dist, "row_dist", s),
+    for cand_dist, attr in (
+        (matrix_c.dist if matrix_c is not None else None, "row_dist"),
+        (a.dist, "row_dist"),
     ):
-        if cand_dist is not None and cand_dist.grid.nprows == naxis:
+        if cand_dist is not None and cand_dist.grid.nprows == pr:
             rdist = getattr(cand_dist, attr)
             break
     for cand_dist, attr in (
         (matrix_c.dist if matrix_c is not None else None, "col_dist"),
         (b.dist, "col_dist"),
     ):
-        if cand_dist is not None and cand_dist.grid.npcols == s:
+        if cand_dist is not None and cand_dist.grid.npcols == pc:
             cdist = getattr(cand_dist, attr)
             break
     nbk = a.nblkcols
-    rdist = _grid_map(rdist, a.nblkrows, s)
-    cdist = _grid_map(cdist, b.nblkcols, s)
+    rdist = _grid_map(rdist, a.nblkrows, pr)
+    cdist = _grid_map(cdist, b.nblkcols, pc)
 
-    kdist = None
-    if a.dist.grid.npcols == s and len(a.dist.col_dist) == nbk:
-        kdist = a.dist.col_dist
-    elif b.dist.grid.nprows == s and len(b.dist.row_dist) == nbk:
-        kdist = b.dist.row_dist
-    if kdist is not None and (
-        len(kdist) == 0
-        or (kdist.min(initial=0) >= 0 and kdist.max(initial=0) < s)
-    ):
-        k_col = np.ascontiguousarray(kdist, np.int64)
-        # 2.5D layer: deterministic round-robin within each grid column
-        # (the image-multiplicity decimation generalized to arbitrary maps)
-        k_layer = _panel_slots(k_col) % kl
-    else:
-        k_layer, k_col = _vcol(np.arange(nbk, dtype=np.int64), kl, s)
-    return rdist, cdist, k_layer, k_col
+    if pr == pc:
+        s = pr
+        kdist = None
+        if a.dist.grid.npcols == s and len(a.dist.col_dist) == nbk:
+            kdist = a.dist.col_dist
+        elif b.dist.grid.nprows == s and len(b.dist.row_dist) == nbk:
+            kdist = b.dist.row_dist
+        if kdist is not None and (
+            len(kdist) == 0
+            or (kdist.min(initial=0) >= 0 and kdist.max(initial=0) < s)
+        ):
+            k_col = np.ascontiguousarray(kdist, np.int64)
+            # 2.5D layer: deterministic round-robin within each grid
+            # column (image-multiplicity decimation generalized)
+            k_layer = _panel_slots(k_col) % kl
+        else:
+            k_layer, k_col = _vcol(np.arange(nbk, dtype=np.int64), kl, s)
+        return rdist, cdist, k_layer, k_col, k_col
+
+    # rectangular: independent k homes, one shared layer split
+    ka = None
+    if a.dist.grid.npcols == pc and len(a.dist.col_dist) == nbk:
+        ka = a.dist.col_dist
+    kb = None
+    if b.dist.grid.nprows == pr and len(b.dist.row_dist) == nbk:
+        kb = b.dist.row_dist
+    ka_col = _grid_map(ka, nbk, pc)
+    kb_row = _grid_map(kb, nbk, pr)
+    k_layer = (np.arange(nbk, dtype=np.int64) // max(pr, pc)) % kl
+    return rdist, cdist, k_layer, ka_col, kb_row
 
 
 @functools.partial(
@@ -341,6 +365,53 @@ def _run_sparse_cannon(a_panels, b_panels, stacks, c_init, alpha, beta_fac,
         if fac.ndim == 1:
             fac = fac[:, None, None]
         c = _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=r0)
+        c = jax.lax.psum(c, "kl")
+        c = (alpha * c + fac * c_in.astype(acc_dtype)).astype(c_in.dtype)
+        return c.reshape((1, 1) + c.shape)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P("kl", "pr", "pc"),
+            P("kl", "pr", "pc"),
+            P("kl", "pr", "pc"),
+            P("pr", "pc"),
+            P(),
+            P("pr", "pc"),
+        ),
+        out_specs=P("pr", "pc"),
+    )
+    return fn(a_panels, b_panels, stacks, c_init, alpha, beta_fac)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("nticks", "cap_c", "acc_name", "mesh_ref", "r0"),
+)
+def _run_sparse_allgather(a_panels, b_panels, stacks, c_init, alpha, beta_fac,
+                          *, nticks, cap_c, acc_name, mesh_ref, r0=0):
+    """Rectangular-grid engine: A panels live at their k home column and
+    are `all_gather`ed along 'pc' (B along 'pr'), then the stack chunks
+    run with no ring shifts.  The TPU-native realization of running on
+    an arbitrary nprows x npcols grid via image distributions
+    (`dbcsr_mm_dist_operations.F:58`, `dbcsr_types.F:188-223`): one XLA
+    collective rides ICI instead of lcm(pr,pc) skew ticks."""
+    mesh = mesh_ref.val
+    acc_dtype = jnp.dtype(acc_name)
+
+    def body(a_p, b_p, st, c_in, alpha, beta_fac):
+        a = a_p.reshape(a_p.shape[3:])  # (cap_a + xtr, bm, bk)
+        b = b_p.reshape(b_p.shape[3:])
+        st = st.reshape(st.shape[3:])   # (nticks, cap, w)
+        c_in = c_in.reshape(c_in.shape[2:])
+        fac = beta_fac.reshape(beta_fac.shape[2:])
+        if fac.ndim == 1:
+            fac = fac[:, None, None]
+        a_all = jax.lax.all_gather(a, "pc", axis=0, tiled=True)
+        b_all = jax.lax.all_gather(b, "pr", axis=0, tiled=True)
+        c = _cannon_tick_loop(a_all, b_all, st, 0, cap_c, acc_dtype,
+                              r0=r0, nticks=nticks)
         c = jax.lax.psum(c, "kl")
         c = (alpha * c + fac * c_in.astype(acc_dtype)).astype(c_in.dtype)
         return c.reshape((1, 1) + c.shape)
@@ -499,7 +570,9 @@ class _MeshPlan:
     """Everything about a mesh multiply that only depends on the
     operands' patterns, distributions, dtype and product options."""
 
-    s: int
+    s: int       # 'pr' extent (== pc on Cannon grids)
+    pc: int
+    nticks: int  # Cannon: = s alignment steps; all-gather: chunk count
     kl: int
     r0: int
     xtr: int
@@ -639,12 +712,17 @@ class _GroupedPlan:
         return n
 
 
-def _build_mesh_plan(a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
+def _build_mesh_plan(a, b, matrix_c, mesh, pr, pc, kl, dtype, bm, bk, bn, r0,
                      limits, retain_sparsity, filter_eps,
                      beta_window=None) -> _MeshPlan:
     """The host-side half of a mesh multiply: symbolic product, device
     and tick assignment, stack fill, panel/collect index maps — all of
-    it pattern-determined and device-uploaded exactly once."""
+    it pattern-determined and device-uploaded exactly once.
+
+    Square grids (pr == pc) get the skewed Cannon layout; rectangular
+    grids get the all-gather layout (stack entries index the
+    'pc'-gathered A / 'pr'-gathered B concatenations, no skew, ticks =
+    balanced chunks instead of alignment steps)."""
     from dbcsr_tpu.mm.multiply import _candidates
 
     shell_c = matrix_c if matrix_c is not None else BlockSparseMatrix(
@@ -671,24 +749,27 @@ def _build_mesh_plan(a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
         )
     )
 
-    rdist, cdist, k_layer, k_col = _resolve_maps(a, b, matrix_c, s, kl)
+    cannon = pr == pc
+    nticks = pr if cannon else max(pr, pc)
+    rdist, cdist, k_layer, ka_col, kb_row = _resolve_maps(
+        a, b, matrix_c, pr, pc, kl
+    )
 
     i_dev = rdist[rows_t]
     j_dev = cdist[cols_t]
-    layer, kc = k_layer[k_t], k_col[k_t]
-    tick_t = (kc - i_dev - j_dev) % s
+    layer = k_layer[k_t]
 
     ar, ac = a.entry_coords()
-    a_layer, a_kc = k_layer[ac], k_col[ac]
-    a_panel = ((a_layer * s) + rdist[ar]) * s + a_kc  # (l, i, kc)
+    a_layer, a_kc = k_layer[ac], ka_col[ac]
+    a_panel = ((a_layer * pr) + rdist[ar]) * pc + a_kc  # (l, i, ka)
     a_slots = _panel_slots(a_panel)
-    cap_a = bucket_size(max(int(np.bincount(a_panel, minlength=kl * s * s).max()), 1) if a.nblks else 1)
+    cap_a = bucket_size(max(int(np.bincount(a_panel, minlength=kl * pr * pc).max()), 1) if a.nblks else 1)
 
     br, bc = b.entry_coords()
-    b_layer, b_kr = k_layer[br], k_col[br]
-    b_panel = ((b_layer * s) + b_kr) * s + cdist[bc]  # (l, kr, j)
+    b_layer, b_kr = k_layer[br], kb_row[br]
+    b_panel = ((b_layer * pr) + b_kr) * pc + cdist[bc]  # (l, kb, j)
     b_slots = _panel_slots(b_panel)
-    cap_b = bucket_size(max(int(np.bincount(b_panel, minlength=kl * s * s).max()), 1) if b.nblks else 1)
+    cap_b = bucket_size(max(int(np.bincount(b_panel, minlength=kl * pr * pc).max()), 1) if b.nblks else 1)
 
     if retain_sparsity:
         c_keys = old_keys
@@ -697,38 +778,63 @@ def _build_mesh_plan(a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
         c_keys = np.union1d(old_keys, prod_keys)
     c_rows = (c_keys // shell_c.nblkcols).astype(np.int64)
     c_cols = (c_keys % shell_c.nblkcols).astype(np.int64)
-    c_panel = rdist[c_rows] * s + cdist[c_cols]
+    c_panel = rdist[c_rows] * pc + cdist[c_cols]
     c_slots = _panel_slots(c_panel)
-    cap_c = bucket_size(max(int(np.bincount(c_panel, minlength=s * s).max()), 1) if len(c_keys) else 1)
+    cap_c = bucket_size(max(int(np.bincount(c_panel, minlength=pr * pc).max()), 1) if len(c_keys) else 1)
 
     ent_c = np.searchsorted(c_keys, rows_t * shell_c.nblkcols + cols_t)
-    group = (((layer * s + i_dev) * s + j_dev) * s) + tick_t
+    xtr = 1 if r0 else 0
+    if cannon:
+        # Cannon: the tick is the alignment step at which A's k column
+        # meets B's k row on the (i, j) device; stacks index LOCAL
+        # panel slots (panels travel via ppermute)
+        tick_t = (ka_col[k_t] - i_dev - j_dev) % pr
+        st_a = a_slots[a_ent]
+        st_b = b_slots[b_ent]
+    else:
+        # all-gather: every k panel is present after the gather; stacks
+        # index the CONCATENATED ('pc'-gathered A / 'pr'-gathered B)
+        # arrays, and ticks are balanced ENTRY-COUNT chunks of each
+        # device's c-sorted stack (chunking by C slot would let one
+        # dominant run collapse into a single tick and size every tick
+        # to it; runs MAY span ticks — the C canvas accumulates)
+        dev_t = (layer * pr + i_dev) * pc + j_dev
+        cnt = np.bincount(dev_t, minlength=kl * pr * pc)
+        order_t = np.lexsort((c_slots[ent_c], dev_t))
+        starts = np.concatenate([[0], np.cumsum(cnt)])[:-1]
+        rank = np.empty(len(dev_t), np.int64)
+        rank[order_t] = np.arange(len(dev_t)) - starts[dev_t[order_t]]
+        tick_t = (rank * nticks) // np.maximum(cnt[dev_t], 1)
+        st_a = ka_col[k_t] * (cap_a + xtr) + a_slots[a_ent]
+        st_b = kb_row[k_t] * (cap_b + xtr) + b_slots[b_ent]
+    group = (((layer * pr + i_dev) * pc + j_dev) * nticks) + tick_t
     stacks = _fill_stacks(
-        group, a_slots[a_ent], b_slots[b_ent], c_slots[ent_c],
-        kl * s * s * s, cap_c, r0=r0, pad_a=cap_a, pad_b=cap_b,
+        group, st_a, st_b, c_slots[ent_c],
+        kl * pr * pc * nticks, cap_c, r0=r0, pad_a=cap_a, pad_b=cap_b,
     )
-    stacks = stacks.reshape(kl, s, s, s, -1, stacks.shape[-1])
+    stacks = stacks.reshape(kl, pr, pc, nticks, -1, stacks.shape[-1])
     stacks_dev = jax.device_put(stacks, NamedSharding(mesh, P("kl", "pr", "pc")))
 
-    # ---- device-side panel assembly maps (skewed start positions) ----
-    xtr = 1 if r0 else 0
-    al, ai_, akc = a_panel // (s * s), (a_panel // s) % s, a_panel % s
-    aj0 = (akc - ai_) % s  # device col initially holding panel (i, kc)
-    a_flat = ((al * s + ai_) * s + aj0) * (cap_a + xtr) + a_slots
-    a_asm = _make_bin_asm(a, a_flat, kl * s * s * (cap_a + xtr), bm, bk)
+    # ---- device-side panel assembly maps ----
+    al, ai_, akc = a_panel // (pr * pc), (a_panel // pc) % pr, a_panel % pc
+    # Cannon panels start SKEWED so the first tick needs no shift;
+    # all-gather panels sit at their k home column directly
+    aj0 = (akc - ai_) % pr if cannon else akc
+    a_flat = ((al * pr + ai_) * pc + aj0) * (cap_a + xtr) + a_slots
+    a_asm = _make_bin_asm(a, a_flat, kl * pr * pc * (cap_a + xtr), bm, bk)
 
-    bl, bkr, bj = b_panel // (s * s), (b_panel // s) % s, b_panel % s
-    bi0 = (bkr - bj) % s  # device row initially holding panel (kr, j)
-    b_flat = ((bl * s + bi0) * s + bj) * (cap_b + xtr) + b_slots
-    b_asm = _make_bin_asm(b, b_flat, kl * s * s * (cap_b + xtr), bk, bn)
+    bl, bkr, bj = b_panel // (pr * pc), (b_panel // pc) % pr, b_panel % pc
+    bi0 = (bkr - bj) % pr if cannon else bkr
+    b_flat = ((bl * pr + bi0) * pc + bj) * (cap_b + xtr) + b_slots
+    b_asm = _make_bin_asm(b, b_flat, kl * pr * pc * (cap_b + xtr), bk, bn)
 
     cinit_asm = None
     if matrix_c is not None and matrix_c.nblks:
         pos_old = np.searchsorted(c_keys, old_keys)
         cinit_flat = (
-            rdist[c_rows[pos_old]] * s + cdist[c_cols[pos_old]]
+            rdist[c_rows[pos_old]] * pc + cdist[c_cols[pos_old]]
         ) * cap_c + c_slots[pos_old]
-        cinit_asm = _make_bin_asm(matrix_c, cinit_flat, s * s * cap_c, bm, bn)
+        cinit_asm = _make_bin_asm(matrix_c, cinit_flat, pr * pc * cap_c, bm, bn)
 
     # windowed-beta semantics: C blocks outside the limit window keep
     # their old values (factor 1.0 instead of beta)
@@ -762,7 +868,7 @@ def _build_mesh_plan(a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
         ci = np.arange(bn)[None, :]
         mrow = (ri >= lo_r[:, None]) & (ri < hi_r[:, None])
         mcol = (ci >= lo_c[:, None]) & (ci < hi_c[:, None])
-        canvas = np.ones((s, s, cap_c, bm, bn), bool)
+        canvas = np.ones((pr, pc, cap_c, bm, bn), bool)
         canvas[rdist[c_rows], cdist[c_cols], c_slots] = (
             mrow[:, :, None] & mcol[:, None, :]
         )
@@ -771,7 +877,7 @@ def _build_mesh_plan(a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
         has_window = True
         inside = np.zeros(1, bool)  # keep_old must stay on
     elif has_window and not inside.all():
-        canvas = np.ones((s, s, cap_c), bool)
+        canvas = np.ones((pr, pc, cap_c), bool)
         canvas[rdist[c_rows], cdist[c_cols], c_slots] = inside
         inside_dev = jax.device_put(canvas, NamedSharding(mesh, P("pr", "pc")))
         inside_bytes = canvas.nbytes
@@ -800,11 +906,11 @@ def _build_mesh_plan(a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
 
     out_dist = (
         matrix_c.dist
-        if matrix_c is not None and matrix_c.dist.grid.nprows == s
-        and matrix_c.dist.grid.npcols == s
+        if matrix_c is not None and matrix_c.dist.grid.nprows == pr
+        and matrix_c.dist.grid.npcols == pc
         else Distribution(
             rdist.astype(np.int32), cdist.astype(np.int32),
-            ProcessGrid(s, s, mesh),
+            ProcessGrid(pr, pc, mesh),
         )
     )
 
@@ -816,7 +922,8 @@ def _build_mesh_plan(a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
     )
     acc_name = "float32" if np.dtype(dtype).name == "bfloat16" else np.dtype(dtype).name
     return _MeshPlan(
-        s=s, kl=kl, r0=r0, xtr=xtr, cap_a=cap_a, cap_b=cap_b, cap_c=cap_c,
+        s=pr, pc=pc, nticks=nticks,
+        kl=kl, r0=r0, xtr=xtr, cap_a=cap_a, cap_b=cap_b, cap_c=cap_c,
         bm=bm, bk=bk, bn=bn, dtype=np.dtype(dtype), acc_name=acc_name,
         true_flops=true_flops, n_cand=len(rows_t), stacks_dev=stacks_dev,
         a_asm=a_asm, b_asm=b_asm, cinit_asm=cinit_asm,
@@ -833,9 +940,8 @@ def _build_mesh_plan(a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
 def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
                           limits=(None,) * 6, retain_sparsity=False,
                           filter_eps=None, element_limits=None):
-    kl, s = mesh.shape["kl"], mesh.shape["pr"]
-    if mesh.shape["pc"] != s:
-        raise ValueError("sparse Cannon needs a square ('pr','pc') grid")
+    kl, pr, pc = mesh.shape["kl"], mesh.shape["pr"], mesh.shape["pc"]
+    cannon = pr == pc
     # accumulate in C's dtype when C is given (host-path convention)
     a, b, matrix_c, dtype, bm, bk, bn = _prepare_operands(
         matrix_a, matrix_b, matrix_c
@@ -869,10 +975,12 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     shell_for_gate = matrix_c if matrix_c is not None else BlockSparseMatrix(
         name or f"{a.name}*{b.name}", a.row_blk_sizes, b.col_blk_sizes, dtype
     )
-    if _dense_mode_wanted(a, b, shell_for_gate, filter_eps, retain_sparsity,
-                          no_limits):
+    if cannon and _dense_mode_wanted(a, b, shell_for_gate, filter_eps,
+                                     retain_sparsity, no_limits):
+        # (the dense 2.5D Cannon is square-grid only; rectangular
+        # grids keep the sparse all-gather route)
         return _dense_multiply_mesh(
-            alpha, a, b, beta, matrix_c, mesh, name, dtype, s, kl
+            alpha, a, b, beta, matrix_c, mesh, name, dtype, pr, kl
         )
 
     r0 = _stack_r0(dtype)
@@ -898,7 +1006,7 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     if plan is None:
         with timed("mesh_plan_build"):
             plan = _build_mesh_plan(
-                a, b, matrix_c, mesh, s, kl, dtype, bm, bk, bn, r0,
+                a, b, matrix_c, mesh, pr, pc, kl, dtype, bm, bk, bn, r0,
                 limits, retain_sparsity, filter_eps, beta_window,
             )
         if plan_key is not None:
@@ -912,19 +1020,19 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     # ---- device-side panel assembly (cached by bin data identity) ----
     spec3 = P("kl", "pr", "pc")
     a_panels = _cached_panels(
-        plan, "a", a, mesh, (kl, s, s, cap_a + xtr, bm, bk), spec3
+        plan, "a", a, mesh, (kl, pr, pc, cap_a + xtr, bm, bk), spec3
     )
     b_panels = _cached_panels(
-        plan, "b", b, mesh, (kl, s, s, cap_b + xtr, bk, bn), spec3
+        plan, "b", b, mesh, (kl, pr, pc, cap_b + xtr, bk, bn), spec3
     )
 
     keep_old = beta != 0 or (plan.has_window and not plan.inside_all)
     if plan.cinit_asm is not None and keep_old:
         c_flat = _run_bin_asm(plan.cinit_asm, matrix_c, dtype)
     else:
-        c_flat = jnp.zeros((s * s * cap_c, bm, bn), dtype)
+        c_flat = jnp.zeros((pr * pc * cap_c, bm, bn), dtype)
     c_init = jax.device_put(
-        c_flat.reshape(s, s, cap_c, bm, bn), NamedSharding(mesh, P("pr", "pc"))
+        c_flat.reshape(pr, pc, cap_c, bm, bn), NamedSharding(mesh, P("pr", "pc"))
     )
 
     if plan.inside_dev is not None:
@@ -933,16 +1041,24 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
             jnp.asarray(beta, dtype), jnp.asarray(1, dtype),
         )
     else:
-        beta_fac = jnp.full((s, s, cap_c), beta, dtype)
+        beta_fac = jnp.full((pr, pc, cap_c), beta, dtype)
     beta_fac = jax.device_put(beta_fac, NamedSharding(mesh, P("pr", "pc")))
 
     # ---- run on the mesh ----
-    c_out = _run_sparse_cannon(
-        a_panels, b_panels, plan.stacks_dev, c_init,
-        jnp.asarray(alpha, dtype), beta_fac,
-        s=s, cap_c=cap_c, acc_name=plan.acc_name,
-        mesh_ref=_HashableMesh(mesh), r0=r0,
-    )
+    if cannon:
+        c_out = _run_sparse_cannon(
+            a_panels, b_panels, plan.stacks_dev, c_init,
+            jnp.asarray(alpha, dtype), beta_fac,
+            s=pr, cap_c=cap_c, acc_name=plan.acc_name,
+            mesh_ref=_HashableMesh(mesh), r0=r0,
+        )
+    else:
+        c_out = _run_sparse_allgather(
+            a_panels, b_panels, plan.stacks_dev, c_init,
+            jnp.asarray(alpha, dtype), beta_fac,
+            nticks=plan.nticks, cap_c=cap_c, acc_name=plan.acc_name,
+            mesh_ref=_HashableMesh(mesh), r0=r0,
+        )
 
     # ---- device-side collect into shape bins (C stays resident) ----
     out = BlockSparseMatrix(
@@ -952,7 +1068,7 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     )
     if len(plan.c_keys):
         bin_datas = _collect_bins(
-            c_out.reshape(s * s * cap_c, bm, bn),
+            c_out.reshape(pr * pc * cap_c, bm, bn),
             plan.collect_pos, plan.collect_slots,
             caps=plan.collect_caps, shapes=plan.collect_shapes,
         )
@@ -979,19 +1095,27 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     # collective-traffic accounting (ref count_mpi_statistics,
     # dbcsr_mm_common.F:135): each tick ppermutes every device's A and B
     # panel; the layer reduction psums each device's C panel
-    ndev = kl * s * s
+    ndev = kl * pr * pc
     itemsize = np.dtype(dtype).itemsize
-    if s > 1:
+    if cannon and pr > 1:
         stats.record_comm(
-            "ppermute", 2 * s * ndev,
-            s * ndev * (cap_a * bm * bk + cap_b * bk * bn) * itemsize,
+            "ppermute", 2 * pr * ndev,
+            pr * ndev * (cap_a * bm * bk + cap_b * bk * bn) * itemsize,
+        )
+    elif not cannon:
+        # all-gather model: every device receives the other pc-1 (A)
+        # / pr-1 (B) panels of its gather group once
+        stats.record_comm(
+            "all_gather", 2 * ndev,
+            ndev * ((pc - 1) * cap_a * bm * bk + (pr - 1) * cap_b * bk * bn)
+            * itemsize,
         )
     if kl > 1:
         # ring-reduce model: each of the kl-1 steps moves every
         # (pr,pc) position's C panel once
         stats.record_comm(
-            "psum", (kl - 1) * s * s,
-            (kl - 1) * s * s * cap_c * bm * bn * itemsize,
+            "psum", (kl - 1) * pr * pc,
+            (kl - 1) * pr * pc * cap_c * bm * bn * itemsize,
         )
     out._last_flops = plan.true_flops  # true flop count of this product
     out._mm_algorithm = "stack"
@@ -1311,7 +1435,12 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
                       filter_eps, nsplit=None):
     g, s = mesh.shape["kl"], mesh.shape["pr"]
     if mesh.shape["pc"] != s:
-        raise ValueError("grouped Cannon needs a square ('pr','pc') grid")
+        raise ValueError(
+            "the grouped TAS mesh path needs a square ('pr','pc') grid; "
+            "rebuild the mesh with make_grid/optimize_grid (square "
+            "preferred automatically), or use sparse_multiply_distributed, "
+            "whose all-gather engine supports rectangular grids"
+        )
     a, b, matrix_c, dtype, bm, bk, bn = _prepare_operands(
         matrix_a, matrix_b, matrix_c
     )

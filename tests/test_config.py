@@ -91,18 +91,19 @@ def test_tas_split_factor_scales_nsplit():
 def test_num_layers_3d_shapes_default_grid():
     from dbcsr_tpu.parallel.mesh import grid_shape
 
-    assert grid_shape(8) == (2, 2)  # auto: largest square
+    assert grid_shape(8) == (2, 2, 2)  # auto: largest square
     set_config(num_layers_3d=8)
     try:
-        assert grid_shape(8) == (8, 1)
+        assert grid_shape(8) == (8, 1, 1)
     finally:
         set_config(num_layers_3d=0)
-    assert grid_shape(8, layers=2) == (2, 2)  # explicit wins
+    assert grid_shape(8, layers=2) == (2, 2, 2)  # explicit wins
     # num_layers_3d=1 is honored (forces a 2D grid), not treated as auto
     set_config(num_layers_3d=1)
     try:
-        assert grid_shape(4) == (1, 2)
-        with pytest.raises(ValueError):
-            grid_shape(8)  # 8 devices cannot form a 1-layer square grid
+        assert grid_shape(4) == (1, 2, 2)
+        # 8 devices in one layer: no square grid exists, so the policy
+        # goes rectangular (all-gather engine) instead of raising
+        assert grid_shape(8) == (1, 2, 4)
     finally:
         set_config(num_layers_3d=0)

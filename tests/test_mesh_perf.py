@@ -1,0 +1,32 @@
+"""Mesh residency performance guard.
+
+Pins the rank-residency property (pattern-keyed mesh plans + on-device
+panel assembly): repeat same-pattern multiplies must not re-stage, so
+reps after the first must be MUCH cheaper (the round-2 3476 -> 39 ms
+win; ref the perf driver's repeat timings,
+`tests/dbcsr_performance_driver.F`).  The committed artifact lives in
+BENCH_MESH.json (`tools/mesh_perf.py`); this test keeps the property
+from silently regressing.
+
+Bounds are deliberately loose (CI-machine variance; 8 virtual devices
+share one host CPU): the failure mode being guarded — plans or panels
+rebuilt every rep — costs an order of magnitude, not a factor.
+"""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+
+def test_mesh_residency_speedup():
+    from mesh_perf import run
+
+    out = run(nrep=4, nblk=30)
+    # rep 2+ must be far cheaper than the plan-building first rep
+    assert out["residency_speedup"] >= 3.0, out
+    # and within an order of magnitude of the single-chip engine (the
+    # virtual mesh adds collective overhead on one shared CPU; a
+    # restaging regression costs ~90x, which this still catches)
+    assert out["vs_single_chip"] <= 30.0, out

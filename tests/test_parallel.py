@@ -21,13 +21,18 @@ from dbcsr_tpu.parallel import (
 
 
 def test_grid_shape():
-    assert grid_shape(1) == (1, 1)
-    assert grid_shape(4) == (1, 2)
-    assert grid_shape(8) == (2, 2)
-    assert grid_shape(9) == (1, 3)
-    assert grid_shape(16) == (1, 4)
-    assert grid_shape(2) == (2, 1)
-    assert grid_shape(8, layers=8) == (8, 1)
+    assert grid_shape(1) == (1, 1, 1)
+    assert grid_shape(4) == (1, 2, 2)
+    assert grid_shape(8) == (2, 2, 2)
+    assert grid_shape(9) == (1, 3, 3)
+    assert grid_shape(16) == (1, 4, 4)
+    assert grid_shape(8, layers=8) == (8, 1, 1)
+    # counts without a usable square factor go rectangular (all-gather
+    # engine; ref arbitrary nprows x npcols grids, dbcsr_types.F:188)
+    assert grid_shape(2) == (1, 1, 2)
+    assert grid_shape(6) == (1, 2, 3)
+    assert grid_shape(8, layers=1) == (1, 2, 4)
+    assert grid_shape(12) == (3, 2, 2)  # square preferred when possible
 
 
 @pytest.mark.parametrize("ndev,layers", [(1, None), (4, None), (8, None), (8, 8), (4, 4)])

@@ -644,3 +644,138 @@ def test_tas_grouped_residency_no_restaging(mesh8):
     assert checksum(c1) == checksum(c2)
     stats.reset()
     clear_mesh_plans()
+
+
+# ---------------------------------------------------------------------------
+# Rectangular grids (all-gather engine; ref arbitrary nprows x npcols
+# grids via image distributions, dbcsr_types.F:188-223,
+# dbcsr_mm_dist_operations.F:58)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mesh6():
+    return make_grid(6)  # (kl=1, pr=2, pc=3)
+
+
+def test_rect_grid_shapes():
+    assert dict(make_grid(6).shape) == {"kl": 1, "pr": 2, "pc": 3}
+    assert dict(make_grid(8, layers=1).shape) == {"kl": 1, "pr": 2, "pc": 4}
+
+
+def test_rect_sparse_multiply_mixed_blocks(mesh6):
+    rng = np.random.default_rng(61)
+    rbs = rng.choice([2, 3, 5], 11)
+    kbs = rng.choice([4, 2], 9)
+    cbs = rng.choice([3, 6], 13)
+    a = _rand("A", rbs, kbs, 0.4, 62)
+    b = _rand("B", kbs, cbs, 0.4, 63)
+    c = sparse_multiply_distributed(-0.5, a, b, 0.0, None, mesh6)
+    np.testing.assert_allclose(
+        to_dense(c), -0.5 * (to_dense(a) @ to_dense(b)), rtol=1e-12, atol=1e-12
+    )
+
+
+def test_rect_8dev_one_layer_beta():
+    mesh = make_grid(8, layers=1)  # (1, 2, 4)
+    rbs = [3] * 9
+    a = _rand("A", rbs, rbs, 0.5, 64)
+    b = _rand("B", rbs, rbs, 0.5, 65)
+    c0 = _rand("C", rbs, rbs, 0.3, 66)
+    c = sparse_multiply_distributed(2.0, a, b, 0.5, c0, mesh)
+    want = 2.0 * to_dense(a) @ to_dense(b) + 0.5 * to_dense(c0)
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-12, atol=1e-12)
+
+
+def test_rect_with_k_layers():
+    mesh = make_grid(6, layers=2)  # (2, 1, 3): layers + rectangular
+    rbs = [4] * 8
+    a = _rand("A", rbs, rbs, 0.5, 67)
+    b = _rand("B", rbs, rbs, 0.5, 68)
+    c = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh)
+    np.testing.assert_allclose(
+        to_dense(c), to_dense(a) @ to_dense(b), rtol=1e-12, atol=1e-12
+    )
+
+
+def test_rect_r_tiled_stacks(mesh6):
+    """Forced xla_group exercises the R-tiled stack layout against the
+    GATHERED panel indexing (in-tile pads must hit the zero rows)."""
+    from dbcsr_tpu.core.config import set_config
+
+    rbs = [3] * 10
+    a = _rand("A", rbs, rbs, 0.5, 69)
+    b = _rand("B", rbs, rbs, 0.5, 70)
+    set_config(mm_driver="xla_group")
+    try:
+        c = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh6)
+    finally:
+        set_config(mm_driver="auto")
+    np.testing.assert_allclose(
+        to_dense(c), to_dense(a) @ to_dense(b), rtol=1e-12, atol=1e-12
+    )
+
+
+def test_rect_filter_eps_matches_single_chip(mesh6):
+    from dbcsr_tpu import create, multiply
+
+    rbs = [4] * 9
+    a = _rand("A", rbs, rbs, 0.5, 71)
+    b = _rand("B", rbs, rbs, 0.5, 72)
+    eps = 0.4
+    c_mesh = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh6,
+                                         filter_eps=eps)
+    c_ref = create("Cref", rbs, rbs, dtype=np.float64)
+    multiply("N", "N", 1.0, a, b, 0.0, c_ref, filter_eps=eps)
+    np.testing.assert_allclose(to_dense(c_mesh), to_dense(c_ref),
+                               rtol=1e-12, atol=1e-12)
+    assert set(map(tuple, np.argwhere(to_dense(c_mesh) != 0).tolist())) == set(
+        map(tuple, np.argwhere(to_dense(c_ref) != 0).tolist())
+    )
+
+
+def test_rect_deterministic(mesh6):
+    rbs = [4] * 10
+    a = _rand("A", rbs, rbs, 0.4, 73)
+    b = _rand("B", rbs, rbs, 0.4, 74)
+    cks = {checksum(sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh6))
+           for _ in range(3)}
+    assert len(cks) == 1
+
+
+def test_rect_block_limits(mesh6):
+    from dbcsr_tpu import create, multiply
+
+    rbs = [4] * 9
+    a = _rand("A", rbs, rbs, 0.6, 75)
+    b = _rand("B", rbs, rbs, 0.6, 76)
+    c_mesh = sparse_multiply_distributed(
+        1.0, a, b, 0.0, None, mesh6, first_row=2, last_row=6,
+        first_col=1, last_col=7,
+    )
+    c_ref = create("Cref", rbs, rbs, dtype=np.float64)
+    multiply("N", "N", 1.0, a, b, 0.0, c_ref, first_row=2, last_row=6,
+             first_col=1, last_col=7)
+    np.testing.assert_allclose(to_dense(c_mesh), to_dense(c_ref),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_rect_complex128(mesh6):
+    rbs = [3] * 8
+    a = _rand("A", rbs, rbs, 0.5, 77, dtype=np.complex128)
+    b = _rand("B", rbs, rbs, 0.5, 78, dtype=np.complex128)
+    c = sparse_multiply_distributed(1.0 + 0.5j, a, b, 0.0, None, mesh6)
+    np.testing.assert_allclose(
+        to_dense(c), (1.0 + 0.5j) * (to_dense(a) @ to_dense(b)),
+        rtol=1e-12, atol=1e-12,
+    )
+
+
+def test_rect_comm_statistics(mesh6):
+    from dbcsr_tpu.core import stats
+
+    rbs = [4] * 8
+    a = _rand("A", rbs, rbs, 0.5, 79)
+    b = _rand("B", rbs, rbs, 0.5, 80)
+    stats.reset()
+    sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh6)
+    assert "all_gather" in stats._comm and stats._comm["all_gather"].nbytes > 0

@@ -1,0 +1,97 @@
+"""Mesh-path performance artifact: the north-star-shaped config on the
+virtual 8-device CPU mesh.
+
+Pins the rank-residency win (round-2/3 work: pattern-keyed mesh plans +
+device-side panel assembly mean repeat same-pattern multiplies upload
+nothing): rep 1 pays the plan build; reps 2+ must be cheap.  Writes ONE
+JSON line to BENCH_MESH.json — the committed evidence the round-3
+verdict asked for (reference analog: the perf driver's per-rank
+timings, `tests/dbcsr_performance_driver.F`).
+
+Usage: python tools/mesh_perf.py [nrep] [nblk]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+
+def run(nrep: int = 6, nblk: int = 50):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    import dbcsr_tpu as dt
+    from dbcsr_tpu.parallel import make_grid, sparse_multiply_distributed
+    from dbcsr_tpu.utils.sync import fetch_fence
+
+    dt.init_lib()
+    # north-star shape, scaled: nblk x nblk blocks of 23x23, occ 0.1,
+    # f64 (BASELINE.json is 10k^2 = 435 blocks/side at occupancy 0.1)
+    rbs = [23] * nblk
+    a = dt.make_random_matrix("A", rbs, rbs, dtype=np.float64,
+                              occupation=0.1, rng=np.random.default_rng(1))
+    b = dt.make_random_matrix("B", rbs, rbs, dtype=np.float64,
+                              occupation=0.1, rng=np.random.default_rng(2))
+    mesh = make_grid(8)
+
+    times = []
+    cks = set()
+    for _ in range(nrep):
+        t0 = time.perf_counter()
+        c = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh)
+        for bb in c.bins:  # force real completion of every bin
+            fetch_fence(bb.data)
+        times.append(time.perf_counter() - t0)
+        cks.add(dt.checksum(c))
+    assert len(cks) == 1, f"nondeterministic mesh multiply: {cks}"
+
+    # single-chip engine reference on the same inputs
+    sc_times = []
+    for _ in range(max(nrep - 1, 2)):
+        c1 = dt.create("C1", rbs, rbs, dtype=np.float64)
+        t0 = time.perf_counter()
+        dt.multiply("N", "N", 1.0, a, b, 0.0, c1)
+        for bb in c1.bins:
+            fetch_fence(bb.data)
+        sc_times.append(time.perf_counter() - t0)
+
+    resident = sorted(times[1:])[len(times[1:]) // 2]  # median rep 2+
+    out = {
+        "metric": f"mesh sparse_multiply resident ms ({nblk}x{nblk} blk 23^2, occ=0.1, f64, 8-dev CPU mesh)",
+        "value": round(resident * 1e3, 2),
+        "unit": "ms",
+        "first_rep_ms": round(times[0] * 1e3, 2),
+        "residency_speedup": round(times[0] / resident, 2),
+        "single_chip_ms": round(min(sc_times) * 1e3, 2),
+        "vs_single_chip": round(resident / min(sc_times), 2),
+        "nrep": nrep,
+        "device": "cpu-mesh-8",
+    }
+    return out
+
+
+def main():
+    nrep = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+    nblk = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    out = run(nrep, nblk)
+    line = json.dumps(out)
+    print(line)
+    with open(os.path.join(REPO, "BENCH_MESH.json"), "w") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
