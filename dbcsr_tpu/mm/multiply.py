@@ -177,7 +177,8 @@ def multiply(
         no_limits = all(
             x is None for x in (first_row, last_row, first_col, last_col, first_k, last_k)
         )
-        if _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits):
+        if _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits,
+                              allow_chunked=True):
             with timed("multiply_dense"):
                 c._mm_algorithm = "dense"
                 return _dense_multiply(a, b, c, alpha, beta)
@@ -275,7 +276,25 @@ def _true_product_flops(a, b) -> int:
 _DENSE_MAX_CANVAS = 2 * 10**8
 
 
-def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits) -> bool:
+def _dense_chunking(nbr, nbc, nbk, bm, bn, bk):
+    """(block-rows per m-strip, block-cols per k-strip) so every strip
+    canvas (A: m-strip x k-strip, B: k-strip x N, C: m-strip x N) fits
+    `_DENSE_MAX_CANVAS` elements, or None when even single-block strips
+    cannot fit (an n-chunked dense path is not implemented — such
+    products keep the stack path)."""
+    cap = _DENSE_MAX_CANVAS
+    n_el = nbc * bn
+    if bm * n_el > cap:
+        return None
+    mrb = min(nbr, max(1, cap // (bm * n_el)))
+    kcb = min(nbk, max(1, cap // (bk * max(mrb * bm, n_el))))
+    if (mrb * bm) * (kcb * bk) > cap or (kcb * bk) * n_el > cap:
+        return None
+    return mrb, kcb
+
+
+def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits,
+                       allow_chunked=False) -> bool:
     """Dense-mode decision (ref `dbcsr_mm.F:593-617`): near-full uniformly
     blocked matrices degrade gracefully to one dense MXU matmul.
 
@@ -317,7 +336,23 @@ def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits) -> bool:
         return False
     mm, nn, kk = a.nfullrows, b.nfullcols, a.nfullcols
     if max(mm * kk, kk * nn, mm * nn) > _DENSE_MAX_CANVAS:
-        return False
+        # beyond the canvas cap the dense route survives only via the
+        # k/m-strip chunked path (single-chip, uniform blockings) — the
+        # reference's dense mode is not size-capped (dbcsr_mm.F:593-617)
+        if not allow_chunked:
+            return False
+        if any(
+            len(np.unique(m.row_blk_sizes)) > 1
+            or len(np.unique(m.col_blk_sizes)) > 1
+            for m in (a, b, c)
+        ):
+            return False
+        if _dense_chunking(
+            a.nblkrows, c.nblkcols, a.nblkcols,
+            int(a.row_blk_sizes[0]), int(b.col_blk_sizes[0]),
+            int(a.col_blk_sizes[0]),
+        ) is None:
+            return False
     if _candidate_fill(a, b) < 0.5:
         return False
     dense_flops = 2.0 * mm * nn * kk
@@ -529,6 +564,9 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
     bn = int(c.col_blk_sizes[0])
     bk = int(a.col_blk_sizes[0])
     nbr, nbc, nbk = a.nblkrows, c.nblkcols, a.nblkcols
+    if max(a.nfullrows * a.nfullcols, a.nfullcols * b.nfullcols,
+           a.nfullrows * b.nfullcols) > _DENSE_MAX_CANVAS:
+        return _dense_multiply_chunked(a, b, c, alpha, beta)
     def _build(m, nr, nc_, brow, bcol):
         rows, cols = m.entry_coords()
         return _blocks_to_dense(
@@ -555,6 +593,137 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
     pad = cap - len(new_keys)
     if pad:
         out = jnp.concatenate([out, jnp.zeros((pad, bm, bn), out.dtype)])
+    c.set_structure_from_device(new_keys, [_Bin((bm, bn), out, len(new_keys))])
+    stats.record_stack(bm, bn, bk, nbr * nbc * nbk, driver="dense")
+    stats.record_multiply(2 * nbr * bm * nbc * bn * nbk * bk)
+    return _true_product_flops(a, b)
+
+
+@functools.partial(
+    jax.jit, donate_argnums=0,
+    static_argnames=("m_el", "k_el", "n_el", "bm", "bn", "bk"),
+)
+def _dense_strip_matmul(cd, a_data, a_ro, a_co, b_data, b_ro, b_co,
+                        *, m_el, k_el, n_el, bm, bn, bk):
+    """One (m-strip x k-strip) @ (k-strip x N) canvas accumulation.
+    Operand strips are scattered from the FULL bin buffers with
+    out-of-strip blocks carrying dropped (out-of-range) offsets, so the
+    jit shape is the stable bucket capacity for every strip."""
+    ad = _scatter_bin_to_canvas(
+        jnp.zeros((m_el, k_el), a_data.dtype), a_data, a_ro, a_co,
+        bm=bm, bn=bk,
+    )
+    bd = _scatter_bin_to_canvas(
+        jnp.zeros((k_el, n_el), b_data.dtype), b_data, b_ro, b_co,
+        bm=bk, bn=bn,
+    )
+    return cd + jax.lax.dot_general(
+        ad, bd, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=cd.dtype,
+    )
+
+
+@functools.partial(
+    jax.jit, donate_argnums=0,
+    static_argnames=("nbc", "bm", "bn", "rows"),
+)
+def _dense_strip_to_blocks(cd, c_blocks, strip_pos, alpha, beta,
+                           *, nbc, bm, bn, rows):
+    """Carve one C m-strip canvas into its full row-major block pattern
+    and merge beta*old (strip_pos: old block -> strip-local full-pattern
+    position, out-of-strip dropped)."""
+    keys = jnp.arange(rows * nbc, dtype=jnp.int32)
+    ro = (keys // nbc) * bm
+    co = (keys % nbc) * bn
+    out = alpha * _gather_bin_from_canvas(cd, ro, co, bm=bm, bn=bn)
+    return out.at[strip_pos].add(beta * c_blocks.astype(out.dtype), mode="drop")
+
+
+def _dense_multiply_chunked(a, b, c, alpha, beta) -> int:
+    """Dense mode beyond the canvas cap: tile over k-strips (and
+    m-strips when the C canvas itself is too big), keeping every live
+    canvas under `_DENSE_MAX_CANVAS` elements while the product stays
+    on the dense MXU route (the reference's dense mode has no size cap,
+    `dbcsr_mm.F:593-617`; this is its big-matrix realization)."""
+    bm = int(c.row_blk_sizes[0])
+    bn = int(c.col_blk_sizes[0])
+    bk = int(a.col_blk_sizes[0])
+    nbr, nbc, nbk = a.nblkrows, c.nblkcols, a.nblkcols
+    chunking = _dense_chunking(nbr, nbc, nbk, bm, bn, bk)
+    if chunking is None:
+        # reached via the forced/occupancy gates (which skip the
+        # feasibility check): no strip shape fits the cap, so keep the
+        # pre-chunking single-canvas behavior rather than crash
+        return _dense_multiply_general(a, b, c, alpha, beta)
+    mrb, kcb = chunking
+    nms = -(-nbr // mrb)
+    nks = -(-nbk // kcb)
+
+    ar, ac = a.entry_coords()
+    br_, bc_ = b.entry_coords()
+    a_data = (a.bins[0].data[: a.nblks] if a.nblks
+              else jnp.zeros((0, bm, bk), c.dtype))
+    b_data = (b.bins[0].data[: b.nblks] if b.nblks
+              else jnp.zeros((0, bk, bn), c.dtype))
+    c_data = (c.bins[0].data[: c.nblks] if c.nblks
+              else jnp.zeros((0, bm, bn), c.dtype))
+    c_rows = (c.keys // nbc).astype(np.int64)
+    c_cols = (c.keys % nbc).astype(np.int64)
+    # dropped by mode="drop" scatters.  MUST stay out of bounds after
+    # jax's int32 scatter-index narrowing (1<<40 would truncate to 0 and
+    # land IN bounds); 2^30 is far beyond any canvas dim (cap 2e8) and
+    # int32-safe even after + block offsets
+    oor = np.int64(1) << 30
+
+    def strip_off(coords, lo, hi, blk):
+        off = (coords - lo) * blk
+        return np.where((coords >= lo) & (coords < hi), off, oor)
+
+    alpha_dev = jnp.asarray(alpha, dtype=c.dtype)
+    beta_dev = jnp.asarray(beta, dtype=c.dtype)
+    acc = np.dtype(c.dtype)
+    # per-k-strip offsets depend only on ks: compute/upload once, not
+    # once per (ms, ks)
+    a_ko_ks = []
+    b_dev_ks = []
+    for ks in range(nks):
+        k0, k1 = ks * kcb, min(nbk, (ks + 1) * kcb)
+        a_ko_ks.append(strip_off(ac, k0, k1, bk))
+        b_ko = strip_off(br_, k0, k1, bk)
+        b_co = np.where(b_ko == oor, oor, (bc_ * bn).astype(np.int64))
+        b_dev_ks.append((jnp.asarray(b_ko), jnp.asarray(b_co)))
+    parts = []
+    for ms in range(nms):
+        r0, r1 = ms * mrb, min(nbr, (ms + 1) * mrb)
+        cd = jnp.zeros((mrb * bm, nbc * bn), acc)
+        a_ro_ms = strip_off(ar, r0, r1, bm)
+        for ks in range(nks):
+            a_ko = a_ko_ks[ks]
+            # drop a block when EITHER axis is out of strip
+            a_ro = np.where(a_ko == oor, oor, a_ro_ms)
+            cd = _dense_strip_matmul(
+                cd, a_data, jnp.asarray(a_ro), jnp.asarray(a_ko),
+                b_data, *b_dev_ks[ks],
+                m_el=mrb * bm, k_el=kcb * bk, n_el=nbc * bn,
+                bm=bm, bn=bn, bk=bk,
+            )
+        strip_pos = np.where(
+            (c_rows >= r0) & (c_rows < r1),
+            (c_rows - r0) * nbc + c_cols, oor,
+        )
+        out = _dense_strip_to_blocks(
+            cd, c_data, jnp.asarray(strip_pos), alpha_dev, beta_dev,
+            nbc=nbc, bm=bm, bn=bn, rows=mrb,
+        )
+        parts.append(out[: (r1 - r0) * nbc])
+    out = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+    new_keys = np.arange(nbr * nbc, dtype=np.int64)
+    cap = bucket_size(len(new_keys))
+    if cap > len(new_keys):
+        out = jnp.concatenate(
+            [out, jnp.zeros((cap - len(new_keys), bm, bn), out.dtype)]
+        )
     c.set_structure_from_device(new_keys, [_Bin((bm, bn), out, len(new_keys))])
     stats.record_stack(bm, bn, bk, nbr * nbc * nbk, driver="dense")
     stats.record_multiply(2 * nbr * bm * nbc * bn * nbk * bk)

@@ -345,11 +345,25 @@ def _resolve_maps(a, b, matrix_c, pr: int, pc: int, kl: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("s", "cap_c", "acc_name", "mesh_ref", "r0"),
+    jax.jit,
+    static_argnames=("s", "nticks", "gather", "cap_c", "acc_name",
+                     "mesh_ref", "r0"),
 )
-def _run_sparse_cannon(a_panels, b_panels, stacks, c_init, alpha, beta_fac,
-                       *, s, cap_c, acc_name, mesh_ref, r0=0):
-    """``beta_fac`` is a per-C-slot (s, s, cap_c) factor: scalar beta
+def _run_sparse_mesh(a_panels, b_panels, stacks, c_init, alpha, beta_fac,
+                     *, s, nticks, gather, cap_c, acc_name, mesh_ref, r0=0):
+    """The one mesh runner behind both sparse engines.
+
+    ``gather=False``: square-grid skewed Cannon — s alignment ticks,
+    ring-shifting A along 'pc' / B along 'pr'.
+    ``gather=True``: rectangular-grid all-gather engine — A panels live
+    at their k home column and are `all_gather`ed along 'pc' (B along
+    'pr'), then nticks shift-free stack chunks run (the TPU-native
+    realization of arbitrary nprows x npcols grids via image
+    distributions, `dbcsr_mm_dist_operations.F:58`,
+    `dbcsr_types.F:188-223`: one XLA collective on ICI instead of
+    lcm(pr,pc) skew ticks).
+
+    ``beta_fac`` is a per-C-slot (pr, pc, cap_c) factor: scalar beta
     everywhere normally; with block limits, 1.0 for blocks outside the
     limited window so they keep their old values (windowed-beta
     semantics shared with the single-chip engine)."""
@@ -357,61 +371,18 @@ def _run_sparse_cannon(a_panels, b_panels, stacks, c_init, alpha, beta_fac,
     acc_dtype = jnp.dtype(acc_name)
 
     def body(a_p, b_p, st, c_in, alpha, beta_fac):
-        a = a_p.reshape(a_p.shape[3:])  # (cap_a, bm, bk)
+        a = a_p.reshape(a_p.shape[3:])  # (cap_a + xtr, bm, bk)
         b = b_p.reshape(b_p.shape[3:])
-        st = st.reshape(st.shape[3:])  # (s, s_cap, 3) or (s, G_cap, 2*r0+1)
+        st = st.reshape(st.shape[3:])  # (nticks, s_cap, 3 or 2*r0+1)
         c_in = c_in.reshape(c_in.shape[2:])  # (cap_c, bm, bn)
         fac = beta_fac.reshape(beta_fac.shape[2:])  # (cap_c,) or (cap_c,bm,bn)
         if fac.ndim == 1:
             fac = fac[:, None, None]
-        c = _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=r0)
-        c = jax.lax.psum(c, "kl")
-        c = (alpha * c + fac * c_in.astype(acc_dtype)).astype(c_in.dtype)
-        return c.reshape((1, 1) + c.shape)
-
-    fn = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(
-            P("kl", "pr", "pc"),
-            P("kl", "pr", "pc"),
-            P("kl", "pr", "pc"),
-            P("pr", "pc"),
-            P(),
-            P("pr", "pc"),
-        ),
-        out_specs=P("pr", "pc"),
-    )
-    return fn(a_panels, b_panels, stacks, c_init, alpha, beta_fac)
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("nticks", "cap_c", "acc_name", "mesh_ref", "r0"),
-)
-def _run_sparse_allgather(a_panels, b_panels, stacks, c_init, alpha, beta_fac,
-                          *, nticks, cap_c, acc_name, mesh_ref, r0=0):
-    """Rectangular-grid engine: A panels live at their k home column and
-    are `all_gather`ed along 'pc' (B along 'pr'), then the stack chunks
-    run with no ring shifts.  The TPU-native realization of running on
-    an arbitrary nprows x npcols grid via image distributions
-    (`dbcsr_mm_dist_operations.F:58`, `dbcsr_types.F:188-223`): one XLA
-    collective rides ICI instead of lcm(pr,pc) skew ticks."""
-    mesh = mesh_ref.val
-    acc_dtype = jnp.dtype(acc_name)
-
-    def body(a_p, b_p, st, c_in, alpha, beta_fac):
-        a = a_p.reshape(a_p.shape[3:])  # (cap_a + xtr, bm, bk)
-        b = b_p.reshape(b_p.shape[3:])
-        st = st.reshape(st.shape[3:])   # (nticks, cap, w)
-        c_in = c_in.reshape(c_in.shape[2:])
-        fac = beta_fac.reshape(beta_fac.shape[2:])
-        if fac.ndim == 1:
-            fac = fac[:, None, None]
-        a_all = jax.lax.all_gather(a, "pc", axis=0, tiled=True)
-        b_all = jax.lax.all_gather(b, "pr", axis=0, tiled=True)
-        c = _cannon_tick_loop(a_all, b_all, st, 0, cap_c, acc_dtype,
-                              r0=r0, nticks=nticks)
+        if gather:
+            a = jax.lax.all_gather(a, "pc", axis=0, tiled=True)
+            b = jax.lax.all_gather(b, "pr", axis=0, tiled=True)
+        c = _cannon_tick_loop(a, b, st, 0 if gather else s, cap_c,
+                              acc_dtype, r0=r0, nticks=nticks)
         c = jax.lax.psum(c, "kl")
         c = (alpha * c + fac * c_in.astype(acc_dtype)).astype(c_in.dtype)
         return c.reshape((1, 1) + c.shape)
@@ -1045,20 +1016,12 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     beta_fac = jax.device_put(beta_fac, NamedSharding(mesh, P("pr", "pc")))
 
     # ---- run on the mesh ----
-    if cannon:
-        c_out = _run_sparse_cannon(
-            a_panels, b_panels, plan.stacks_dev, c_init,
-            jnp.asarray(alpha, dtype), beta_fac,
-            s=pr, cap_c=cap_c, acc_name=plan.acc_name,
-            mesh_ref=_HashableMesh(mesh), r0=r0,
-        )
-    else:
-        c_out = _run_sparse_allgather(
-            a_panels, b_panels, plan.stacks_dev, c_init,
-            jnp.asarray(alpha, dtype), beta_fac,
-            nticks=plan.nticks, cap_c=cap_c, acc_name=plan.acc_name,
-            mesh_ref=_HashableMesh(mesh), r0=r0,
-        )
+    c_out = _run_sparse_mesh(
+        a_panels, b_panels, plan.stacks_dev, c_init,
+        jnp.asarray(alpha, dtype), beta_fac,
+        s=pr, nticks=plan.nticks, gather=not cannon, cap_c=cap_c,
+        acc_name=plan.acc_name, mesh_ref=_HashableMesh(mesh), r0=r0,
+    )
 
     # ---- device-side collect into shape bins (C stays resident) ----
     out = BlockSparseMatrix(

@@ -9,6 +9,11 @@ matrix per group, multiply per group, reduce
 """
 
 from dbcsr_tpu.tas.base import TASMatrix
-from dbcsr_tpu.tas.split import estimate_split_factor, choose_nsplit
+from dbcsr_tpu.tas.split import (
+    choose_nsplit,
+    choose_nsplit_traffic,
+    estimate_split_factor,
+    estimate_split_traffic,
+)
 from dbcsr_tpu.tas.mm import tas_multiply
 from dbcsr_tpu.tas.batched import batched_mm, batched_mm_init, batched_mm_finalize

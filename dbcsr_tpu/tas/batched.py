@@ -28,7 +28,12 @@ def batched_mm_init(
     m = _matrix(matrix_c)
     if getattr(m, "_tas_batched_state", None) is not None:
         raise RuntimeError("matrix already in a batched TAS multiply")
-    m._tas_batched_state = {"filter_eps": None, "nsplit": nsplit}
+    # an nsplit given at init is the USER's split: the between-batch
+    # re-optimizer must not override it (only auto-chosen splits float)
+    m._tas_batched_state = {
+        "filter_eps": None, "nsplit": nsplit,
+        "nsplit_explicit": nsplit is not None,
+    }
 
 
 def batched_mm_finalize(matrix_c: Union[TASMatrix, BlockSparseMatrix]) -> None:

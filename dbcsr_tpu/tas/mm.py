@@ -81,13 +81,36 @@ def tas_multiply(
             nsplit = batch.get("nsplit")
 
     with timed("tas_multiply"):
-        def _fresh_opt() -> int:
-            from dbcsr_tpu.core.config import get_config
+        dims = {"m": m_full, "n": n_full, "k": k_full}
+        long_dim = max(dims, key=dims.get)
 
+        def _fresh_opt() -> int:
+            import numpy as _np
+
+            from dbcsr_tpu.core.config import get_config
+            from dbcsr_tpu.tas.split import choose_nsplit_traffic
+
+            long_blks = max(c.nblkrows, c.nblkcols, nblk_k)
+            if mesh is not None and mesh.shape["pr"] == mesh.shape["pc"]:
+                # (rectangular grids: grouping cannot engage — the
+                # grouped path needs a square Cannon grid — so nsplit
+                # does not move traffic; keep the geometric estimate)
+                # mesh path: pick the split that minimizes MEASURED-model
+                # collective bytes (calibrated against the virtual-mesh
+                # traffic counters; the role of the reference's
+                # split-factor/pgrid acceptance machinery,
+                # `dbcsr_tas_mm.F:1427-1464`, `dbcsr_tas_split.F:207-281`)
+                g = choose_nsplit_traffic(
+                    long_dim, m_full, n_full, k_full, a.nnz, b.nnz, c.nnz,
+                    _np.dtype(c.dtype).itemsize,
+                    mesh.shape["kl"], mesh.shape["pr"],
+                    ngroups_max, long_blks,
+                )
+                if g is not None:
+                    return g
             sf = estimate_split_factor(
                 m_full, n_full, k_full, a.nnz, b.nnz, c.nnz
             ) * get_config().tas_split_factor  # ref TAS_SPLIT_FACTOR knob
-            long_blks = max(c.nblkrows, c.nblkcols, nblk_k)
             return choose_nsplit(sf, ngroups_max, long_blks)
 
         if nsplit is None:
@@ -100,6 +123,10 @@ def tas_multiply(
         if batch is not None:
             if explicit_nsplit or batch.get("nsplit") is None:
                 batch["nsplit"] = nsplit  # (re)set the batch's split
+                if explicit_nsplit:
+                    batch["nsplit_explicit"] = True
+            elif batch.get("nsplit_explicit"):
+                pass  # user-pinned split: no between-batch re-splitting
             else:
                 # split re-optimization between batches (the
                 # single-controller analog of the batched pgrid
@@ -125,8 +152,6 @@ def tas_multiply(
                         batch["nsplit"] = nsplit = opt
                         batch["resplit_count"] = batch.get("resplit_count", 0) + 1
 
-        dims = {"m": m_full, "n": n_full, "k": k_full}
-        long_dim = max(dims, key=dims.get)
         if mesh is not None:
             if batch is not None:
                 # batched pgrid re-optimization (ref the reference
@@ -204,7 +229,14 @@ def _tas_multiply_mesh(transa, transb, alpha, a, b, beta, c, filter_eps,
         return new_transposed(m, conjugate=(t == "C" and is_complex(m.dtype)))
 
     a_op, b_op = _op(a, transa), _op(b, transb)
-    grouped = nsplit > 1 and mesh.shape["kl"] > 1 and long_dim in ("m", "n")
+    # the grouped path runs per-group square Cannons: a rectangular
+    # ('pr','pc') grid cannot take it (falls back to the all-gather
+    # engine below, which supports any grid)
+    grouped = (
+        nsplit > 1 and mesh.shape["kl"] > 1
+        and mesh.shape["pr"] == mesh.shape["pc"]
+        and long_dim in ("m", "n")
+    )
     if grouped and long_dim == "m":
         acc = tas_grouped_multiply(
             alpha, a_op, b_op, beta, c, mesh, name=c.name,

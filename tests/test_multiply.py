@@ -405,3 +405,69 @@ def test_alpha_beta_scalar_typing():
                                rtol=1e-12, atol=1e-12)
     with pytest.raises(TypeError, match="complex alpha"):
         multiply("N", "N", 1.0 + 2.0j, a, b, 0.0, create("c", [2, 2], [2, 2]))
+
+
+# ---------------------------------------------------------------------------
+# Chunked dense mode (beyond the canvas cap; ref dbcsr_mm.F:593-617 —
+# the reference's dense mode has no size cap)
+# ---------------------------------------------------------------------------
+
+def test_dense_chunked_matches_stack_path(monkeypatch):
+    """With the canvas cap shrunk, the dense route must tile over
+    k/m-strips and stay exact (incl. beta accumulation)."""
+    import dbcsr_tpu as dt
+    from dbcsr_tpu.core.config import set_config
+    from dbcsr_tpu.mm import multiply as mm
+
+    monkeypatch.setattr(mm, "_DENSE_MAX_CANVAS", 5000)
+    rbs = [7] * 13
+    kbs = [7] * 17
+    cbs = [7] * 11
+    a = dt.make_random_matrix("A", rbs, kbs, occupation=0.6,
+                              rng=np.random.default_rng(1))
+    b = dt.make_random_matrix("B", kbs, cbs, occupation=0.6,
+                              rng=np.random.default_rng(2))
+    c0 = dt.make_random_matrix("C", rbs, cbs, occupation=0.3,
+                               rng=np.random.default_rng(3))
+    want = 1.5 * (dt.to_dense(a) @ dt.to_dense(b)) + 0.5 * dt.to_dense(c0)
+    assert mm._dense_chunking(13, 11, 17, 7, 7, 7) == (9, 9)
+    set_config(mm_dense=True)
+    try:
+        dt.multiply("N", "N", 1.5, a, b, 0.5, c0)
+    finally:
+        set_config(mm_dense=None)
+    assert c0._mm_algorithm == "dense"
+    np.testing.assert_allclose(dt.to_dense(c0), want, rtol=1e-12, atol=1e-12)
+
+
+def test_dense_chunked_gate_and_feasibility(monkeypatch):
+    """The cost-model route beyond the cap requires uniform blockings
+    (chunked path) — mixed blockings or an unchunkable geometry must
+    leave the gate closed.  (The occupancy-threshold route is
+    deliberately not size-capped, matching prior behavior.)"""
+    import dbcsr_tpu as dt
+    from dbcsr_tpu.mm import multiply as mm
+
+    monkeypatch.setattr(mm, "_DENSE_MAX_CANVAS", 2000)
+    # a single block row wider than the cap: no k-chunking can fit
+    assert mm._dense_chunking(4, 50, 4, 10, 10, 10) is None
+    # feasible uniform geometry chunks
+    assert mm._dense_chunking(13, 11, 17, 7, 7, 7) is not None
+
+    # LOW-occupancy mixed-blocking over-cap product: every dense route
+    # is closed (occupancy below threshold, cost model needs uniform)
+    rbs = [7] * 9
+    kbs = [7, 5] * 5
+    a = dt.make_random_matrix("A", rbs, kbs, occupation=0.3,
+                              rng=np.random.default_rng(4))
+    b = dt.make_random_matrix("B", kbs, rbs, occupation=0.3,
+                              rng=np.random.default_rng(5))
+    c = dt.create("C", rbs, rbs, dtype=np.float64)
+    assert not mm._dense_mode_wanted(a, b, c, None, False, True,
+                                     allow_chunked=True)
+    dt.multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert c._mm_algorithm == "stack"
+    np.testing.assert_allclose(
+        dt.to_dense(c), dt.to_dense(a) @ dt.to_dense(b),
+        rtol=1e-12, atol=1e-12,
+    )
