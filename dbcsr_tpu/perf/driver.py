@@ -379,6 +379,30 @@ def _mp_worker(cfg_path: str, port: int, nproc: int, pid: int,
     return 0
 
 
+def aggregate_rank_results(results: list) -> dict:
+    """World aggregation of per-rank MPRESULT records: verify the
+    cross-rank checksum contract and report the CONSERVATIVE world rate
+    — the slowest rank's best repeat sets the time, exactly as the
+    straggler sets an MPI world's wall clock
+    (ref per-rank reporting, `dbcsr_performance_multiply.F:452-515`)."""
+    checksums = {r["checksum"] for r in results}
+    if len(checksums) != 1:
+        raise RuntimeError(f"rank checksums differ: {sorted(checksums)}")
+    flops = results[0]["flops"]
+    t_max = max(r["time_best_s"] for r in results)
+    return {
+        "nproc": len(results),
+        "checksum": results[0]["checksum"],
+        "flops": flops,
+        # conservative world rate: slowest rank's best repeat
+        "gflops_world": flops / t_max / 1e9 if t_max > 0 else 0.0,
+        "gflops_mean_ranks": float(
+            np.mean([r["gflops_mean"] for r in results])
+        ),
+        "per_rank": results,
+    }
+
+
 def run_perf_multiproc(cfg_path: str, nproc: int, devices_per_proc: int = 4,
                        nrep: Optional[int] = None, timeout: float = 600,
                        verbose: bool = True) -> dict:
@@ -446,22 +470,7 @@ def run_perf_multiproc(cfg_path: str, nproc: int, devices_per_proc: int = 4,
     if len(results) != nproc:
         raise RuntimeError(f"got {len(results)}/{nproc} rank results:\n"
                            + "\n".join(o[-800:] for o in outs))
-    checksums = {r["checksum"] for r in results}
-    if len(checksums) != 1:
-        raise RuntimeError(f"rank checksums differ: {sorted(checksums)}")
-    flops = results[0]["flops"]
-    t_max = max(r["time_best_s"] for r in results)
-    agg = {
-        "nproc": nproc,
-        "checksum": results[0]["checksum"],
-        "flops": flops,
-        # conservative world rate: slowest rank's best repeat
-        "gflops_world": flops / t_max / 1e9 if t_max > 0 else 0.0,
-        "gflops_mean_ranks": float(
-            np.mean([r["gflops_mean"] for r in results])
-        ),
-        "per_rank": results,
-    }
+    agg = aggregate_rank_results(results)
     if verbose:
         print(f" {nproc}-process world: {agg['gflops_world']:.3f} GFLOP/s "
               f"(slowest-rank best), checksum {agg['checksum']:.9e} "

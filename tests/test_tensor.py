@@ -319,8 +319,14 @@ def test_tas_batched_split_reoptimizes_on_sparsity_change():
     b = make_random_matrix("B", cbs, cbs, occupation=1.0, rng=rng)
     c = make_random_matrix("C", rbs, cbs, occupation=0.0, rng=rng)
     want = np.zeros((sum(rbs), sum(cbs)))
-    with batched_mm(c, nsplit=1):  # deliberately stale split
+    with batched_mm(c):  # AUTO split: only auto splits float
         state = c._tas_batched_state
+        # simulate a split cached under long-gone sparsity (the
+        # between-batch drift case): stale auto value, counts unchecked.
+        # (An nsplit given at batched_mm init is user-pinned and never
+        # re-optimized — see test_batched_pgrid_reoptimization.)
+        state["nsplit"] = 1
+        state["nblks_checked"] = None
         tas_multiply("N", "N", 1.0, a, b, 1.0, c)
         want += to_dense(a) @ to_dense(b)
         assert state["nsplit"] > 1, "stale nsplit=1 should have been re-chosen"
