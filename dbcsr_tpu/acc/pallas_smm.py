@@ -337,48 +337,55 @@ def build_crosspack_stack(c_idx: np.ndarray, a_idx: np.ndarray,
     slots point at the zero rows / a dummy output slot.
     """
     s_total = len(c_idx)
+    if s_total == 0:
+        return (np.empty((0, P, R), np.int32), np.empty((0, P, R), np.int32),
+                np.empty((0, P), np.int32), np.empty((0, P), np.int32),
+                [np.empty(0, np.int32) for _ in range(P)])
     run_first = np.flatnonzero(np.diff(c_idx)) + 1
     run_starts = np.concatenate([[0], run_first])
     run_lens = np.diff(np.concatenate([run_starts, [s_total]]))
     run_steps = -(-run_lens // R)
     nruns = len(run_lens)
-    # greedy: next run to the least-loaded lane (runs are near-uniform
-    # in length for real stacks, so this stays well balanced)
-    lane_loads = np.zeros(P, np.int64)
-    lane_runs: list = [[] for _ in range(P)]
-    order = np.argsort(-run_steps, kind="stable") if P > 1 else np.arange(nruns)
-    for j in order:
-        p = int(np.argmin(lane_loads))
-        lane_runs[p].append(j)
-        lane_loads[p] += run_steps[j]
+    # snake-order dealing over steps-descending runs (0..P-1, P-1..0,
+    # ...): the vectorized stand-in for greedy LPT — within one run's
+    # steps of perfectly balanced on sorted items, no Python loop
+    lane_of = np.zeros(nruns, np.int64)
+    if P > 1 and nruns:
+        order = np.argsort(-run_steps, kind="stable")
+        cyc = np.arange(nruns) % (2 * P)
+        lane_of[order] = np.where(cyc < P, cyc, 2 * P - 1 - cyc)
+    lane_loads = np.bincount(lane_of, weights=run_steps, minlength=P) \
+        if nruns else np.zeros(P)
     nsteps = int(lane_loads.max()) if nruns else 0
     ai = np.full((nsteps, P, R), a_pad_row, np.int32)
     bi = np.full((nsteps, P, R), b_pad_row, np.int32)
     cg = np.zeros((nsteps, P), np.int32)
     cl = np.empty((nsteps, P), np.int32)
     lane_c = []
+    run_of = np.repeat(np.arange(nruns), run_lens)
     for p in range(P):
-        s0 = 0
-        cvals = []
-        for slot, j in enumerate(sorted(lane_runs[p])):
-            st, ln = run_starts[j], run_lens[j]
-            steps = int(run_steps[j])
-            entries_a = a_idx[st:st + ln]
-            entries_b = b_idx[st:st + ln]
-            flat_a = np.full(steps * R, a_pad_row, np.int32)
-            flat_b = np.full(steps * R, b_pad_row, np.int32)
-            flat_a[:ln] = entries_a
-            flat_b[:ln] = entries_b
-            ai[s0:s0 + steps, p, :] = flat_a.reshape(steps, R)
-            bi[s0:s0 + steps, p, :] = flat_b.reshape(steps, R)
-            cg[s0:s0 + steps, p] = c_idx[st]
-            cl[s0:s0 + steps, p] = slot
-            cvals.append(c_idx[st])
-            s0 += steps
-        # pad tail steps -> dummy slot len(cvals): zero contributions
+        runs_p = np.flatnonzero(lane_of == p)  # ascending c within lane
+        ent = np.flatnonzero(lane_of[run_of] == p)
+        if not len(runs_p):
+            cl[:, p] = 0
+            lane_c.append(np.empty(0, np.int32))
+            continue
+        # the lane's subset keeps its sort-by-c; reuse the vectorized
+        # single-lane step builder
+        ai2, bi2, ci2, _ = build_grouped_stack(
+            c_idx[ent], a_idx[ent], b_idx[ent], a_pad_row, b_pad_row,
+            grouping=R,
+        )
+        sp = ai2.shape[0]
+        ai[:sp, p, :] = ai2
+        bi[:sp, p, :] = bi2
+        cg[:sp, p] = ci2
+        # lane-local slot: rank of each step's run within the lane
+        cl[:sp, p] = np.searchsorted(c_idx[run_starts[runs_p]], ci2)
+        # pad tail steps -> dummy slot len(runs_p): zero contributions
         # land there and the scatter never reads it
-        cl[s0:, p] = len(cvals)
-        lane_c.append(np.asarray(cvals, np.int32))
+        cl[sp:, p] = len(runs_p)
+        lane_c.append(c_idx[run_starts[runs_p]].astype(np.int32))
     return ai, bi, cg, cl, lane_c
 
 

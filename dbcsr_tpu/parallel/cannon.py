@@ -94,6 +94,12 @@ def cannon_multiply_dense(mesh: Mesh, a, b, acc_dtype=None):
     """
     kl = mesh.shape["kl"]
     s = mesh.shape["pr"]
+    if mesh.shape["pc"] != s:
+        raise ValueError(
+            "the dense Cannon needs a square ('pr','pc') grid; "
+            "rectangular grids are supported by the block-sparse "
+            "engine (sparse_multiply_distributed, all-gather path)"
+        )
     m, k = a.shape
     k2, n = b.shape
     if k != k2:
