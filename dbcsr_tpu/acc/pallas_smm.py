@@ -405,28 +405,14 @@ def _cp_out_map(s, ai, bi, cg, cl, *, p, P):
     return (cl[s * P + p], 0, 0)
 
 
-def _crosspack_kernel(ai_ref, bi_ref, cg_ref, cl_ref, *refs, P, R):
-    a_refs = refs[:P * R]
-    b_refs = refs[P * R:2 * P * R]
-    alpha_ref = refs[2 * P * R]
-    c_refs = refs[2 * P * R + 1:2 * P * R + 1 + P]
-    o_refs = refs[2 * P * R + 1 + P:2 * P * R + 1 + 2 * P]
-    acc_ref = refs[-1]  # VMEM (P, m, n) f32
+def _crosspack_epilogue(a_cols, b_cols, cl_ref, alpha_ref, c_refs, o_refs,
+                        acc_ref, P):
+    """Shared tail of both crosspack kernels: the big block-diagonal
+    cross dot, per-lane diagonal extraction, run-boundary accumulation
+    (first-step detection via cl), and per-lane write-back."""
     s = pl.program_id(0)
-    m = a_refs[0].shape[2]  # A arrives transposed: (1, k, m)
-    n = b_refs[0].shape[2]
-    # lane strips: k-concats on the sublane axis (cheap), then the lane
-    # concat packs strips side by side on the lane axis
-    a_cols = [
-        jnp.concatenate([a_refs[p * R + r][0] for r in range(R)], axis=0)
-        if R > 1 else a_refs[p * R][0]
-        for p in range(P)
-    ]
-    b_cols = [
-        jnp.concatenate([b_refs[p * R + r][0] for r in range(R)], axis=0)
-        if R > 1 else b_refs[p * R][0]
-        for p in range(P)
-    ]
+    m = a_cols[0].shape[1]
+    n = b_cols[0].shape[1]
     a_all = jnp.concatenate(a_cols, axis=1) if P > 1 else a_cols[0]
     b_all = jnp.concatenate(b_cols, axis=1) if P > 1 else b_cols[0]
     full = jax.lax.dot_general(
@@ -453,6 +439,29 @@ def _crosspack_kernel(ai_ref, bi_ref, cg_ref, cl_ref, *refs, P, R):
             acc_ref[p] = acc_ref[p] + contrib
 
         o_refs[p][0] = acc_ref[p].astype(o_refs[p].dtype)
+
+
+def _crosspack_kernel(ai_ref, bi_ref, cg_ref, cl_ref, *refs, P, R):
+    a_refs = refs[:P * R]
+    b_refs = refs[P * R:2 * P * R]
+    alpha_ref = refs[2 * P * R]
+    c_refs = refs[2 * P * R + 1:2 * P * R + 1 + P]
+    o_refs = refs[2 * P * R + 1 + P:2 * P * R + 1 + 2 * P]
+    acc_ref = refs[-1]  # VMEM (P, m, n) f32
+    # lane strips: k-concats on the sublane axis (cheap), then the lane
+    # concat packs strips side by side on the lane axis
+    a_cols = [
+        jnp.concatenate([a_refs[p * R + r][0] for r in range(R)], axis=0)
+        if R > 1 else a_refs[p * R][0]
+        for p in range(P)
+    ]
+    b_cols = [
+        jnp.concatenate([b_refs[p * R + r][0] for r in range(R)], axis=0)
+        if R > 1 else b_refs[p * R][0]
+        for p in range(P)
+    ]
+    _crosspack_epilogue(a_cols, b_cols, cl_ref, alpha_ref, c_refs, o_refs,
+                        acc_ref, P)
 
 
 @functools.partial(
@@ -509,6 +518,91 @@ def _pallas_crosspack(c_data, a_data_t, b_data, ai, bi, cg, cl, alpha, *,
         alpha,
         *([c_data] * P),
     )
+
+
+def _crosspack_vmem_kernel(ai_ref, bi_ref, cg_ref, cl_ref, a_ref, b_ref,
+                           alpha_ref, *refs, P, R):
+    """VMEM-resident sibling of `_crosspack_kernel`: the whole
+    (transposed-A, B) block arrays live in VMEM and lanes gather their
+    blocks IN-KERNEL by dynamic leading-dim indexing — zero per-step
+    HBM traffic, the regime where the operands fit on-chip (the
+    double-buffered shared-memory residency of the CUDA kernels,
+    `smm_acc_dnt_largeDB1.h:147-150`, taken to its TPU limit)."""
+    c_refs = refs[:P]
+    o_refs = refs[P:2 * P]
+    acc_ref = refs[-1]
+    s = pl.program_id(0)
+    a_cols = [
+        jnp.concatenate(
+            [a_ref[ai_ref[(s * P + p) * R + r]] for r in range(R)], axis=0
+        ) if R > 1 else a_ref[ai_ref[s * P * R + p * R]]
+        for p in range(P)
+    ]
+    b_cols = [
+        jnp.concatenate(
+            [b_ref[bi_ref[(s * P + p) * R + r]] for r in range(R)], axis=0
+        ) if R > 1 else b_ref[bi_ref[s * P * R + p * R]]
+        for p in range(P)
+    ]
+    _crosspack_epilogue(a_cols, b_cols, cl_ref, alpha_ref, c_refs, o_refs,
+                        acc_ref, P)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("P", "R", "nc_out", "interpret"),
+)
+def _pallas_crosspack_vmem(c_data, a_data_t, b_data, ai, bi, cg, cl, alpha,
+                           *, P, R, nc_out, interpret):
+    """One VMEM-resident crosspack launch: operand arrays are whole
+    VMEM operands (caller gates on their byte size); per-lane outputs
+    as in `_pallas_crosspack`."""
+    nsteps = cg.shape[0] // P
+    k, m = a_data_t.shape[1:]
+    n = b_data.shape[2]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(nsteps,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # whole A (transposed)
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # whole B
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # alpha
+            *[
+                pl.BlockSpec((1, m, n), functools.partial(_cp_cin_map, p=p, P=P))
+                for p in range(P)
+            ],
+        ],
+        out_specs=[
+            pl.BlockSpec((1, m, n), functools.partial(_cp_out_map, p=p, P=P))
+            for p in range(P)
+        ],
+        scratch_shapes=[pltpu.VMEM((P, m, n), jnp.float32)],
+    )
+    kernel = functools.partial(_crosspack_vmem_kernel, P=P, R=R)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((nc_out, m, n), c_data.dtype)
+            for _ in range(P)
+        ],
+        interpret=interpret,
+    )(
+        ai, bi, cg, cl,
+        a_data_t, b_data,
+        alpha,
+        *([c_data] * P),
+    )
+
+
+# byte gate for the VMEM-resident variant: A+B (plus headroom for C
+# blocks, accumulators and double-buffered index streams) must fit the
+# ~128 MB v5e VMEM; stay well under it
+_VMEM_RESIDENT_MAX_BYTES = 64 * 1024 * 1024
+
+
+def supports_vmem_resident(a_data, b_data) -> bool:
+    return int(a_data.nbytes) + int(b_data.nbytes) <= _VMEM_RESIDENT_MAX_BYTES
 
 
 def prepare_crosspack_launches(c_idx, a_idx, b_idx, a_pad_row, b_pad_row,
@@ -580,11 +674,14 @@ def process_stack_crosspack(
     a_pad_row: int | None = None,
     b_pad_row: int | None = None,
     pack: tuple | None = None,
+    vmem_resident: bool = False,
 ):
     """Cross-packed stack processing (host entry point).
 
     Semantics match `process_stack_pallas`: stack sorted by c_idx,
     contributions added onto ``c_data``.  ``pack`` forces (P, R).
+    ``vmem_resident`` selects the whole-array-in-VMEM gather variant
+    (caller responsibility: `supports_vmem_resident`).
     Returns updated c_data, or None if the stack is crosspack-ineligible
     (degenerate packing or an over-long run) — callers then use the
     base kernel.
@@ -596,6 +693,8 @@ def process_stack_crosspack(
     P, R = pack or choose_pack(m, n, k)
     if P <= 1:
         return None  # no spatial packing possible; base kernel is equal
+    if vmem_resident and not supports_vmem_resident(a_data, b_data):
+        return None
     if a_pad_row is None:
         a_data = jnp.concatenate(
             [a_data, jnp.zeros((1,) + a_data.shape[1:], a_data.dtype)])
@@ -613,9 +712,10 @@ def process_stack_crosspack(
     a_data_t = jnp.swapaxes(a_data, 1, 2)
     interpret = jax.devices()[0].platform != "tpu"
     alpha_arr = jnp.asarray([[alpha]], dtype=jnp.float32)
+    launch_fn = _pallas_crosspack_vmem if vmem_resident else _pallas_crosspack
     for lc in launches:
         with jax.enable_x64(False):
-            outs = _pallas_crosspack(
+            outs = launch_fn(
                 c_data, a_data_t, b_data,
                 jnp.asarray(lc["ai"]), jnp.asarray(lc["bi"]),
                 jnp.asarray(lc["cg"]), jnp.asarray(lc["cl"]),

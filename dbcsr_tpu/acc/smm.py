@@ -264,10 +264,11 @@ def _validate_pallas_kernel(c_data, a_data, b_data, a_idx, b_idx, c_idx,
     bi = np.asarray(b_idx[:s], np.int32)
     ci = np.asarray(c_idx[:s], np.int32)
     c0 = jnp.zeros_like(c_data)
-    if variant == "crosspack":
+    if variant in ("crosspack", "crosspack_vmem"):
         got = process_stack_crosspack(
             c0, a_data, b_data, ai, bi, ci, 1.0,
             a_pad_row=a_pad_row, b_pad_row=b_pad_row, pack=pack,
+            vmem_resident=(variant == "crosspack_vmem"),
         )
         if got is None:  # prefix ineligible: nothing to validate against
             raise KernelValidationError(
@@ -306,7 +307,8 @@ class StackPlan:
 
     __slots__ = ("driver", "nseg", "xla_idx", "launches", "r_grp",
                  "a_pad_row", "b_pad_row", "append_a_pad", "append_b_pad",
-                 "val_idx", "group_idx", "kmerge", "pack", "cross_launches")
+                 "val_idx", "group_idx", "kmerge", "pack", "cross_launches",
+                 "cross_vmem")
 
     def __init__(self):
         self.driver = "xla"
@@ -323,6 +325,7 @@ class StackPlan:
         self.kmerge = False      # pallas: k-merged MXU dot variant
         self.pack = None         # pallas_cross: (P, R) MXU packing
         self.cross_launches = None  # pallas_cross: launch dicts
+        self.cross_vmem = False  # pallas_cross: whole-array VMEM variant
 
     def nbytes(self) -> int:
         """Approximate device bytes pinned by this plan (cache budget)."""
@@ -412,7 +415,8 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
                 if tuned.get("grouping"):
                     grouping = int(tuned["grouping"])
                 kmerge = tuned.get("variant") == "kmerge"
-                tuned_cross = tuned.get("variant") == "crosspack"
+                tuned_cross = tuned.get("variant") in ("crosspack",
+                                                       "crosspack_vmem")
             # no guaranteed-zero row in the data array: the plan indexes
             # a virtual row one past the end, appended at execute time
             # (capacities are pattern-deterministic, so cached plans
@@ -464,6 +468,12 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
                 if cross is not None:
                     plan.driver = "pallas_cross"
                     plan.pack = pack
+                    # VMEM-resident gather variant: tuned-table only,
+                    # and only while the operand arrays actually fit
+                    plan.cross_vmem = bool(
+                        tuned and tuned.get("variant") == "crosspack_vmem"
+                        and pallas_smm.supports_vmem_resident(a_data, b_data)
+                    )
                     plan.a_pad_row = a_pad_row
                     plan.b_pad_row = b_pad_row
                     plan.cross_launches = [
@@ -566,10 +576,11 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0):
         from dbcsr_tpu.acc import pallas_smm
 
         cfg = get_config()
+        cross_variant = "crosspack_vmem" if plan.cross_vmem else "crosspack"
         if cfg.validate_kernels and plan.val_idx is not None:
             key = (
                 a_data.shape[1], b_data.shape[2], a_data.shape[2],
-                str(jnp.dtype(c_data.dtype)), "crosspack", plan.pack,
+                str(jnp.dtype(c_data.dtype)), cross_variant, plan.pack,
             )
             if key not in _validated_kernels:
                 ai, bi, ci = plan.val_idx
@@ -577,7 +588,7 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0):
                     c_data, a_data, b_data, ai, bi, ci,
                     None if plan.append_a_pad else plan.a_pad_row,
                     None if plan.append_b_pad else plan.b_pad_row,
-                    None, variant="crosspack", pack=plan.pack,
+                    None, variant=cross_variant, pack=plan.pack,
                 )
                 _validated_kernels.add(key)
         if plan.append_a_pad:
@@ -592,9 +603,11 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0):
         alpha_arr = jnp.asarray([[alpha]], dtype=jnp.float32)
         interpret = jax.devices()[0].platform != "tpu"
         P, R = plan.pack
+        launch_fn = (pallas_smm._pallas_crosspack_vmem if plan.cross_vmem
+                     else pallas_smm._pallas_crosspack)
         for lc in plan.cross_launches:
             with jax.enable_x64(False):
-                outs = pallas_smm._pallas_crosspack(
+                outs = launch_fn(
                     c_data, a_data_t, b_data,
                     lc["ai"], lc["bi"], lc["cg"], lc["cl"],
                     alpha_arr, P=P, R=R, nc_out=lc["nc_out"],

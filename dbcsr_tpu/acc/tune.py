@@ -215,30 +215,36 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
             ]
             alpha32 = jnp.asarray([[1.0]], jnp.float32)
 
-            def run_cross(P=P, R=R, dev_launches=dev_launches):
-                c = jnp.zeros((nc, m, n), dtype)
-                with jax.enable_x64(False):
-                    for dai, dbi, dcg, dcl, sidx, lens, nc_out in dev_launches:
-                        outs = pallas_smm._pallas_crosspack(
-                            c, a_t, b, dai, dbi, dcg, dcl, alpha32,
-                            P=P, R=R, nc_out=nc_out, interpret=interpret,
-                        )
-                        c = pallas_smm.scatter_lane_outputs(
-                            c, outs, lens, sidx
-                        )
-                return c
+            variants = [("crosspack", pallas_smm._pallas_crosspack)]
+            if pallas_smm.supports_vmem_resident(a, b):
+                variants.append(
+                    ("crosspack_vmem", pallas_smm._pallas_crosspack_vmem)
+                )
+            for vname, vfn in variants:
+                def run_v(P=P, R=R, dev_launches=dev_launches, vfn=vfn):
+                    c = jnp.zeros((nc, m, n), dtype)
+                    with jax.enable_x64(False):
+                        for dai, dbi, dcg, dcl, sidx, lens, nc_out in dev_launches:
+                            outs = vfn(
+                                c, a_t, b, dai, dbi, dcg, dcl, alpha32,
+                                P=P, R=R, nc_out=nc_out, interpret=interpret,
+                            )
+                            c = pallas_smm.scatter_lane_outputs(
+                                c, outs, lens, sidx
+                            )
+                    return c
 
-            tag = f"pallas crosspack P={P} R={R}"
-            try:
-                t = _time_config(run_cross, nrep)
-            except Exception as exc:
-                out(f"  {tag}: failed ({type(exc).__name__})")
-                continue
-            candidates.append(
-                {"driver": "pallas", "variant": "crosspack",
-                 "grouping": R, "pack_p": P, "gflops": flops / t / 1e9}
-            )
-            out(f"  {tag}: {flops / t / 1e9:.1f} GFLOP/s")
+                tag = f"pallas {vname} P={P} R={R}"
+                try:
+                    t = _time_config(run_v, nrep)
+                except Exception as exc:
+                    out(f"  {tag}: failed ({type(exc).__name__})")
+                    continue
+                candidates.append(
+                    {"driver": "pallas", "variant": vname,
+                     "grouping": R, "pack_p": P, "gflops": flops / t / 1e9}
+                )
+                out(f"  {tag}: {flops / t / 1e9:.1f} GFLOP/s")
 
     best = max(candidates, key=lambda c: c["gflops"])
     entry = {

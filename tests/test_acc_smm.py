@@ -421,3 +421,69 @@ def test_crosspack_predicted_donor_rederives_pack(tmp_path, monkeypatch):
                                        jnp.asarray(b), plan, 1.0))
     np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.0),
                                rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("dtype,mnk", [
+    (np.float32, (23, 23, 23)),
+    ("bfloat16", (16, 16, 16)),
+])
+def test_crosspack_vmem_resident_vs_oracle(dtype, mnk):
+    """Whole-array-in-VMEM gather variant: identical contract to the
+    DMA-stream crosspack (in-kernel dynamic leading-dim gathers)."""
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.acc import pallas_smm
+
+    m, n, k = mnk
+    dt = jnp.bfloat16 if dtype == "bfloat16" else dtype
+    rng = np.random.default_rng(51)
+    a_h = rng.standard_normal((24, m, k))
+    b_h = rng.standard_normal((24, k, n))
+    c_h = rng.standard_normal((18, m, n))
+    s = 350
+    ai = rng.integers(0, 24, s).astype(np.int32)
+    bi = rng.integers(0, 24, s).astype(np.int32)
+    ci = np.sort(rng.integers(0, 18, s)).astype(np.int32)
+    got = pallas_smm.process_stack_crosspack(
+        jnp.asarray(c_h, dt), jnp.asarray(a_h, dt), jnp.asarray(b_h, dt),
+        ai, bi, ci, 1.1, vmem_resident=True,
+    )
+    assert got is not None
+    want = c_h.copy()
+    np.add.at(want, ci, 1.1 * np.einsum("sij,sjk->sik", a_h[ai], b_h[bi]))
+    err = np.abs(np.asarray(got, np.float64) - want).max() / np.abs(want).max()
+    assert err < (5e-2 if dtype == "bfloat16" else 1e-5), err
+
+
+def test_crosspack_vmem_tuned_dispatch(tmp_path, monkeypatch):
+    """A tuned crosspack_vmem row selects the VMEM-resident variant
+    (gated on the operands actually fitting)."""
+    import json
+
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.acc import params as params_mod
+    from dbcsr_tpu.acc import smm
+    from dbcsr_tpu.core.config import set_config
+
+    monkeypatch.setenv("DBCSR_TPU_PARAMS_DIR", str(tmp_path))
+    entry = {"m": 12, "n": 12, "k": 12, "dtype": "float32",
+             "driver": "pallas", "variant": "crosspack_vmem", "grouping": 4,
+             "pack_p": 4, "gflops": 1.0}
+    with open(params_mod.params_path(), "w") as f:
+        json.dump([entry], f)
+    rng = np.random.default_rng(53)
+    a, b, c, ai, bi, ci = _random_stack(rng, 20, 20, 12, 300, 12, 12, 12,
+                                        np.float32)
+    set_config(mm_driver="auto", validate_kernels=True)
+    plan = smm.prepare_stack(jnp.asarray(c), jnp.asarray(a), jnp.asarray(b),
+                             ai, bi, ci)
+    assert plan.driver == "pallas_cross" and plan.cross_vmem
+    got = np.asarray(smm.execute_stack(jnp.asarray(c), jnp.asarray(a),
+                                       jnp.asarray(b), plan, 1.0))
+    np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.0),
+                               rtol=2e-4, atol=2e-4)
+    assert any(
+        len(kk) > 4 and kk[4] == "crosspack_vmem"
+        for kk in smm._validated_kernels
+    )
