@@ -101,17 +101,24 @@ def optimize_grid(mesh: Mesh, nsplit: int, long_dim: str) -> Mesh:
     devs = list(mesh.devices.flat)
     n = len(devs)
     cands = [
-        (n // (s * s), s)
+        (n // (s * s), s, s)
         for s in range(1, int(round(n ** 0.5)) + 1)
         if n % (s * s) == 0
     ]
+    # always offer the balanced rectangular single-layer grid
+    # (all-gather engine): it keeps C partitioned where kl-heavy shapes
+    # replicate it through the psum, and keeps all devices busy when no
+    # square factorization fits the nsplit demand
+    pr, pc = _balanced_factor(n)
+    if (1, pr, pc) not in cands:
+        cands.append((1, pr, pc))
     if long_dim in ("m", "n"):
         ok = [c for c in cands if c[0] <= max(int(nsplit), 1)]
-        kl, s = max(ok) if ok else min(cands)
+        kl, pr, pc = max(ok) if ok else min(cands)
     else:
         target = max(int(round(n ** (1.0 / 3.0))), 1)
-        kl, s = min(cands, key=lambda c: (abs(c[0] - target), -c[1]))
-    if (kl, s) == (mesh.shape["kl"], mesh.shape["pr"] ) and s == mesh.shape["pc"]:
+        kl, pr, pc = min(cands, key=lambda c: (abs(c[0] - target), -c[1]))
+    if (kl, pr, pc) == (mesh.shape["kl"], mesh.shape["pr"], mesh.shape["pc"]):
         return mesh
-    return Mesh(np.asarray(devs).reshape(kl, s, s),
+    return Mesh(np.asarray(devs).reshape(kl, pr, pc),
                 axis_names=("kl", "pr", "pc"))

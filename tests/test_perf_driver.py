@@ -181,3 +181,19 @@ def test_aggregate_rank_results_straggler():
     bad["checksum"] = 9.0
     with pytest.raises(RuntimeError, match="checksums differ"):
         aggregate_rank_results([mk(0, fast), bad])
+
+
+def test_multiproc_driver_rect_world():
+    """2 ranks x 3 devices = a 6-device world: the multihost mesh goes
+    RECTANGULAR (1, 2, 3) and the all-gather engine's collectives run
+    across real process boundaries (Gloo/TCP), rank-identical
+    checksums."""
+    from dbcsr_tpu.perf.driver import run_perf_multiproc
+
+    agg = run_perf_multiproc(
+        os.path.join(INPUTS, "smoke.perf"), 2, devices_per_proc=3,
+        nrep=1, verbose=False, timeout=420,
+    )
+    assert agg["nproc"] == 2
+    assert agg["gflops_world"] > 0
+    assert all(r["checksum"] == agg["checksum"] for r in agg["per_rank"])

@@ -246,3 +246,16 @@ def test_tas_auto_split_on_rectangular_mesh():
         dt.to_dense(c), dt.to_dense(a) @ dt.to_dense(b),
         rtol=1e-12, atol=1e-12,
     )
+
+
+def test_optimize_grid_rect_fallback():
+    """Counts with no usable square factor get a balanced rectangular
+    candidate (all-gather engine) instead of the C-replicating kl-only
+    factorization."""
+    from dbcsr_tpu.parallel.mesh import make_grid, optimize_grid
+
+    m6 = make_grid(6)
+    assert dict(optimize_grid(m6, 2, "m").shape) == {"kl": 1, "pr": 2, "pc": 3}
+    assert dict(optimize_grid(m6, 1, "k").shape) == {"kl": 1, "pr": 2, "pc": 3}
+    # enough group demand still prefers the kl factorization
+    assert dict(optimize_grid(m6, 8, "m").shape) == {"kl": 6, "pr": 1, "pc": 1}

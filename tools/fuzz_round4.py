@@ -8,6 +8,11 @@ discipline used in rounds 2/3 for the base engine).
 
 Usage: python tools/fuzz_round4.py [nconfigs] [seed]
 Prints a tally; exits nonzero on any mismatch.
+
+Large sweeps run in SUBPROCESS BATCHES of 50 configs (each batch a
+fresh interpreter): every distinct random shape adds entries to XLA's
+process-lifetime jit cache, and a single 300-config process was
+observed to exhaust host memory (LLVM 'Cannot allocate memory').
 """
 
 from __future__ import annotations
@@ -147,7 +152,43 @@ def main(nconfigs: int = 200, seed: int = 2026_0730) -> int:
     return 1 if failures else 0
 
 
+def main_batched(nconfigs: int, seed: int, batch: int = 50) -> int:
+    """Split the sweep into fresh-interpreter batches (see module
+    docstring); aggregates exit status and streams each batch's tail."""
+    import subprocess
+
+    rc = 0
+    done = 0
+    while done < nconfigs:
+        take = min(batch, nconfigs - done)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--batch",
+                 str(take), str(seed + done)],
+                capture_output=True, text=True, timeout=3600,
+            )
+            code, out_s, err_s = r.returncode, r.stdout or "", r.stderr or ""
+        except subprocess.TimeoutExpired as exc:
+            code = -1
+            out_s = (exc.stdout or b"").decode(errors="replace") \
+                if isinstance(exc.stdout, bytes) else (exc.stdout or "")
+            err_s = "batch TIMEOUT after 3600 s"
+        tail = "\n".join(out_s.strip().splitlines()[-8:])
+        print(f"--- batch @{done} (+{take}), rc={code} ---\n{tail}",
+              flush=True)
+        if code:
+            rc = 1
+            print("stderr tail:\n" +
+                  "\n".join(err_s.strip().splitlines()[-10:]), flush=True)
+        done += take
+    print(f"\nbatched sweep: {nconfigs} configs, overall rc={rc}")
+    return rc
+
+
 if __name__ == "__main__":
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200
-    s = int(sys.argv[2]) if len(sys.argv) > 2 else 2026_0730
-    sys.exit(main(n, s))
+    args = sys.argv[1:]
+    if args and args[0] == "--batch":
+        sys.exit(main(int(args[1]), int(args[2])))
+    n = int(args[0]) if args else 200
+    s = int(args[1]) if len(args) > 1 else 2026_0730
+    sys.exit(main_batched(n, s) if n > 60 else main(n, s))
