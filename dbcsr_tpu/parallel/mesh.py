@@ -113,8 +113,10 @@ def optimize_grid(mesh: Mesh, nsplit: int, long_dim: str) -> Mesh:
     if (1, pr, pc) not in cands:
         cands.append((1, pr, pc))
     if long_dim in ("m", "n"):
+        # the kl=1 rectangular candidate always qualifies, so `ok` is
+        # never empty
         ok = [c for c in cands if c[0] <= max(int(nsplit), 1)]
-        kl, pr, pc = max(ok) if ok else min(cands)
+        kl, pr, pc = max(ok)
     else:
         target = max(int(round(n ** (1.0 / 3.0))), 1)
         kl, pr, pc = min(cands, key=lambda c: (abs(c[0] - target), -c[1]))

@@ -16,6 +16,7 @@ EXAMPLES = os.path.join(
     "example_3_multiply.py",
     "tensor_example_contract.py",
     "example_4_tensor_api.py",
+    "example_5_any_grid.py",
 ])
 def test_example_runs(name, capsys):
     runpy.run_path(os.path.join(EXAMPLES, name), run_name="__main__")

@@ -442,3 +442,25 @@ def test_contract_test_with_bounds_and_filter_reject():
     with pytest.raises(ValueError, match="filter_eps"):
         contract_test(1.0, a, b, 0.0, c, [1], [0], [0], [1],
                       filter_eps=1e-10, io=lambda *_: None)
+
+
+def test_contract_rank3_rect_mesh_matches_oracle():
+    """Tensor contraction over a RECTANGULAR 6-device mesh: the
+    nd->2d-mapped product runs through the all-gather engine with
+    oracle-equal results (ref arbitrary nprows x npcols grids,
+    dbcsr_types.F:188-223)."""
+    from dbcsr_tpu.parallel import make_grid
+
+    mesh = make_grid(6)  # (kl=1, pr=2, pc=3)
+    assert mesh.shape["pr"] != mesh.shape["pc"]
+    si, sj, sk, sl = [2, 3] * 4, [3, 2] * 3, [4, 2] * 2, [2, 2]
+    a = _rand_tensor("a", [si, sj, sk], occ=0.5, seed=60)
+    b = _rand_tensor("b", [sk, sl], occ=0.8, seed=61)
+    c = create_tensor("c", [si, sj, sl])
+    c.finalize()
+    contract(1.0, a, b, 0.0, c, mesh=mesh,
+             contract_a=(2,), notcontract_a=(0, 1),
+             contract_b=(0,), notcontract_b=(1,),
+             map_1=(0, 1), map_2=(2,))
+    want = np.einsum("ijk,kl->ijl", a.to_dense(), b.to_dense())
+    np.testing.assert_allclose(c.to_dense(), want, rtol=1e-12, atol=1e-12)

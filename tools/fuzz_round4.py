@@ -170,9 +170,8 @@ def main_batched(nconfigs: int, seed: int, batch: int = 50) -> int:
             code, out_s, err_s = r.returncode, r.stdout or "", r.stderr or ""
         except subprocess.TimeoutExpired as exc:
             code = -1
-            out_s = (exc.stdout or b"").decode(errors="replace") \
-                if isinstance(exc.stdout, bytes) else (exc.stdout or "")
-            err_s = "batch TIMEOUT after 3600 s"
+            out_s = exc.stdout or ""
+            err_s = "batch TIMEOUT after 3600 s\n" + (exc.stderr or "")
         tail = "\n".join(out_s.strip().splitlines()[-8:])
         print(f"--- batch @{done} (+{take}), rc={code} ---\n{tail}",
               flush=True)
