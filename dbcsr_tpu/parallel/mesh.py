@@ -92,8 +92,8 @@ def optimize_grid(mesh: Mesh, nsplit: int, long_dim: str) -> Mesh:
 
     m/n-long (grouped TAS) batches want the group axis as large as the
     computed nsplit can fill: kl positions beyond nsplit would idle, so
-    pick the largest kl <= nsplit (falling back to the smallest
-    factorization if every candidate exceeds it).  k-long batches run
+    pick the largest kl <= nsplit (the always-offered kl=1 rectangular
+    candidate guarantees a match).  k-long batches run
     2.5D k-layers, whose replication optimum scales like n^(1/3)
     (communication-avoiding Cannon): pick kl nearest that.
     Returns the input mesh unchanged when it already matches.
