@@ -308,7 +308,7 @@ class StackPlan:
     __slots__ = ("driver", "nseg", "xla_idx", "launches", "r_grp",
                  "a_pad_row", "b_pad_row", "append_a_pad", "append_b_pad",
                  "val_idx", "group_idx", "kmerge", "pack", "cross_launches",
-                 "cross_vmem")
+                 "cross_vmem", "cross_src")
 
     def __init__(self):
         self.driver = "xla"
@@ -326,6 +326,8 @@ class StackPlan:
         self.pack = None         # pallas_cross: (P, R) MXU packing
         self.cross_launches = None  # pallas_cross: launch dicts
         self.cross_vmem = False  # pallas_cross: whole-array VMEM variant
+        self.cross_src = None    # pallas_cross: host (ai, bi, ci) for
+                                 # the compile-failure demotion rebuild
 
     def nbytes(self) -> int:
         """Approximate device bytes pinned by this plan (cache budget)."""
@@ -343,6 +345,8 @@ class StackPlan:
                     int(lc[key].size) * 4
                     for key in ("ai", "bi", "cg", "cl", "scatter_idx")
                 )
+        if self.cross_src is not None:  # host bytes, freed on first success
+            total += sum(int(x.nbytes) for x in self.cross_src)
         return total
 
 
@@ -427,12 +431,21 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
             if b_pad_row is None:
                 plan.append_b_pad = True
                 b_pad_row = b_data.shape[0]
-            # cross-packed variant: forced by config, or tuned-table
-            # choice under auto dispatch (see pallas_smm crosspack
-            # block comment); ineligible stacks fall through to the
-            # base kernel
-            want_cross = cfg.mm_driver == "pallas_cross" or (
-                cfg.mm_driver == "auto" and tuned_cross
+            # cross-packed variant: forced by config, tuned-table
+            # choice, or — on a REAL TPU — the default for untuned
+            # f32/bf16 shapes (P*R entries per MXU pass).  A compile
+            # failure demotes the shape for the session
+            # (_cross_disabled), so dispatch can never be bricked by a
+            # Mosaic lowering gap; ineligible stacks fall through to
+            # the base kernel
+            shape_key = _stack_shape_key(c_data, a_data, b_data)
+            auto_cross = (
+                cfg.mm_driver == "auto" and tuned is None and _on_tpu()
+            )
+            want_cross = shape_key not in _cross_disabled and (
+                cfg.mm_driver == "pallas_cross"
+                or (cfg.mm_driver == "auto" and tuned_cross)
+                or auto_cross
             )
             if tuned_cross:
                 # a crosspack entry's "grouping" is the crosspack
@@ -468,6 +481,11 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
                 if cross is not None:
                     plan.driver = "pallas_cross"
                     plan.pack = pack
+                    plan.cross_src = (
+                        np.ascontiguousarray(a_idx, np.int32),
+                        np.ascontiguousarray(b_idx, np.int32),
+                        np.ascontiguousarray(c_idx, np.int32),
+                    )
                     # VMEM-resident gather variant: tuned-table only,
                     # and only while the operand arrays actually fit
                     plan.cross_vmem = bool(
@@ -577,46 +595,96 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0):
 
         cfg = get_config()
         cross_variant = "crosspack_vmem" if plan.cross_vmem else "crosspack"
-        if cfg.validate_kernels and plan.val_idx is not None:
-            key = (
-                a_data.shape[1], b_data.shape[2], a_data.shape[2],
-                str(jnp.dtype(c_data.dtype)), cross_variant, plan.pack,
+        try:
+            if cfg.validate_kernels and plan.val_idx is not None:
+                key = (
+                    a_data.shape[1], b_data.shape[2], a_data.shape[2],
+                    str(jnp.dtype(c_data.dtype)), cross_variant, plan.pack,
+                )
+                if key not in _validated_kernels:
+                    ai, bi, ci = plan.val_idx
+                    _validate_pallas_kernel(
+                        c_data, a_data, b_data, ai, bi, ci,
+                        None if plan.append_a_pad else plan.a_pad_row,
+                        None if plan.append_b_pad else plan.b_pad_row,
+                        None, variant=cross_variant, pack=plan.pack,
+                    )
+                    _validated_kernels.add(key)
+            a_pad = a_data
+            b_pad = b_data
+            if plan.append_a_pad:
+                a_pad = jnp.concatenate(
+                    [a_data, jnp.zeros((1,) + a_data.shape[1:], a_data.dtype)]
+                )
+            if plan.append_b_pad:
+                b_pad = jnp.concatenate(
+                    [b_data, jnp.zeros((1,) + b_data.shape[1:], b_data.dtype)]
+                )
+            a_data_t = jnp.swapaxes(a_pad, 1, 2)
+            alpha_arr = jnp.asarray([[alpha]], dtype=jnp.float32)
+            interpret = jax.devices()[0].platform != "tpu"
+            P, R = plan.pack
+            launch_fn = (pallas_smm._pallas_crosspack_vmem if plan.cross_vmem
+                         else pallas_smm._pallas_crosspack)
+            c_out = c_data
+            for lc in plan.cross_launches:
+                with jax.enable_x64(False):
+                    outs = launch_fn(
+                        c_out, a_data_t, b_pad,
+                        lc["ai"], lc["bi"], lc["cg"], lc["cl"],
+                        alpha_arr, P=P, R=R, nc_out=lc["nc_out"],
+                        interpret=interpret,
+                    )
+                c_out = pallas_smm.scatter_lane_outputs(
+                    c_out, outs, lc["lane_len"], lc["scatter_idx"]
+                )
+            # kernel proven on this backend: drop the demotion payload
+            # (host index copies kept only until the first success)
+            plan.cross_src = None
+            return c_out
+        except KernelValidationError:
+            raise  # numeric corruption: hard fail, never fall back
+        except Exception as exc:
+            # compile/lowering failure (e.g. a Mosaic gap on this
+            # backend): demote the shape and rebuild the plan IN PLACE
+            # as a base-kernel plan from the retained source indices —
+            # the reference's unsupported-kernel fallback
+            # (`libsmm_acc.cpp:227-249`)
+            if plan.cross_src is None:
+                raise
+            import warnings
+
+            shape_key = _stack_shape_key(c_data, a_data, b_data)
+            msg = f"{type(exc).__name__}: {exc}"
+            transient = ("RESOURCE_EXHAUSTED" in msg
+                         or "out of memory" in msg.lower())
+            if not transient:
+                # a lowering gap is deterministic — blacklist the shape;
+                # resource pressure is not — fall back this time only
+                _cross_disabled.add(shape_key)
+            warnings.warn(
+                f"crosspack kernel failed on this backend for shape "
+                f"{shape_key} ({msg}); falling back to the base kernel"
+                + ("" if transient else " for this session"),
+                RuntimeWarning,
+                stacklevel=2,
             )
-            if key not in _validated_kernels:
-                ai, bi, ci = plan.val_idx
-                _validate_pallas_kernel(
+            ai, bi, ci = plan.cross_src
+            # the rebuild must not re-select crosspack; for transient
+            # failures the disable is scoped to this rebuild only
+            _cross_disabled.add(shape_key)
+            try:
+                new_plan = prepare_stack(
                     c_data, a_data, b_data, ai, bi, ci,
-                    None if plan.append_a_pad else plan.a_pad_row,
-                    None if plan.append_b_pad else plan.b_pad_row,
-                    None, variant=cross_variant, pack=plan.pack,
+                    a_pad_row=None if plan.append_a_pad else plan.a_pad_row,
+                    b_pad_row=None if plan.append_b_pad else plan.b_pad_row,
                 )
-                _validated_kernels.add(key)
-        if plan.append_a_pad:
-            a_data = jnp.concatenate(
-                [a_data, jnp.zeros((1,) + a_data.shape[1:], a_data.dtype)]
-            )
-        if plan.append_b_pad:
-            b_data = jnp.concatenate(
-                [b_data, jnp.zeros((1,) + b_data.shape[1:], b_data.dtype)]
-            )
-        a_data_t = jnp.swapaxes(a_data, 1, 2)
-        alpha_arr = jnp.asarray([[alpha]], dtype=jnp.float32)
-        interpret = jax.devices()[0].platform != "tpu"
-        P, R = plan.pack
-        launch_fn = (pallas_smm._pallas_crosspack_vmem if plan.cross_vmem
-                     else pallas_smm._pallas_crosspack)
-        for lc in plan.cross_launches:
-            with jax.enable_x64(False):
-                outs = launch_fn(
-                    c_data, a_data_t, b_data,
-                    lc["ai"], lc["bi"], lc["cg"], lc["cl"],
-                    alpha_arr, P=P, R=R, nc_out=lc["nc_out"],
-                    interpret=interpret,
-                )
-            c_data = pallas_smm.scatter_lane_outputs(
-                c_data, outs, lc["lane_len"], lc["scatter_idx"]
-            )
-        return c_data
+            finally:
+                if transient:
+                    _cross_disabled.discard(shape_key)
+            for slot in StackPlan.__slots__:  # cached plans heal too
+                setattr(plan, slot, getattr(new_plan, slot))
+            return execute_stack(c_data, a_data, b_data, plan, alpha)
     if plan.driver == "pallas":
         from dbcsr_tpu.acc.pallas_smm import _pallas_process
 
@@ -684,6 +752,28 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
     plan = prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
                          a_pad_row=a_pad_row, b_pad_row=b_pad_row)
     return execute_stack(c_data, a_data, b_data, plan, alpha)
+
+
+def _on_tpu() -> bool:
+    return jax.devices()[0].platform == "tpu"
+
+
+def _stack_shape_key(c_data, a_data, b_data) -> tuple:
+    """(m, n, k, dtype) of a stack — the single key construction shared
+    by crosspack dispatch and the demotion handler (they MUST match, or
+    a demoted shape could re-select the failing kernel and recurse)."""
+    return (
+        a_data.shape[1], b_data.shape[2], a_data.shape[2],
+        str(jnp.dtype(c_data.dtype)),
+    )
+
+
+# shapes whose crosspack kernel failed to COMPILE/run on this backend
+# (not a numeric mismatch): dispatch demotes them to the base kernel
+# for the session — the role of the reference's unsupported-kernel
+# fallback (`libsmm_acc.cpp:227-249` falls back when no JIT kernel
+# exists for an (m, n, k))
+_cross_disabled: set = set()
 
 
 def _pallas_supported(cfg, c_data, a_data, b_data) -> bool:

@@ -487,3 +487,71 @@ def test_crosspack_vmem_tuned_dispatch(tmp_path, monkeypatch):
         len(kk) > 4 and kk[4] == "crosspack_vmem"
         for kk in smm._validated_kernels
     )
+
+
+def test_crosspack_compile_failure_demotes_to_base(monkeypatch):
+    """A crosspack COMPILE/lowering failure (not a numeric mismatch)
+    must demote the shape for the session and fall back to the base
+    kernel with correct results — the unsupported-kernel fallback
+    (libsmm_acc.cpp:227-249).  Numeric corruption must still hard-fail
+    (covered by test_validate_kernels_catches_corrupted_kernel)."""
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.acc import pallas_smm, smm
+    from dbcsr_tpu.core.config import set_config
+
+    def boom(*a, **k):
+        raise RuntimeError("simulated Mosaic lowering failure")
+
+    monkeypatch.setattr(pallas_smm, "_pallas_crosspack", boom)
+    monkeypatch.setattr(pallas_smm, "_pallas_crosspack_vmem", boom)
+    smm._cross_disabled.discard((14, 14, 14, "float32"))
+    rng = np.random.default_rng(55)
+    a, b, c, ai, bi, ci = _random_stack(rng, 16, 16, 10, 300, 14, 14, 14,
+                                        np.float32)
+    set_config(mm_driver="pallas_cross", validate_kernels=True)
+    try:
+        plan = smm.prepare_stack(jnp.asarray(c), jnp.asarray(a),
+                                 jnp.asarray(b), ai, bi, ci)
+        assert plan.driver == "pallas_cross"
+        with pytest.warns(RuntimeWarning, match="falling back to the base kernel"):
+            got = np.asarray(smm.execute_stack(
+                jnp.asarray(c), jnp.asarray(a), jnp.asarray(b), plan, 1.5))
+    finally:
+        set_config(mm_driver="auto")
+    np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.5),
+                               rtol=2e-4, atol=2e-4)
+    assert (14, 14, 14, "float32") in smm._cross_disabled
+    # the cached plan healed in place: next execute uses the base path
+    assert plan.driver != "pallas_cross"
+    got2 = np.asarray(smm.execute_stack(
+        jnp.asarray(c), jnp.asarray(a), jnp.asarray(b), plan, 1.5))
+    np.testing.assert_allclose(got2, got, rtol=1e-6, atol=1e-6)
+    smm._cross_disabled.discard((14, 14, 14, "float32"))
+
+
+def test_auto_crosspack_default_on_tpu(monkeypatch):
+    """On a real TPU, untuned f32/bf16 shapes default to the crosspack
+    kernel under auto dispatch (tuned rows and the disabled set still
+    take precedence)."""
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.acc import smm
+    from dbcsr_tpu.core.config import set_config
+
+    monkeypatch.setattr(smm, "_on_tpu", lambda: True)
+    rng = np.random.default_rng(57)
+    a, b, c, ai, bi, ci = _random_stack(rng, 16, 16, 10, 300, 15, 15, 15,
+                                        np.float32)
+    set_config(mm_driver="auto")
+    plan = smm.prepare_stack(jnp.asarray(c), jnp.asarray(a), jnp.asarray(b),
+                             ai, bi, ci)
+    assert plan.driver == "pallas_cross"
+    # disabled shapes go back to the base kernel
+    smm._cross_disabled.add((15, 15, 15, "float32"))
+    try:
+        plan2 = smm.prepare_stack(jnp.asarray(c), jnp.asarray(a),
+                                  jnp.asarray(b), ai, bi, ci)
+        assert plan2.driver != "pallas_cross"
+    finally:
+        smm._cross_disabled.discard((15, 15, 15, "float32"))
