@@ -308,7 +308,7 @@ class StackPlan:
     __slots__ = ("driver", "nseg", "xla_idx", "launches", "r_grp",
                  "a_pad_row", "b_pad_row", "append_a_pad", "append_b_pad",
                  "val_idx", "group_idx", "kmerge", "pack", "cross_launches",
-                 "cross_vmem", "cross_src")
+                 "cross_vmem", "cross_src", "host_idx")
 
     def __init__(self):
         self.driver = "xla"
@@ -328,6 +328,8 @@ class StackPlan:
         self.cross_vmem = False  # pallas_cross: whole-array VMEM variant
         self.cross_src = None    # pallas_cross: host (ai, bi, ci) for
                                  # the compile-failure demotion rebuild
+        self.host_idx = None     # host: numpy (ai, bi, ci) for the
+                                 # native C++ stack driver
 
     def nbytes(self) -> int:
         """Approximate device bytes pinned by this plan (cache budget)."""
@@ -347,6 +349,8 @@ class StackPlan:
                 )
         if self.cross_src is not None:  # host bytes, freed on first success
             total += sum(int(x.nbytes) for x in self.cross_src)
+        if self.host_idx is not None:  # host bytes
+            total += sum(int(x.nbytes) for x in self.host_idx)
         return total
 
 
@@ -366,6 +370,31 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
     # choice, grouping, and the flat-gather layout decision
     from dbcsr_tpu.acc import params as params_mod
 
+    # native host stack driver (the reference's CPU path,
+    # dbcsr_mm_hostdrv.F:90 / tools/build_libsmm): explicit opt-in on
+    # CPU backends only — through the axon tunnel a host round-trip per
+    # stack would be catastrophic, so on TPU it demotes to auto
+    if cfg.mm_driver == "host":
+        if _host_smm_available(c_data.dtype):
+            plan = StackPlan()
+            plan.nseg = c_data.shape[0]
+            plan.driver = "host"
+            plan.a_pad_row = a_pad_row
+            plan.b_pad_row = b_pad_row
+            plan.host_idx = (
+                np.ascontiguousarray(a_idx, np.int32),
+                np.ascontiguousarray(b_idx, np.int32),
+                np.ascontiguousarray(c_idx, np.int32),
+            )
+            return plan
+        import warnings
+
+        warnings.warn(
+            "mm_driver='host' but the native host driver is unavailable "
+            "on this backend/dtype; falling back to auto selection",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     tuned = params_mod.predict(
         a_data.shape[1], b_data.shape[2], a_data.shape[2], c_data.dtype
     )
@@ -576,6 +605,38 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0):
     """Device side: run a prepared plan against (possibly new) data."""
     if plan is None:
         return c_data
+    if plan.driver == "host":
+        from dbcsr_tpu import native
+
+        ai, bi, ci = plan.host_idx
+        c_np = np.array(c_data)  # writable host copy (CPU backend: memcpy)
+        ok = native.host_smm(
+            c_np, np.asarray(a_data), np.asarray(b_data), ai, bi, ci, alpha
+        )
+        if ok:
+            return jnp.asarray(c_np)
+        # native library vanished after planning (e.g. DBCSR_TPU_NATIVE
+        # flipped): rebuild the plan in place without the host driver.
+        # prepare_stack re-checks _host_smm_available, which now fails,
+        # so the rebuild falls through to the XLA selection — no global
+        # config mutation (the crosspack demotion pattern).
+        import warnings
+
+        warnings.warn(
+            "native host driver unavailable at execute time; rebuilding "
+            "as an XLA plan",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        new_plan = prepare_stack(
+            c_data, a_data, b_data, ai, bi, ci,
+            a_pad_row=plan.a_pad_row, b_pad_row=plan.b_pad_row,
+        )
+        if new_plan.driver == "host":  # cannot happen; guard recursion
+            raise RuntimeError("host driver rebuild selected host again")
+        for slot in StackPlan.__slots__:
+            setattr(plan, slot, getattr(new_plan, slot))
+        return execute_stack(c_data, a_data, b_data, plan, alpha)
     if plan.driver == "xla_group":
         if plan.append_a_pad:
             a_data = jnp.concatenate(
@@ -756,6 +817,26 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
 
 def _on_tpu() -> bool:
     return jax.devices()[0].platform == "tpu"
+
+
+def _host_smm_available(dtype) -> bool:
+    """True when the native C++ stack driver can run this stack: CPU
+    backend (no device round-trip), a dtype the C++ kernel's switch
+    handles (the reference enum codes r4/r8/c4/c8 — not bf16), and the
+    native library built."""
+    if jax.devices()[0].platform != "cpu":
+        return False
+    from dbcsr_tpu.core import kinds
+
+    try:
+        code = kinds.enum_of(dtype)
+    except KeyError:
+        return False
+    if code not in (1, 3, 5, 7):
+        return False
+    from dbcsr_tpu import native
+
+    return native.get_lib() is not None
 
 
 def _stack_shape_key(c_data, a_data, b_data) -> tuple:

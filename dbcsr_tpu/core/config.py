@@ -19,7 +19,9 @@ import os
 @dataclasses.dataclass
 class Config:
     # --- multiply driver selection (ref MM_DRIVER {auto,matmul,blas,smm,xsmm},
-    #     dbcsr_config.F:34-38) -> here {auto, xla, xla_group, pallas, dense}
+    #     dbcsr_config.F:34-38) -> here {auto, xla, xla_group, pallas,
+    #     pallas_cross, dense, host} ("host" = native C++ stack driver on
+    #     CPU backends, the ref smm/blas CPU path)
     mm_driver: str = "auto"
     # max entries pushed to the device per kernel call before flushing
     # (ref MM_STACK_SIZE: 30000 accel / 1000 CPU, dbcsr_config.F:77-79)
@@ -61,7 +63,7 @@ class Config:
 
     def validate(self) -> None:
         if self.mm_driver not in ("auto", "xla", "xla_group", "pallas",
-                                  "pallas_cross", "dense"):
+                                  "pallas_cross", "dense", "host"):
             raise ValueError(f"unknown mm_driver {self.mm_driver!r}")
         if self.mm_stack_size <= 0:
             raise ValueError("mm_stack_size must be positive")

@@ -21,17 +21,42 @@ _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
-_SRC = os.path.join(os.path.dirname(__file__), "index_engine.cpp")
-_SO = os.path.join(os.path.dirname(__file__), "libdbcsr_index.so")
+_SRCS = [
+    os.path.join(os.path.dirname(__file__), "index_engine.cpp"),
+    os.path.join(os.path.dirname(__file__), "host_smm.cpp"),
+]
+
+
+def _isa_tag() -> str:
+    """CPU-capability tag baked into the .so filename: the build uses
+    -march=native, so a binary cached on a shared filesystem must never
+    be loaded by a rank on a CPU with different ISA extensions (SIGILL
+    is not catchable).  Different flags -> different file -> rebuild."""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    import hashlib
+
+                    return hashlib.sha1(line.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    return "generic"
+
+
+_SO = os.path.join(os.path.dirname(__file__),
+                   f"libdbcsr_index.{_isa_tag()}.so")
 
 
 def _build() -> Optional[str]:
     # compile to a process-private temp path, then rename atomically so
     # concurrent ranks never load a partially written .so
     tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmds = [
-        ["g++", "-O3", "-fopenmp", "-fPIC", "-shared", _SRC, "-o", tmp],
-        ["g++", "-O3", "-fPIC", "-shared", _SRC, "-o", tmp],  # no OpenMP
+    base = ["g++", "-O3", "-fPIC", "-shared", *_SRCS, "-o", tmp]
+    cmds = [  # prefer vectorized + OpenMP, degrade gracefully
+        base[:2] + ["-march=native", "-fopenmp"] + base[2:],
+        base[:2] + ["-fopenmp"] + base[2:],
+        base,
     ]
     for cmd in cmds:
         try:
@@ -50,7 +75,7 @@ def _build() -> Optional[str]:
 
 def _fresh() -> bool:
     try:
-        return os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        return os.path.getmtime(_SO) >= max(map(os.path.getmtime, _SRCS))
     except OSError:
         return False
 
@@ -92,6 +117,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
             lib.dbcsr_group_sort_stacks.restype = None
             lib.dbcsr_group_sort_stacks.argtypes = [
                 ctypes.c_int64, i64p, ctypes.c_int64, i32p, i64p, i64p, i64p,
+            ]
+            lib.dbcsr_host_smm.restype = ctypes.c_int32
+            lib.dbcsr_host_smm.argtypes = [
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, i32p, i32p, i32p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_double, ctypes.c_double,
             ]
         except AttributeError:
             # stale library missing an expected symbol -> NumPy fallback
@@ -192,6 +224,45 @@ def coo_fill_blocks(blk_of_entry, local_row, local_col, values,
         out_flat.ctypes.data_as(ctypes.c_void_p),
     )
     return True
+
+
+def host_smm(c_np, a_np, b_np, ai, bi, ci, alpha) -> bool:
+    """Native host stack processing: ``c[ci] += alpha * a[ai] @ b[bi]``
+    in-place over a sorted param stack (the reference's CPU stack driver,
+    `dbcsr_mm_hostdrv.F:90` / tools/build_libsmm).  ``c_np`` must be a
+    writable contiguous array; returns False when the native library is
+    unavailable or the dtype is unsupported (caller falls back)."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    from dbcsr_tpu.core import kinds
+
+    try:
+        code = kinds.enum_of(c_np.dtype)
+    except KeyError:
+        return False
+    if not (c_np.flags.c_contiguous and c_np.flags.writeable):
+        raise ValueError("c_np must be C-contiguous and writable")
+    a_np = np.ascontiguousarray(a_np)
+    b_np = np.ascontiguousarray(b_np)
+    if a_np.dtype != c_np.dtype or b_np.dtype != c_np.dtype:
+        return False  # the C++ kernel reinterprets raw pointers by code
+    ai = np.ascontiguousarray(ai, np.int32)
+    bi = np.ascontiguousarray(bi, np.int32)
+    ci = np.ascontiguousarray(ci, np.int32)
+    alpha = complex(alpha)
+    m, k = a_np.shape[1], a_np.shape[2]
+    n = b_np.shape[2]
+    rc = lib.dbcsr_host_smm(
+        code,
+        c_np.ctypes.data_as(ctypes.c_void_p),
+        a_np.ctypes.data_as(ctypes.c_void_p),
+        b_np.ctypes.data_as(ctypes.c_void_p),
+        _ptr(ai, ctypes.c_int32), _ptr(bi, ctypes.c_int32),
+        _ptr(ci, ctypes.c_int32), len(ai), m, n, k,
+        alpha.real, alpha.imag,
+    )
+    return rc == 0
 
 
 def sort_order(group, ngroups, c_slot, a_ent, return_bounds: bool = False):

@@ -1,0 +1,133 @@
+// Native host-side batched small-matrix-multiply driver.
+//
+// The reference processes CPU stacks in `dbcsr_mm_hostdrv.F:90` (BLAS /
+// libxsmm / an offline-generated tuned SMM library, tools/build_libsmm)
+// when a stack is not worth shipping to the accelerator.  This is the
+// TPU build's equivalent: a C++ kernel that consumes the SAME sorted
+// param stack the device drivers use (a_idx/b_idx/c_idx into the
+// shape-binned block arrays) and accumulates C += alpha * A@B per entry
+// on the host.  On CPU-only backends it replaces the XLA gather +
+// segment-sum pipeline with direct indexed accumulation: entries are
+// grouped into runs of equal C block (the stack builder already sorts
+// by c), each run accumulates into an L1-resident scratch tile, and
+// runs are independent, so OpenMP parallelism is race-free without
+// atomics (the reference reaches the same point via per-thread stacks,
+// dbcsr_mm_sched.F:266).
+//
+// Built into libdbcsr_index.so together with index_engine.cpp.
+
+#include <complex>
+#include <cstdint>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+// One run of stack entries sharing a C block: accumulate into `acc`
+// (zeroed by the caller), classic i/k/j order so the j loop vectorizes
+// and the whole working set (A block + B block + acc tile) stays in L1
+// for the small block sizes this library exists for (m,n,k <= ~100).
+template <typename T>
+inline void accumulate_entry(T* __restrict acc, const T* __restrict ab,
+                             const T* __restrict bb, int64_t m, int64_t n,
+                             int64_t k) {
+  for (int64_t i = 0; i < m; ++i) {
+    T* __restrict crow = acc + i * n;
+    const T* __restrict arow = ab + i * k;
+    for (int64_t kk = 0; kk < k; ++kk) {
+      const T aik = arow[kk];
+      const T* __restrict brow = bb + kk * n;
+      for (int64_t j = 0; j < n; ++j) crow[j] += aik * brow[j];
+    }
+  }
+}
+
+template <typename T, typename S>
+void smm_runs(T* c, const T* a, const T* b, const int32_t* ai,
+              const int32_t* bi, const int32_t* ci, const int64_t* run_ptr,
+              int64_t nruns, int64_t m, int64_t n, int64_t k, S alpha) {
+  const int64_t asz = m * k, bsz = k * n, csz = m * n;
+#ifdef _OPENMP
+#pragma omp parallel
+#endif
+  {
+    std::vector<T> acc(static_cast<size_t>(csz));
+#ifdef _OPENMP
+#pragma omp for schedule(dynamic, 16)
+#endif
+    for (int64_t r = 0; r < nruns; ++r) {
+      const int64_t s0 = run_ptr[r], s1 = run_ptr[r + 1];
+      T* accp = acc.data();
+      for (int64_t x = 0; x < csz; ++x) accp[x] = T(0);
+      for (int64_t s = s0; s < s1; ++s) {
+        accumulate_entry(accp, a + static_cast<int64_t>(ai[s]) * asz,
+                         b + static_cast<int64_t>(bi[s]) * bsz, m, n, k);
+      }
+      T* __restrict cb = c + static_cast<int64_t>(ci[s0]) * csz;
+      for (int64_t x = 0; x < csz; ++x) cb[x] += alpha * accp[x];
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Process a full sorted stack on the host.  dtype_code uses the
+// reference datatype enum (acc_libsmm.h:31-36: r4=1, r8=3, c4=5, c8=7;
+// mirrored in core/kinds.py).  `ci` must be grouped (equal C blocks
+// contiguous — the stack builder's sort guarantees it); runs are
+// derived here.  Returns 0 on success, -1 for an unsupported dtype.
+int32_t dbcsr_host_smm(int32_t dtype_code, void* c_data, const void* a_data,
+                       const void* b_data, const int32_t* ai,
+                       const int32_t* bi, const int32_t* ci, int64_t nstack,
+                       int64_t m, int64_t n, int64_t k, double alpha_re,
+                       double alpha_im) {
+  if (nstack <= 0) return 0;
+  std::vector<int64_t> run_ptr;
+  run_ptr.reserve(static_cast<size_t>(nstack / 4 + 2));
+  run_ptr.push_back(0);
+  for (int64_t s = 1; s < nstack; ++s) {
+    if (ci[s] != ci[s - 1]) run_ptr.push_back(s);
+  }
+  run_ptr.push_back(nstack);
+  const int64_t nruns = static_cast<int64_t>(run_ptr.size()) - 1;
+  switch (dtype_code) {
+    case 1:
+      smm_runs<float, float>(
+          static_cast<float*>(c_data), static_cast<const float*>(a_data),
+          static_cast<const float*>(b_data), ai, bi, ci, run_ptr.data(),
+          nruns, m, n, k, static_cast<float>(alpha_re));
+      return 0;
+    case 3:
+      smm_runs<double, double>(
+          static_cast<double*>(c_data), static_cast<const double*>(a_data),
+          static_cast<const double*>(b_data), ai, bi, ci, run_ptr.data(),
+          nruns, m, n, k, alpha_re);
+      return 0;
+    case 5:
+      smm_runs<std::complex<float>, std::complex<float>>(
+          static_cast<std::complex<float>*>(c_data),
+          static_cast<const std::complex<float>*>(a_data),
+          static_cast<const std::complex<float>*>(b_data), ai, bi, ci,
+          run_ptr.data(), nruns, m, n, k,
+          std::complex<float>(static_cast<float>(alpha_re),
+                              static_cast<float>(alpha_im)));
+      return 0;
+    case 7:
+      smm_runs<std::complex<double>, std::complex<double>>(
+          static_cast<std::complex<double>*>(c_data),
+          static_cast<const std::complex<double>*>(a_data),
+          static_cast<const std::complex<double>*>(b_data), ai, bi, ci,
+          run_ptr.data(), nruns, m, n, k,
+          std::complex<double>(alpha_re, alpha_im));
+      return 0;
+    default:
+      return -1;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,164 @@
+"""Native host stack driver tests (mm_driver='host').
+
+The C++ `dbcsr_host_smm` kernel is the analog of the reference's CPU
+stack path (`dbcsr_mm_hostdrv.F:90`, offline-tuned SMM library
+`tools/build_libsmm`): it consumes the same sorted param stack as the
+device drivers and accumulates on the host.  Validated here against the
+NumPy oracle and the default engine path, like the generated
+libsmm_acc unit tests validate the GPU kernels against CPU results.
+"""
+
+import numpy as np
+import pytest
+
+from dbcsr_tpu import create, make_random_matrix, multiply, to_dense
+from dbcsr_tpu import native
+from dbcsr_tpu.acc import process_stack
+from dbcsr_tpu.acc.smm import prepare_stack
+from dbcsr_tpu.core.config import get_config, set_config
+
+
+def _random_stack(rng, na, nb, nc, s, m, n, k, dtype):
+    a = rng.standard_normal((na, m, k))
+    b = rng.standard_normal((nb, k, n))
+    c = rng.standard_normal((nc, m, n))
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        a = a + 1j * rng.standard_normal(a.shape)
+        b = b + 1j * rng.standard_normal(b.shape)
+        c = c + 1j * rng.standard_normal(c.shape)
+    a, b, c = (x.astype(dtype) for x in (a, b, c))
+    ai = rng.integers(0, na, s).astype(np.int32)
+    bi = rng.integers(0, nb, s).astype(np.int32)
+    ci = np.sort(rng.integers(0, nc, s)).astype(np.int32)
+    return a, b, c, ai, bi, ci
+
+
+def _oracle(c, a, b, ai, bi, ci, alpha):
+    out = c.copy().astype(c.dtype)
+    for s in range(len(ai)):
+        out[ci[s]] += (alpha * (a[ai[s]] @ b[bi[s]])).astype(c.dtype)
+    return out
+
+
+requires_native = pytest.mark.skipif(
+    native.get_lib() is None, reason="native library unavailable"
+)
+
+
+@requires_native
+@pytest.mark.parametrize(
+    "dtype", [np.float32, np.float64, np.complex64, np.complex128]
+)
+@pytest.mark.parametrize("mnk", [(4, 4, 4), (23, 23, 23), (5, 13, 23)])
+def test_native_host_smm_vs_oracle(dtype, mnk):
+    m, n, k = mnk
+    rng = np.random.default_rng(3)
+    a, b, c, ai, bi, ci = _random_stack(rng, 17, 19, 11, 300, m, n, k, dtype)
+    alpha = (1.5 - 0.5j) if np.issubdtype(dtype, np.complexfloating) else 1.5
+    got = c.copy()
+    assert native.host_smm(got, a, b, ai, bi, ci, alpha)
+    want = _oracle(c, a, b, ai, bi, ci, alpha)
+    single = np.finfo(np.dtype(dtype).type).eps > 1e-10
+    tol = 1e-4 if single else 1e-12
+    np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+
+
+@requires_native
+def test_process_stack_host_driver():
+    """mm_driver='host' routes through the planner and matches the
+    default engine path."""
+    rng = np.random.default_rng(5)
+    a, b, c, ai, bi, ci = _random_stack(
+        rng, 20, 20, 12, 400, 23, 23, 23, np.float64
+    )
+    auto = np.asarray(process_stack(c, a, b, ai, bi, ci, alpha=2.0))
+    set_config(mm_driver="host")
+    try:
+        plan = prepare_stack(c, a, b, ai, bi, ci)
+        assert plan.driver == "host"
+        got = np.asarray(process_stack(c, a, b, ai, bi, ci, alpha=2.0))
+    finally:
+        set_config(mm_driver="auto")
+    np.testing.assert_allclose(got, auto, rtol=1e-12, atol=1e-12)
+
+
+@requires_native
+def test_host_driver_empty_and_single_runs():
+    """Degenerate stacks: one entry, all entries on one C block."""
+    rng = np.random.default_rng(6)
+    a, b, c, ai, bi, ci = _random_stack(rng, 4, 4, 3, 8, 5, 5, 5, np.float64)
+    ci[:] = 1  # one run
+    got = c.copy()
+    assert native.host_smm(got, a, b, ai, bi, ci, 1.0)
+    np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.0),
+                               rtol=1e-12)
+    got1 = c.copy()
+    assert native.host_smm(got1, a, b, ai[:1], bi[:1], ci[:1], 1.0)
+    np.testing.assert_allclose(got1, _oracle(c, a, b, ai[:1], bi[:1],
+                                             ci[:1], 1.0), rtol=1e-12)
+
+
+@requires_native
+def test_full_multiply_host_driver_vs_oracle():
+    """A full engine multiply with the host driver matches the dense
+    oracle (the `dbcsr_test_multiply.F` pattern) and records its flops
+    under the 'host' driver in the statistics block."""
+    from dbcsr_tpu.core import stats
+
+    rbs, kbs, cbs = [2, 3, 5], [4, 2, 3], [3, 4]
+    a = make_random_matrix("a", rbs, kbs, occupation=0.7,
+                           rng=np.random.default_rng(1))
+    b = make_random_matrix("b", kbs, cbs, occupation=0.7,
+                           rng=np.random.default_rng(2))
+    set_config(mm_driver="host")
+    try:
+        stats.reset()
+        c = create("c", rbs, cbs)
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+        by_driver = {
+            d: f
+            for st in stats._by_mnk.values()
+            for d, f in st.by_driver.items()
+        }
+    finally:
+        set_config(mm_driver="auto")
+    want = to_dense(a) @ to_dense(b)
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-12, atol=1e-12)
+    assert "host" in by_driver and by_driver["host"] > 0
+
+
+def test_host_driver_unavailable_falls_back(monkeypatch):
+    """DBCSR_TPU_NATIVE=0 -> the planner warns and falls back to an XLA
+    plan; results stay correct."""
+    monkeypatch.setenv("DBCSR_TPU_NATIVE", "0")
+    rng = np.random.default_rng(7)
+    a, b, c, ai, bi, ci = _random_stack(rng, 6, 6, 4, 50, 4, 4, 4,
+                                        np.float64)
+    set_config(mm_driver="host")
+    try:
+        with pytest.warns(RuntimeWarning, match="host driver is unavailable"):
+            plan = prepare_stack(c, a, b, ai, bi, ci)
+        assert plan.driver != "host"
+        got = np.asarray(process_stack(c, a, b, ai, bi, ci))
+    finally:
+        set_config(mm_driver="auto")
+    np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.0),
+                               rtol=1e-12)
+
+
+@requires_native
+def test_host_driver_bf16_falls_back():
+    """bf16 has no native host kernel; the planner falls back."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(8)
+    a, b, c, ai, bi, ci = _random_stack(rng, 6, 6, 4, 50, 4, 4, 4,
+                                        np.float32)
+    a, b, c = (jnp.asarray(x, jnp.bfloat16) for x in (a, b, c))
+    set_config(mm_driver="host")
+    try:
+        with pytest.warns(RuntimeWarning, match="host driver is unavailable"):
+            plan = prepare_stack(c, a, b, ai, bi, ci)
+        assert plan.driver != "host"
+    finally:
+        set_config(mm_driver="auto")
