@@ -65,7 +65,10 @@ def bench_smm(nrep=5, stack_size=30000, m=23, n=23, k=23, dtype_enum=3,
     got = np.asarray(c, np.float64)
     scale = max(np.abs(want).max(), 1.0)
     max_err = np.abs(got - want).max() / scale
-    tol = 1e-3 if np.dtype(dtype).itemsize <= 4 else 1e-10
+    # bf16 stores C at ~8 bit mantissa: even exact f32 accumulation
+    # rounds to ~4e-3 relative on store, so 1e-3 would always "fail"
+    itemsize = np.dtype(dtype).itemsize
+    tol = 2e-2 if itemsize <= 2 else (1e-3 if itemsize <= 4 else 1e-10)
     ok = max_err < tol
 
     times = []
