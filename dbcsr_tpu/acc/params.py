@@ -7,8 +7,13 @@ produced by `dbcsr_tpu.acc.tune` and consulted at dispatch time — the
 role the reference's per-GPU JSON plays for `libsmm_acc_process`
 (`libsmm_acc.cpp:227-249` parameter lookup on kernel-cache miss).
 
-Schema per entry: {"m", "n", "k", "dtype", "driver": "pallas"|"xla",
-"grouping", "gflops"}.
+Schema per entry: {"m", "n", "k", "dtype", "stack_size",
+"driver": "pallas"|"xla"|..., "grouping", "gflops"}.  Rows are keyed by
+(m, n, k, dtype, stack_size): the same shape tuned at S=30k and S=800k
+keeps BOTH rows (through the tunnel, small-stack timings are
+latency-bound and would otherwise clobber production-scale rows —
+VERDICT r3 item 3), and dispatch picks the row nearest the live stack
+size.
 """
 
 from __future__ import annotations
@@ -45,10 +50,10 @@ def params_path(kind: Optional[str] = None) -> str:
     return os.path.join(_params_dir(), f"parameters_{kind or device_kind()}.json")
 
 
-def _key(m: int, n: int, k: int, dtype) -> str:
+def _key(m: int, n: int, k: int, dtype, stack_size) -> str:
     import numpy as np
 
-    return f"{m}x{n}x{k}:{np.dtype(dtype).name}"
+    return f"{m}x{n}x{k}:{np.dtype(dtype).name}:{int(stack_size)}"
 
 
 def _load(kind: Optional[str] = None) -> Dict:
@@ -62,19 +67,46 @@ def _load(kind: Optional[str] = None) -> Dict:
                 try:
                     with open(path) as f:
                         for e in json.load(f):
-                            table[_key(e["m"], e["n"], e["k"], e["dtype"])] = e
+                            table[_key(e["m"], e["n"], e["k"], e["dtype"],
+                                       e.get("stack_size", 0))] = e
                 except (OSError, ValueError, KeyError):
                     table = {}
             _cache[path] = table
         return _cache[path]
 
 
-def lookup(m: int, n: int, k: int, dtype) -> Optional[Dict]:
-    """Tuned entry for this (m, n, k, dtype) on the current device."""
+def lookup(m: int, n: int, k: int, dtype,
+           stack_size: Optional[int] = None) -> Optional[Dict]:
+    """Tuned entry for this (m, n, k, dtype) on the current device.
+
+    With ``stack_size``, the same-shape row tuned nearest that size (in
+    log space, larger-S winning ties) is returned; without it, the
+    largest-S row (production scale)."""
+    import math
+
+    import numpy as np
+
     try:
-        return _load().get(_key(m, n, k, dtype))
+        table = _load()
     except Exception:
         return None
+    want_dtype = np.dtype(dtype).name
+    rows = [
+        e for e in table.values()
+        if (e["m"], e["n"], e["k"]) == (m, n, k) and e["dtype"] == want_dtype
+    ]
+    if not rows:
+        return None
+    if stack_size is None:
+        return max(rows, key=lambda e: e.get("stack_size", 0))
+    want = math.log(max(int(stack_size), 1))
+    return min(
+        rows,
+        key=lambda e: (
+            abs(math.log(max(e.get("stack_size", 1), 1)) - want),
+            -e.get("stack_size", 0),
+        ),
+    )
 
 
 # a donor entry only predicts for shapes within this flop-count ratio;
@@ -84,7 +116,8 @@ _PREDICT_MAX_FLOP_RATIO = 16.0
 _predict_cache: Dict[tuple, Optional[Dict]] = {}
 
 
-def predict(m: int, n: int, k: int, dtype) -> Optional[Dict]:
+def predict(m: int, n: int, k: int, dtype,
+            stack_size: Optional[int] = None) -> Optional[Dict]:
     """Nearest-tuned-entry prediction for an UNTUNED (m, n, k).
 
     The analog of the reference's predictive-modeling pipeline
@@ -93,16 +126,19 @@ def predict(m: int, n: int, k: int, dtype) -> Optional[Dict]:
     space is {driver, grouping}, so nearest-neighbor in log-flops space
     within the same dtype — capped at a 16x flop-count ratio, so a lone
     distant donor can't dictate dispatch globally — is a sound
-    estimator.  Results are memoized (this sits on the multiply hot
-    path).  Returns a copy of the donor entry tagged "predicted_from"."""
+    estimator; among equally-near shapes the row tuned nearest the live
+    stack size wins.  Results are memoized (this sits on the multiply
+    hot path).  Returns a copy of the donor entry tagged
+    "predicted_from"."""
     import numpy as np
 
-    exact = lookup(m, n, k, dtype)
+    exact = lookup(m, n, k, dtype, stack_size)
     if exact is not None:
         return exact
     # keyed by the resolved params file so env-redirected tables (tests,
     # DBCSR_TPU_PARAMS_DIR) never serve stale predictions
-    ck = (params_path(), m, n, k, np.dtype(dtype).name)
+    sbucket = None if stack_size is None else int(np.log2(max(stack_size, 1)))
+    ck = (params_path(), m, n, k, np.dtype(dtype).name, sbucket)
     if ck in _predict_cache:
         return _predict_cache[ck]
     gen0 = _table_gen
@@ -113,13 +149,21 @@ def predict(m: int, n: int, k: int, dtype) -> Optional[Dict]:
     want_dtype = np.dtype(dtype).name
     best, best_d = None, None
     target = np.log(float(m) * n * k)
+    want_s = None if stack_size is None else np.log(float(max(stack_size, 1)))
     max_d = np.log(_PREDICT_MAX_FLOP_RATIO)
     for e in table.values():
         if e["dtype"] != want_dtype:
             continue
         d = abs(np.log(float(e["m"]) * e["n"] * e["k"]) - target)
-        if d <= max_d and (best_d is None or d < best_d):
-            best, best_d = e, d
+        if d > max_d:
+            continue
+        if want_s is None:
+            ds = -float(e.get("stack_size", 0))  # larger S preferred
+        else:
+            ds = abs(np.log(float(max(e.get("stack_size", 1), 1))) - want_s)
+        key = (d, ds)
+        if best_d is None or key < best_d:
+            best, best_d = e, key
     out = None
     if best is not None:
         out = dict(best)
@@ -135,7 +179,8 @@ def save_entry(entry: Dict, kind: Optional[str] = None) -> str:
     kind = kind or device_kind()
     table = _load(kind)
     with _lock:
-        table[_key(entry["m"], entry["n"], entry["k"], entry["dtype"])] = entry
+        table[_key(entry["m"], entry["n"], entry["k"], entry["dtype"],
+                   entry.get("stack_size", 0))] = entry
         os.makedirs(_params_dir(), exist_ok=True)
         path = params_path(kind)
         with open(path, "w") as f:

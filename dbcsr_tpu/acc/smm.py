@@ -396,7 +396,8 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
             stacklevel=2,
         )
     tuned = params_mod.predict(
-        a_data.shape[1], b_data.shape[2], a_data.shape[2], c_data.dtype
+        a_data.shape[1], b_data.shape[2], a_data.shape[2], c_data.dtype,
+        stack_size=S,
     )
     tuned_driver = tuned.get("driver") if tuned else None
     plan = StackPlan()

@@ -53,6 +53,31 @@ def tune_smm(m: int, n: int, k: int, dtype_enum: int = 1,
                              jax, jnp)
 
 
+class _Candidates(list):
+    """Candidate list that persists the best row after every append: a
+    later candidate that crashes the PROCESS (a Mosaic fatal error
+    aborts before Python sees an exception) must not lose the timings
+    already measured — the sweep's resumability contract."""
+
+    def __init__(self, m, n, k, dtype, stack_size, out):
+        super().__init__()
+        self._row = {"m": m, "n": n, "k": k, "dtype": np.dtype(dtype).name,
+                     "stack_size": stack_size}
+        self._out = out
+        self._best = None
+
+    def append(self, cand) -> None:
+        super().append(cand)
+        if self._best is None or cand["gflops"] > self._best:
+            self._best = cand["gflops"]
+            entry = {**self._row, **cand,
+                     "gflops": round(cand["gflops"], 2)}
+            try:
+                params_mod.save_entry(entry)
+            except OSError as exc:
+                self._out(f"  (best-so-far persist failed: {exc})")
+
+
 def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
 
     from dbcsr_tpu.acc import pallas_smm
@@ -69,7 +94,7 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
     bi = rng.integers(0, nb - 1, stack_size).astype(np.int32)
     ci = np.sort(rng.integers(0, nc, stack_size)).astype(np.int32)
     flops = 2.0 * m * n * k * stack_size
-    candidates = []
+    candidates = _Candidates(m, n, k, dtype, stack_size, out)
 
     # XLA gather/segment-sum path (always available)
     chunk = bucket_size(min(stack_size, 30000))

@@ -53,6 +53,41 @@ def test_predict_falls_back_to_nearest_tuned_entry(tmp_path, monkeypatch):
         params_mod._cache.clear()
 
 
+def test_params_stack_size_rows_coexist(tmp_path, monkeypatch):
+    """Rows for the same shape at different stack sizes coexist (keyed
+    by (m,n,k,dtype,S)), and lookup/predict pick the row nearest the
+    live stack size — VERDICT r3 item 3's S>=100k requirement."""
+    monkeypatch.setenv("DBCSR_TPU_PARAMS_DIR", str(tmp_path))
+    params_mod._cache.clear()
+    params_mod._predict_cache.clear()
+    base = {"m": 23, "n": 23, "k": 23, "dtype": "float64",
+            "grouping": None, "gflops": 1.0}
+    params_mod.save_entry({**base, "stack_size": 30000, "driver": "xla"})
+    params_mod.save_entry({**base, "stack_size": 800000,
+                           "driver": "xla_group"})
+    try:
+        # both rows survive in the file
+        import json
+
+        with open(params_mod.params_path()) as fh:
+            assert len(json.load(fh)) == 2
+        # S-aware: near 30k -> the 30k row; near 800k -> the 800k row
+        assert params_mod.lookup(23, 23, 23, "float64", 20000)["driver"] == "xla"
+        assert (params_mod.lookup(23, 23, 23, "float64", 900000)["driver"]
+                == "xla_group")
+        # no S -> production scale (largest S)
+        assert params_mod.lookup(23, 23, 23, "float64")["driver"] == "xla_group"
+        # predict() for an untuned shape prefers the donor tuned nearest
+        # the live stack size
+        p_small = params_mod.predict(21, 21, 21, "float64", stack_size=30000)
+        p_big = params_mod.predict(21, 21, 21, "float64", stack_size=700000)
+        assert p_small["driver"] == "xla" and p_big["driver"] == "xla_group"
+        assert p_big["predicted_from"] == (23, 23, 23)
+    finally:
+        params_mod._cache.clear()
+        params_mod._predict_cache.clear()
+
+
 def test_tune_smm_writes_entry(tmp_path, monkeypatch):
     from dbcsr_tpu.acc.tune import tune_smm
 

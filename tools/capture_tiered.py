@@ -159,6 +159,24 @@ TIER4_SWEEP = [
     (5, 13, 23, 3, 100000), (13, 23, 23, 3, 100000), (23, 23, 13, 3, 100000),
     (5, 5, 5, 1, 100000), (5, 5, 5, 3, 100000), (4, 4, 4, 3, 100000),
     (23, 23, 23, 3, 30000), (23, 23, 23, 1, 800000), (23, 23, 23, 7, 100000),
+    # extension toward parameters_K20X.json breadth (rows are keyed by
+    # (m,n,k,dtype,S) — S variants coexist): production-scale north
+    # star, power-of-two ladder, the reference unittest3 large blocks
+    # (45/67/78), mixed-shape f32, c64, and S∈{30k,800k} spreads
+    (23, 23, 23, 3, 800000), (23, 23, 23, 1, 30000),
+    (32, 32, 32, 3, 100000), (64, 64, 64, 3, 100000),
+    (8, 8, 8, 3, 100000), (8, 8, 8, 1, 100000),
+    (16, 16, 16, 3, 100000), (16, 16, 16, 1, 100000),
+    (4, 4, 4, 1, 100000), (4, 4, 4, 3, 30000),
+    (45, 45, 45, 3, 100000), (45, 45, 45, 1, 100000),
+    (67, 67, 67, 1, 100000), (78, 78, 78, 1, 100000),
+    (5, 13, 23, 1, 100000), (13, 23, 23, 1, 100000),
+    (23, 13, 5, 3, 100000), (23, 5, 13, 3, 100000),
+    (23, 23, 23, 5, 100000), (32, 32, 32, 1, 800000),
+    (64, 64, 64, 1, 30000), (13, 13, 13, 9, 100000),
+    (5, 5, 5, 9, 100000), (16, 16, 16, 9, 100000),
+    (23, 23, 23, 9, 800000), (45, 45, 45, 9, 100000),
+    (8, 8, 8, 1, 30000), (13, 13, 13, 1, 30000),
 ]
 
 
