@@ -28,6 +28,25 @@ from typing import Dict, Optional
 _lock = threading.Lock()
 _cache: Dict[str, Dict] = {}
 _table_gen = 0  # bumped by save_entry; guards predict memoization
+# (path, generation) -> {(m, n, k, dtype): [entries]}; one generation kept
+_shape_index: Dict[tuple, Dict] = {}
+
+
+def _by_shape(path: str, table: Dict) -> Dict:
+    """Secondary index over the table for O(1) per-shape row lists
+    (lookup sits on the multiply hot path via predict)."""
+    key = (path, _table_gen)
+    with _lock:
+        idx = _shape_index.get(key)
+        if idx is None:
+            idx = {}
+            for e in table.values():
+                idx.setdefault(
+                    (e["m"], e["n"], e["k"], e["dtype"]), []
+                ).append(e)
+            _shape_index.clear()
+            _shape_index[key] = idx
+    return idx
 
 
 def _params_dir() -> str:
@@ -87,14 +106,11 @@ def lookup(m: int, n: int, k: int, dtype,
     import numpy as np
 
     try:
+        path = params_path()
         table = _load()
     except Exception:
         return None
-    want_dtype = np.dtype(dtype).name
-    rows = [
-        e for e in table.values()
-        if (e["m"], e["n"], e["k"]) == (m, n, k) and e["dtype"] == want_dtype
-    ]
+    rows = _by_shape(path, table).get((m, n, k, np.dtype(dtype).name), [])
     if not rows:
         return None
     if stack_size is None:
@@ -136,9 +152,12 @@ def predict(m: int, n: int, k: int, dtype,
     if exact is not None:
         return exact
     # keyed by the resolved params file so env-redirected tables (tests,
-    # DBCSR_TPU_PARAMS_DIR) never serve stale predictions
-    sbucket = None if stack_size is None else int(np.log2(max(stack_size, 1)))
-    ck = (params_path(), m, n, k, np.dtype(dtype).name, sbucket)
+    # DBCSR_TPU_PARAMS_DIR) never serve stale predictions.  Exact S in
+    # the key: the engine buckets stack lengths already, so distinct S
+    # values stay few — and a bucketed key would make the nearest-S
+    # donor choice depend on which S in the bucket was queried first
+    ck = (params_path(), m, n, k, np.dtype(dtype).name,
+          None if stack_size is None else int(stack_size))
     if ck in _predict_cache:
         return _predict_cache[ck]
     gen0 = _table_gen
