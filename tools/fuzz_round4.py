@@ -53,12 +53,14 @@ def main(nconfigs: int = 200, seed: int = 2026_0730) -> int:
     cap0 = mm._DENSE_MAX_CANVAS
     for i in range(nconfigs):
         feature = rng.choice(["crosspack", "rect_mesh", "chunked_dense",
-                              "tas_auto"])
+                              "tas_auto", "host"])
         dtype = {
             "crosspack": rng.choice([np.float32, "bf16"]),
             "rect_mesh": rng.choice([np.float64, np.float32, np.complex128]),
             "chunked_dense": np.float64,
             "tas_auto": np.float64,
+            "host": rng.choice([np.float64, np.float32, np.complex128,
+                                np.complex64]),
         }[feature]
         uniform = feature in ("crosspack", "chunked_dense")
         szpool = [1, 2, 3, 5, 7, 8, 13, 23]
@@ -93,15 +95,13 @@ def main(nconfigs: int = 200, seed: int = 2026_0730) -> int:
         c = dt.make_random_matrix("c", m_s, n_s, dtype=dtj,
                                   occupation=float(rng.uniform(0, 0.5)),
                                   rng=rng)
+        acc_dt = (np.complex128
+                  if dtype in (np.complex128, np.complex64) else np.float64)
         want = alpha * (
-            dt.to_dense(a).astype(np.complex128 if dtype is np.complex128
-                                  else np.float64)
-            @ dt.to_dense(b).astype(np.complex128 if dtype is np.complex128
-                                    else np.float64)
-        ) + beta * dt.to_dense(c).astype(
-            np.complex128 if dtype is np.complex128 else np.float64)
+            dt.to_dense(a).astype(acc_dt) @ dt.to_dense(b).astype(acc_dt)
+        ) + beta * dt.to_dense(c).astype(acc_dt)
         tol = 5e-2 if dtype == "bf16" else (
-            5e-4 if dtype is np.float32 else 1e-10)
+            5e-4 if dtype in (np.float32, np.complex64) else 1e-10)
         try:
             if feature == "crosspack":
                 set_config(mm_driver="pallas_cross", validate_kernels=True)
@@ -123,6 +123,13 @@ def main(nconfigs: int = 200, seed: int = 2026_0730) -> int:
                 finally:
                     set_config(mm_dense=None)
                     mm._DENSE_MAX_CANVAS = cap0
+                got = dt.to_dense(c)
+            elif feature == "host":
+                set_config(mm_driver="host")
+                try:
+                    dt.multiply("N", "N", alpha, a, b, beta, c)
+                finally:
+                    set_config(mm_driver="auto")
                 got = dt.to_dense(c)
             else:  # tas_auto
                 mesh = (meshes[rng.choice(["sq8", "rect6"])]
