@@ -1,0 +1,119 @@
+"""Exhaustive kernel validation over the committed parameter table.
+
+The reference generates a unit test instantiating a multiply check for
+EVERY (m, n, k) triplet in the GPU's parameter file
+(`generate_libsmm_acc_unittest_multiply.py` +
+`libsmm_acc_unittest_multiply.cpp.template`).  This is the same gate
+for the TPU build: every row the autotuner ever committed to
+`acc/params/parameters_*.json` must drive its chosen kernel variant to
+an oracle-correct result — a tuned row that selects a broken lowering
+is caught here, not at a user's first dispatch.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import dbcsr_tpu  # noqa: F401 — jax config via conftest
+from dbcsr_tpu.acc.smm import execute_stack, prepare_stack
+from dbcsr_tpu.core.config import set_config
+from dbcsr_tpu.core.kinds import dtype_of
+
+_PARAMS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "dbcsr_tpu", "acc", "params",
+)
+
+
+def _all_rows():
+    rows = []
+    for path in sorted(glob.glob(os.path.join(_PARAMS_DIR, "*.json"))):
+        with open(path) as fh:
+            for e in json.load(fh):
+                rows.append((os.path.basename(path), e))
+    return rows
+
+
+_ROWS = _all_rows()
+
+
+def _row_id(arg):
+    fname, e = arg
+    return (f"{e['m']}x{e['n']}x{e['k']}:{e['dtype']}"
+            f":S{e.get('stack_size', 0)}:{e['driver']}"
+            f":{e.get('variant') or e.get('r0') or e.get('grouping') or ''}")
+
+
+@pytest.mark.parametrize("row", _ROWS, ids=map(_row_id, _ROWS))
+def test_tuned_row_drives_correct_kernel(row, tmp_path, monkeypatch):
+    """Dispatch through a table containing exactly this row (so auto
+    selection follows it) and validate against the f64 host oracle."""
+    _, e = row
+    dtype = np.dtype(e["dtype"]) if e["dtype"] != "bfloat16" else None
+    m, n, k = e["m"], e["n"], e["k"]
+    # small stack, same shape/dtype as the row; the row's stack_size is
+    # a tuning condition, not a kernel parameter, so a short stack
+    # exercises the same compiled variant cheaply
+    rng = np.random.default_rng(m * 131 + n * 17 + k)
+    na, nb, nc, s = 9, 8, 6, 160
+    if dtype is None:
+        import jax.numpy as jnp
+
+        a = jnp.asarray(rng.standard_normal((na, m, k)), jnp.bfloat16)
+        b = jnp.asarray(rng.standard_normal((nb, k, n)), jnp.bfloat16)
+        c = jnp.zeros((nc, m, n), jnp.bfloat16)
+        tol = 5e-2
+    else:
+        cplx = np.issubdtype(dtype, np.complexfloating)
+        a = rng.standard_normal((na, m, k))
+        b = rng.standard_normal((nb, k, n))
+        if cplx:
+            a = a + 1j * rng.standard_normal(a.shape)
+            b = b + 1j * rng.standard_normal(b.shape)
+        a = a.astype(dtype)
+        b = b.astype(dtype)
+        c = np.zeros((nc, m, n), dtype)
+        tol = 1e-4 if np.dtype(dtype).itemsize <= (8 if cplx else 4) else 1e-10
+    ai = rng.integers(0, na, s).astype(np.int32)
+    bi = rng.integers(0, nb, s).astype(np.int32)
+    ci = np.sort(rng.integers(0, nc, s)).astype(np.int32)
+
+    # a params dir holding ONLY this row: auto dispatch must follow it
+    table = tmp_path / "parameters_test.json"
+    table.write_text(json.dumps([e]))
+    monkeypatch.setenv("DBCSR_TPU_PARAMS_DIR", str(tmp_path))
+    from dbcsr_tpu.acc import params as params_mod
+
+    params_mod._cache.clear()
+    params_mod._predict_cache.clear()
+    monkeypatch.setattr(params_mod, "params_path",
+                        lambda kind=None: str(table))
+    acc_dt = (np.complex128 if (dtype is not None and
+                                np.issubdtype(dtype, np.complexfloating))
+              else np.float64)
+    set_config(mm_driver="auto", validate_kernels=True)
+    try:
+        tuned = params_mod.predict(m, n, k,
+                                   dtype_of(9) if dtype is None else dtype,
+                                   stack_size=s)
+        assert tuned is not None and tuned["driver"] == e["driver"], (
+            "the single-row table must drive dispatch to the row's driver"
+        )
+        plan = prepare_stack(c, a, b, ai, bi, ci)
+        got = np.asarray(execute_stack(c, a, b, plan, 1.0)).astype(acc_dt)
+    finally:
+        set_config(mm_driver="auto")
+        params_mod._cache.clear()
+        params_mod._predict_cache.clear()
+
+    want = np.zeros((nc, m, n), acc_dt)
+    np.add.at(
+        want, ci,
+        np.einsum("smk,skn->smn", np.asarray(a, want.dtype)[ai],
+                  np.asarray(b, want.dtype)[bi]),
+    )
+    err = np.abs(got - want).max() / max(1.0, np.abs(want).max())
+    assert err < tol, f"row {e} produced rel err {err:.3e}"
