@@ -121,6 +121,31 @@ def impose_sparsity(dense: np.ndarray, matrix: BlockSparseMatrix) -> np.ndarray:
     return out
 
 
+_pos_term_jit = None
+
+
+def _pos_checksum_bin(data, ro, co):
+    """Jitted per-bin position-dependent checksum term (one compiled
+    callable, retraced per bin shape; returns a device scalar)."""
+    global _pos_term_jit
+    if _pos_term_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def _term(data, ro, co):
+            bm, bn = data.shape[1], data.shape[2]
+            grow = ro[:, None, None] + 1.0 + jnp.arange(
+                bm, dtype=jnp.float64)[None, :, None]
+            gcol = co[:, None, None] + 1.0 + jnp.arange(
+                bn, dtype=jnp.float64)[None, None, :]
+            w = jnp.log(jnp.abs(grow * gcol))
+            return (jnp.real(data).astype(jnp.float64) * w).sum()
+
+        _pos_term_jit = _term
+    return _pos_term_jit(data, ro, co)
+
+
 def checksum(matrix: BlockSparseMatrix, pos: bool = False) -> float:
     """Scalar checksum (ref `dbcsr_checksum`, `src/dist/dbcsr_dist_util.F:431`).
 
@@ -131,14 +156,30 @@ def checksum(matrix: BlockSparseMatrix, pos: bool = False) -> float:
     positions, which the plain sum of squares cannot.
     """
     if pos:
+        # per-bin DEVICE reduction, one 8-byte fetch per bin: the
+        # previous host-loop implementation fetched every block —
+        # through the axon tunnel a full-matrix d2h fetch persistently
+        # degrades the session (PERF_NOTES.md), and the perf driver
+        # computes this checksum after every run
+        import jax.numpy as jnp
+
         row_off = matrix.row_blk_offsets
         col_off = matrix.col_blk_offsets
+        rows, cols = matrix.entry_coords()
         total = 0.0
-        for r, c, blk in matrix.iterate_blocks():
-            grow = row_off[r] + 1 + np.arange(blk.shape[0])[:, None]
-            gcol = col_off[c] + 1 + np.arange(blk.shape[1])[None, :]
-            w = np.log(np.abs(grow.astype(np.float64) * gcol))
-            total += float((np.real(blk).astype(np.float64) * w).sum())
+        for b_id, b in enumerate(matrix.bins):
+            if b.count == 0:
+                continue
+            mask = matrix.ent_bin == b_id
+            ro = np.zeros(b.count, np.float64)
+            co = np.zeros(b.count, np.float64)
+            slots = matrix.ent_slot[mask]
+            ro[slots] = row_off[rows[mask]]
+            co[slots] = col_off[cols[mask]]
+            total += float(
+                _pos_checksum_bin(b.data[: b.count], jnp.asarray(ro),
+                                  jnp.asarray(co))
+            )
         return total
     norms = matrix.block_norms().astype(np.float64)
     if matrix.matrix_type != NO_SYMMETRY:
