@@ -61,10 +61,15 @@ def bench_smm(nrep=5, stack_size=30000, m=23, n=23, k=23, dtype_enum=3,
 
     c = jnp.zeros((nc, m, n), dtype)
     c = process_stack(c, a, b, ai, bi, ci, 1.0)
-    jax.block_until_ready(c)
-    got = np.asarray(c, np.float64)
+    # compare ON DEVICE and fetch 8 bytes: a full-result d2h fetch here
+    # (tens of MB) persistently degrades the axon tunnel session and
+    # can wedge the kernels that follow (PERF_NOTES.md)
     scale = max(np.abs(want).max(), 1.0)
-    max_err = np.abs(got - want).max() / scale
+    cmp_dtype = (jnp.float32 if np.dtype(dtype).itemsize <= 4
+                 and not jax.config.jax_enable_x64 else jnp.float64)
+    max_err = float(
+        jnp.max(jnp.abs(c.astype(cmp_dtype) - jnp.asarray(want, cmp_dtype)))
+    ) / scale
     # bf16 stores C at ~8 bit mantissa: even exact f32 accumulation
     # rounds to ~4e-3 relative on store, so 1e-3 would always "fail"
     itemsize = np.dtype(dtype).itemsize
