@@ -281,13 +281,18 @@ def _validate_pallas_kernel(c_data, a_data, b_data, a_idx, b_idx, c_idx,
             a_pad_row=a_pad_row, b_pad_row=b_pad_row, grouping=grouping,
             variant=variant,
         )
-    got = np.asarray(got)
-    a_h = np.asarray(a_data)[ai].astype(np.float64)
-    b_h = np.asarray(b_data)[bi].astype(np.float64)
+    a_h = np.asarray(a_data[ai]).astype(np.float64)
+    b_h = np.asarray(b_data[bi]).astype(np.float64)
     ref = np.zeros(c_data.shape, np.float64)
     np.add.at(ref, ci, np.einsum("smk,skn->smn", a_h, b_h))
     scale = max(np.max(np.abs(ref)), 1.0)
-    err = np.max(np.abs(got.astype(np.float64) - ref)) / scale
+    # compare ON DEVICE, fetch one scalar: fetching the full C-shaped
+    # validation result d2h persistently degrades the axon tunnel
+    # (PERF_NOTES.md) and this gate runs in the production path
+    cmp_dtype = (jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    err = float(
+        jnp.max(jnp.abs(got.astype(cmp_dtype) - jnp.asarray(ref, cmp_dtype)))
+    ) / scale
     tol = 5e-2 if got.dtype == jnp.bfloat16 else 1e-5
     if not np.isfinite(err) or err > tol:
         m, k = a_data.shape[1:]
