@@ -259,9 +259,11 @@ def attempt() -> dict:
     log("tier 3 (full bench f64 + bf16 + f32)")
     ok3 = run_bench({}, 1800, 3)
     # bf16/f32 variants are recorded but do NOT gate tier 4: a
-    # dtype-specific kernel crash must not block the tuner sweep
-    run_bench({"DBCSR_TPU_BENCH_DTYPE": "9"}, 1800, 3)
+    # dtype-specific kernel crash must not block the tuner sweep.
+    # f32 runs BEFORE bf16 — the 23^3 bf16 Mosaic fatal must not cost
+    # the f32 leg (or wedge the window) first
     run_bench({"DBCSR_TPU_BENCH_DTYPE": "1"}, 1800, 3)
+    run_bench({"DBCSR_TPU_BENCH_DTYPE": "9"}, 1800, 3)
     st["tier3"] = ok3
     if ok3:
         log("tier 4 (autotuner sweep at production stack sizes)")
