@@ -468,14 +468,29 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
                 b_pad_row = b_data.shape[0]
             # cross-packed variant: forced by config, tuned-table
             # choice, or — on a REAL TPU — the default for untuned
-            # f32/bf16 shapes (P*R entries per MXU pass).  A compile
-            # failure demotes the shape for the session
-            # (_cross_disabled), so dispatch can never be bricked by a
-            # Mosaic lowering gap; ineligible stacks fall through to
-            # the base kernel
+            # f32 shapes (P*R entries per MXU pass; bf16 excluded, see
+            # below).  A compile failure demotes the shape for the
+            # session (_cross_disabled), so dispatch can never be
+            # bricked by a Mosaic lowering gap; ineligible stacks fall
+            # through to the base kernel
             shape_key = _stack_shape_key(c_data, a_data, b_data)
+            # bf16 crosspack runs ONLY from an EXACT tuned row: a 23^3
+            # bf16 crosspack launch dies with a Mosaic FATAL (process
+            # abort — the in-process demotion can't catch it; observed
+            # 2026-07-31, capture_loop.log), and the abort is
+            # shape-specific, so neither untuned auto-crosspack nor a
+            # nearest-neighbor-predicted donor row (proved on a
+            # DIFFERENT shape) may select it.  The tuner subprocess is
+            # the sacrificial process that proves each exact shape on
+            # this backend first.
+            is_bf16 = jnp.dtype(c_data.dtype) == jnp.bfloat16
+            if tuned_cross and is_bf16 and "predicted_from" in tuned:
+                tuned_cross = False
+                grouping = None  # donor's crosspack R must not leak
+                # into the base kernel (same rule as below)
             auto_cross = (
                 cfg.mm_driver == "auto" and tuned is None and _on_tpu()
+                and not is_bf16
             )
             want_cross = shape_key not in _cross_disabled and (
                 cfg.mm_driver == "pallas_cross"
