@@ -70,6 +70,18 @@ def main():
 
     dtype_enum = int(os.environ.get("DBCSR_TPU_BENCH_DTYPE", "3"))  # 3 = f64
     nrep = int(os.environ.get("DBCSR_TPU_BENCH_NREP", "3"))
+    if fallback:
+        # CPU production configuration: the native C++ stack driver is
+        # ~1.9x the XLA-CPU drivers on the north-star stack (the
+        # reference likewise selects its tuned CPU SMM library via
+        # MM_DRIVER=smm on CPU, dbcsr_config.F:34-38); falls back to
+        # auto inside prepare_stack when unavailable for the dtype
+        from dbcsr_tpu.acc.smm import _host_smm_available
+        from dbcsr_tpu.core.config import set_config
+        from dbcsr_tpu.core.kinds import dtype_of as _dtype_of
+
+        if _host_smm_available(_dtype_of(dtype_enum)):
+            set_config(mm_driver="host")
     cfg = PerfConfig(
         m=10000, n=10000, k=10000,
         sparsity_a=0.9, sparsity_b=0.9, sparsity_c=0.9,
