@@ -113,6 +113,20 @@ def _c_map(s, ai, bi, ci):
     return (ci[s], 0, 0)
 
 
+def _dot_precision(dtype):
+    """MXU precision per operand dtype.  HIGHEST forces true-f32
+    multi-pass contraction for f32 inputs (the default single bf16
+    pass loses ~1e-3 relative — caught by the validate_kernels gate on
+    hardware).  bf16 operands MUST use DEFAULT: this Mosaic rejects an
+    fp32 contract precision on bf16 vectors ("Bad lhs type" fatal,
+    observed on-chip 2026-07-31), and bf16 inputs gain nothing from
+    extra passes — the MXU multiplies bf16 exactly into the f32
+    accumulator either way."""
+    if jnp.dtype(dtype) == jnp.dtype(jnp.bfloat16):
+        return jax.lax.Precision.DEFAULT
+    return jax.lax.Precision.HIGHEST
+
+
 def _smm_kernel(ai_ref, bi_ref, ci_ref, *refs, r_grp, kmerge):
     a_refs = refs[:r_grp]
     b_refs = refs[r_grp : 2 * r_grp]
@@ -124,10 +138,6 @@ def _smm_kernel(ai_ref, bi_ref, ci_ref, *refs, r_grp, kmerge):
     cur = ci_ref[s]
     prev = ci_ref[jnp.maximum(s - 1, 0)]
     first = jnp.logical_or(s == 0, cur != prev)
-    # HIGHEST: true-f32 MXU passes for f32 inputs (default would be
-    # one bf16 pass, ~1e-3 relative error — caught by the
-    # validate_kernels gate on real hardware); bf16 inputs stay
-    # single-pass with f32 accumulation either way
     if kmerge and r_grp > 1:
         # k-merged variant (the in-kernel sibling of the engine's
         # xla_group R-tiling): ONE (R*k, m)^T x (R*k, n) MXU dot per
@@ -141,7 +151,7 @@ def _smm_kernel(ai_ref, bi_ref, ci_ref, *refs, r_grp, kmerge):
             a_cat, b_cat,
             (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=_dot_precision(a_cat.dtype),
         )
     else:
         contrib = jnp.zeros(acc_ref.shape, jnp.float32)
@@ -151,7 +161,7 @@ def _smm_kernel(ai_ref, bi_ref, ci_ref, *refs, r_grp, kmerge):
                 b_refs[r][0],
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
+                precision=_dot_precision(a_refs[r].dtype),
             )
     contrib = alpha_ref[0, 0] * contrib
 
@@ -419,7 +429,7 @@ def _crosspack_epilogue(a_cols, b_cols, cl_ref, alpha_ref, c_refs, o_refs,
         a_all, b_all,
         (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=_dot_precision(a_all.dtype),
     )
     alpha = alpha_ref[0, 0]
     for p in range(P):
