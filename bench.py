@@ -89,6 +89,13 @@ def main():
         m_sizes=[(1, 23)], n_sizes=[(1, 23)], k_sizes=[(1, 23)],
     )
     res = run_perf(cfg, verbose=False)
+    if os.environ.get("DBCSR_TPU_BENCH_TIMINGS") == "1":
+        # phase breakdown to stderr (with DBCSR_TPU_DENSE_PROFILE=1 the
+        # dense path fences between phases so the buckets are honest
+        # on-chip times, not async dispatch)
+        from dbcsr_tpu.core import timings
+
+        timings.report(out=lambda s: print(s, file=sys.stderr))
     from dbcsr_tpu.core.kinds import dtype_of
 
     dname = {"float64": "dreal", "float32": "sreal"}.get(

@@ -28,6 +28,7 @@ final pass keeping blocks with ||C||² >= eps²
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -417,6 +418,33 @@ def _blocks_to_dense(data, rows, cols, nbr, nbc, bm, bn):
     return _scatter_bin_to_canvas(canvas, data, ro, co, bm=bm, bn=bn)
 
 
+def _carve_full_pattern(cd, nbr, nbc, bm, bn):
+    """Carve a product canvas into the FULL row-major block pattern.
+
+    Two lowerings, selected by ``DBCSR_TPU_DENSE_CARVE``:
+    * ``gather`` — element-offset advanced-indexing gather (the
+      historical path): builds (nbr*nbc, bm, bn) index tensors, i.e. an
+      element-granular XLA gather over the whole canvas.
+    * ``reshape`` — reshape/transpose/reshape: the full row-major
+      carve is a pure layout permutation, which XLA lowers to a
+      near-bandwidth copy instead of a 10^8-entry gather.  The 4-D
+      intermediate is transient inside one fused program (the round-2
+      HBM-thrash lesson was about MATERIALIZED grid temps across
+      program boundaries) — but until it is A/B-timed on real
+      hardware the measured ``gather`` path stays the default.
+    The env is read at first trace; switch it only across processes."""
+    if os.environ.get("DBCSR_TPU_DENSE_CARVE", "gather") == "gather":
+        keys = jnp.arange(nbr * nbc, dtype=jnp.int32)
+        ro = (keys // nbc) * bm
+        co = (keys % nbc) * bn
+        return _gather_bin_from_canvas(cd, ro, co, bm=bm, bn=bn)
+    return (
+        cd.reshape(nbr, bm, nbc, bn)
+        .transpose(0, 2, 1, 3)
+        .reshape(nbr * nbc, bm, bn)
+    )
+
+
 @functools.partial(jax.jit, donate_argnums=2, static_argnames=("nbr", "nbc", "bm", "bn"))
 def _dense_product_to_blocks(ad, bd, c_blocks, c_keys, alpha, beta, nbr, nbc, bm, bn):
     """Matmul on 2-D canvases, then carve the FULL row-major block
@@ -427,11 +455,25 @@ def _dense_product_to_blocks(ad, bd, c_blocks, c_keys, alpha, beta, nbr, nbc, bm
         ad, bd, (((1,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=acc,
     )
-    keys = jnp.arange(nbr * nbc, dtype=jnp.int32)
-    ro = (keys // nbc) * bm
-    co = (keys % nbc) * bn
-    out = alpha * _gather_bin_from_canvas(cd, ro, co, bm=bm, bn=bn)
+    out = alpha * _carve_full_pattern(cd, nbr, nbc, bm, bn)
     return out.at[c_keys].add(beta * c_blocks.astype(acc), mode="drop")
+
+
+@jax.jit
+def _dense_dot_only(ad, bd):
+    """Profile-mode split: the bare canvas matmul as its own program so
+    a fence can time it separately from the carve."""
+    return jax.lax.dot_general(
+        ad, bd, (((1,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=ad.dtype,
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("nbr", "nbc", "bm", "bn"))
+def _dense_carve_only(cd, c_blocks, c_keys, alpha, beta, nbr, nbc, bm, bn):
+    """Profile-mode split: carve + beta-merge as its own program."""
+    out = alpha * _carve_full_pattern(cd, nbr, nbc, bm, bn)
+    return out.at[c_keys].add(beta * c_blocks.astype(out.dtype), mode="drop")
 
 
 @functools.partial(jax.jit, donate_argnums=0, static_argnames=("bm", "bn"))
@@ -575,8 +617,15 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
             jnp.asarray(rows), jnp.asarray(cols), nr, nc_, brow, bcol,
         )
 
-    ad = _dense_canvas_cached(a, lambda: _build(a, nbr, nbk, bm, bk))
-    bd = _dense_canvas_cached(b, lambda: _build(b, nbk, nbc, bk, bn))
+    profile = os.environ.get("DBCSR_TPU_DENSE_PROFILE") == "1"
+    if profile:
+        from dbcsr_tpu.utils.sync import fetch_fence as _ff
+
+    with timed("dense_canvas_ab"):
+        ad = _dense_canvas_cached(a, lambda: _build(a, nbr, nbk, bm, bk))
+        bd = _dense_canvas_cached(b, lambda: _build(b, nbk, nbc, bk, bn))
+        if profile:
+            _ff(ad), _ff(bd)
     c_blocks = (
         c.bins[0].data[: c.nblks]
         if c.nblks
@@ -584,16 +633,32 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
     )
     alpha_dev = jnp.asarray(alpha, dtype=c.dtype)
     beta_dev = jnp.asarray(beta, dtype=c.dtype)
-    out = _dense_product_to_blocks(
-        ad, bd, c_blocks, jnp.asarray(c.keys.astype(np.int32)),
-        alpha_dev, beta_dev, nbr, nbc, bm, bn,
-    )
-    new_keys = np.arange(nbr * nbc, dtype=np.int64)  # full pattern, row-major
-    cap = bucket_size(len(new_keys))
-    pad = cap - len(new_keys)
-    if pad:
-        out = jnp.concatenate([out, jnp.zeros((pad, bm, bn), out.dtype)])
-    c.set_structure_from_device(new_keys, [_Bin((bm, bn), out, len(new_keys))])
+    if profile:
+        # split programs + fences: attribute dot vs carve separately
+        # (production fuses them — this is measurement-only)
+        with timed("dense_dot"):
+            cd = _dense_dot_only(ad, bd)
+            _ff(cd)
+        with timed("dense_carve"):
+            out = _dense_carve_only(
+                cd, c_blocks, jnp.asarray(c.keys.astype(np.int32)),
+                alpha_dev, beta_dev, nbr, nbc, bm, bn,
+            )
+            _ff(out)
+    else:
+        out = _dense_product_to_blocks(
+            ad, bd, c_blocks, jnp.asarray(c.keys.astype(np.int32)),
+            alpha_dev, beta_dev, nbr, nbc, bm, bn,
+        )
+    with timed("dense_finalize"):
+        new_keys = np.arange(nbr * nbc, dtype=np.int64)  # full pattern, row-major
+        cap = bucket_size(len(new_keys))
+        pad = cap - len(new_keys)
+        if pad:
+            out = jnp.concatenate([out, jnp.zeros((pad, bm, bn), out.dtype)])
+        c.set_structure_from_device(new_keys, [_Bin((bm, bn), out, len(new_keys))])
+        if profile:
+            _ff(c.bins[0].data)
     stats.record_stack(bm, bn, bk, nbr * nbc * nbk, driver="dense")
     stats.record_multiply(2 * nbr * bm * nbc * bn * nbk * bk)
     return _true_product_flops(a, b)

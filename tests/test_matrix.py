@@ -205,11 +205,17 @@ def test_assembly_microbench_1e5_blocks():
     rows, cols = keys // nb, keys % nb
     blocks = rng.standard_normal((n, 4, 4))
 
-    t0 = time.perf_counter()
-    m = BlockSparseMatrix("bench", rbs, rbs)
-    m.put_blocks(rows, cols, blocks)
-    m.finalize()
-    batched_s = time.perf_counter() - t0
+    # best-of-2: a background process stealing the core mid-phase
+    # compresses the ratio (observed under the TPU capture loop's
+    # probes); min-of-two is load-robust while keeping the regression
+    # bound meaningful
+    batched_s = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        m = BlockSparseMatrix("bench", rbs, rbs)
+        m.put_blocks(rows, cols, blocks)
+        m.finalize()
+        batched_s = min(batched_s, time.perf_counter() - t0)
     assert m.nblks == n
 
     # per-block path on 5k blocks, extrapolated

@@ -471,3 +471,41 @@ def test_dense_chunked_gate_and_feasibility(monkeypatch):
         dt.to_dense(c), dt.to_dense(a) @ dt.to_dense(b),
         rtol=1e-12, atol=1e-12,
     )
+
+
+def test_dense_carve_variants_equal(monkeypatch):
+    """The reshape carve is element-exact vs the gather carve and vs a
+    manual block slicing of the canvas (full row-major pattern)."""
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.mm import multiply as mm
+
+    rng = np.random.default_rng(7)
+    nbr, nbc, bm, bn = 3, 4, 5, 7
+    cd_np = rng.standard_normal((nbr * bm, nbc * bn))
+    cd = jnp.asarray(cd_np)
+    monkeypatch.setenv("DBCSR_TPU_DENSE_CARVE", "gather")
+    g = np.asarray(mm._carve_full_pattern(cd, nbr, nbc, bm, bn))
+    monkeypatch.setenv("DBCSR_TPU_DENSE_CARVE", "reshape")
+    r = np.asarray(mm._carve_full_pattern(cd, nbr, nbc, bm, bn))
+    assert np.array_equal(g, r)
+    for bi in range(nbr):
+        for bj in range(nbc):
+            np.testing.assert_array_equal(
+                r[bi * nbc + bj],
+                cd_np[bi * bm : (bi + 1) * bm, bj * bn : (bj + 1) * bn],
+            )
+
+
+def test_dense_profile_mode_matches_default(monkeypatch):
+    """DBCSR_TPU_DENSE_PROFILE=1 (split programs + fences) must give
+    bit-identical results to the fused production path."""
+    rbs = [4] * 6
+    a = _rand("a", rbs, rbs, 1.0, seed=60)
+    b = _rand("b", rbs, rbs, 1.0, seed=61)
+    c_ref = _rand("c", rbs, rbs, 0.5, seed=62)
+    c_prof = c_ref.copy()
+    multiply("N", "N", 1.5, a, b, 0.5, c_ref)  # auto -> dense mode
+    monkeypatch.setenv("DBCSR_TPU_DENSE_PROFILE", "1")
+    multiply("N", "N", 1.5, a, b, 0.5, c_prof)
+    np.testing.assert_array_equal(to_dense(c_ref), to_dense(c_prof))

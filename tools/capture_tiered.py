@@ -263,13 +263,14 @@ def _artifacts_done() -> dict:
     """Which tiers already have committed on-chip artifacts."""
     done = {"tier1": False, "tier2": False, "tier3_f64": False,
             "tier3_f32": False, "tier3_bf16": False}
-    try:
-        with open(PERF_CAPTURES) as fh:
-            n = sum(1 for line in fh
-                    if line.strip() and "TPU" in json.loads(line)["device"])
-        done["tier1"] = n >= 4
-    except (OSError, ValueError, KeyError):
-        pass
+    # tier 1 is complete only when EVERY kernel in the list has a
+    # committed TPU line — a count threshold would permanently skip a
+    # kernel that failed in an early window (the 23^3 bf16 fatal) even
+    # after its fix landed, deadlocking any gate that needs its evidence
+    have = _tier1_captured()
+    done["tier1"] = all(
+        (f"{m}x{n}x{k}", dt) in have for m, n, k, dt, _ in TIER1_KERNELS
+    )
     try:
         with open(BENCH_CAPTURES) as fh:
             for line in fh:
@@ -341,22 +342,29 @@ def _attempt_tiers(st: dict) -> dict:
         st["tier2"] = run_bench({"DBCSR_TPU_BENCH_NREP": "2"}, 1200, 2)
         if not st["tier2"]:
             return st
-    # bf16/f32 variants are recorded but do NOT gate tier 4: a
-    # dtype-specific kernel crash must not block the tuner sweep.
-    # f32 runs BEFORE bf16 — the 23^3 bf16 Mosaic fatal must not cost
-    # the f32 leg (or wedge the window) first
+    # f64/f32 legs are known-good; bf16 is quarantined to LAST (after
+    # tier 4): the 03:34 bf16 leg hung for its whole 1800 s budget and
+    # the kill left the tunnel wedged, costing the rest of the window —
+    # a risky leg must never run before the tuner sweep has banked its
+    # rows.  It is additionally gated on kernel-level evidence: a
+    # committed tier-1 23^3 bf16 capture (post precision-fix).
     ok3 = done["tier3_f64"]
     if not ok3:
         log("tier 3 (full bench f64)")
         ok3 = run_bench({}, 1800, 3)
     if ok3 and not done["tier3_f32"]:
         run_bench({"DBCSR_TPU_BENCH_DTYPE": "1"}, 1800, 3)
-    if ok3 and not done["tier3_bf16"]:
-        run_bench({"DBCSR_TPU_BENCH_DTYPE": "9"}, 1800, 3)
     st["tier3"] = ok3
     if ok3:
         log("tier 4 (autotuner sweep at production stack sizes)")
         st["tier4"], st["tier4_walked"] = run_tier4()
+    if ok3 and st.get("tier4_walked") and not done["tier3_bf16"]:
+        if ("23x23x23", 9) in _tier1_captured():
+            log("tier 3 (full bench bf16 — quarantined leg, last)")
+            run_bench({"DBCSR_TPU_BENCH_DTYPE": "9"}, 1800, 3)
+        else:
+            log("tier3 bf16 leg skipped: no tier-1 23x23x23 bf16 "
+                "capture yet (kernel-level evidence gate)")
     return st
 
 
