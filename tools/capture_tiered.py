@@ -199,6 +199,14 @@ def run_tier25(done: dict) -> None:
     if not done.get("tier25_reshape"):
         log("tier2.5b: reshape-carve A/B vs gather (f64)")
         run_bench({"DBCSR_TPU_DENSE_CARVE": "reshape"}, 900, 2.5)
+    if not done.get("tier25_f32dense"):
+        # the banked tier-3 f32 run took the STACK path (15.46 GFLOP/s);
+        # a 10k^3 f32 MXU matmul costs ~0.2 s, so forced dense mode may
+        # be ~3x faster — measured evidence decides whether the cost
+        # model learns an f32/bf16 branch
+        log("tier2.5c: f32 dense-forced A/B vs banked stack run")
+        run_bench({"DBCSR_TPU_BENCH_DTYPE": "1",
+                   "DBCSR_TPU_MM_DENSE": "1"}, 900, 2.5)
 
 
 # (m, n, k, dtype_enum, stack_size): the production-scale tuner sweep
@@ -320,6 +328,8 @@ def _artifacts_done() -> dict:
                         done["tier25_reshape"] = True
                     if env25.get("DBCSR_TPU_DENSE_PROFILE") == "1":
                         done["tier25_profile"] = True
+                    if env25.get("DBCSR_TPU_MM_DENSE") == "1":
+                        done["tier25_f32dense"] = True
                 if r.get("tier") == 3:
                     dt = (r.get("env") or {}).get("DBCSR_TPU_BENCH_DTYPE",
                                                   "3")
