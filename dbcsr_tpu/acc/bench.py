@@ -38,8 +38,6 @@ def bench_smm(nrep=5, stack_size=30000, m=23, n=23, k=23, dtype_enum=3,
     import jax
     import jax.numpy as jnp
 
-    from dbcsr_tpu.acc.smm import process_stack
-
     dtype = dtype_of(dtype_enum)
     rng = np.random.default_rng(seed)
     # reference sizing: ~stack_size/16 distinct blocks cycle through HBM
@@ -59,8 +57,10 @@ def bench_smm(nrep=5, stack_size=30000, m=23, n=23, k=23, dtype_enum=3,
                   b_host[bi].astype(np.float64)),
     )
 
-    c = jnp.zeros((nc, m, n), dtype)
-    c = process_stack(c, a, b, ai, bi, ci, 1.0)
+    from dbcsr_tpu.acc.smm import execute_stack, prepare_stack
+
+    plan = prepare_stack(jnp.zeros((nc, m, n), dtype), a, b, ai, bi, ci)
+    c = execute_stack(jnp.zeros((nc, m, n), dtype), a, b, plan, 1.0)
     # compare ON DEVICE and fetch 8 bytes: a full-result d2h fetch here
     # (tens of MB) persistently degrades the axon tunnel session and
     # can wedge the kernels that follow (PERF_NOTES.md)
@@ -80,7 +80,7 @@ def bench_smm(nrep=5, stack_size=30000, m=23, n=23, k=23, dtype_enum=3,
     for _ in range(nrep):
         c = jnp.zeros((nc, m, n), dtype)
         t0 = time.perf_counter()
-        c = process_stack(c, a, b, ai, bi, ci, 1.0)
+        c = execute_stack(c, a, b, plan, 1.0)
         fetch_fence(c)  # forced completion (PERF_NOTES.md)
         times.append(time.perf_counter() - t0)
     best = min(times)
@@ -99,6 +99,18 @@ def bench_smm(nrep=5, stack_size=30000, m=23, n=23, k=23, dtype_enum=3,
         "ms": best * 1e3,
         "max_rel_err": float(max_err),
         "errors": 0 if ok else 1,
+        # which driver auto-dispatch chose — artifact lines are useless
+        # for tuning decisions without it.  "timed": what the rep loop
+        # measures — "execute" = kernel launches on a prepared stack
+        # (the reference acc_bench_smm discipline); older artifact
+        # lines without the field timed prepare+execute per rep
+        "timed": "execute",
+        "driver": plan.driver,
+        "variant": ("kmerge" if plan.kmerge
+                    else ("crosspack_vmem" if plan.cross_vmem
+                          else ("crosspack" if plan.pack else None))),
+        "r_grp": plan.r_grp,
+        "pack": list(plan.pack) if plan.pack else None,
     }
     out(f"typename (id={dtype_enum}): {result['dtype']}")
     out(f"device: {result['device']}")

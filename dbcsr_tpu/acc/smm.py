@@ -436,6 +436,7 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
             r0, a_pad_row, b_pad_row, plan.nseg, chunk_groups,
         )
         plan.driver = "xla_group"
+        plan.r_grp = r0  # metadata: the R-tile grouping actually used
         plan.a_pad_row = a_pad_row
         plan.b_pad_row = b_pad_row
         plan.group_idx = (jnp.asarray(ga), jnp.asarray(gb), jnp.asarray(gc))

@@ -697,11 +697,10 @@ def _dense_strip_to_blocks(cd, c_blocks, strip_pos, alpha, beta,
                            *, nbc, bm, bn, rows):
     """Carve one C m-strip canvas into its full row-major block pattern
     and merge beta*old (strip_pos: old block -> strip-local full-pattern
-    position, out-of-strip dropped)."""
-    keys = jnp.arange(rows * nbc, dtype=jnp.int32)
-    ro = (keys // nbc) * bm
-    co = (keys % nbc) * bn
-    out = alpha * _gather_bin_from_canvas(cd, ro, co, bm=bm, bn=bn)
+    position, out-of-strip dropped).  A strip is a full row-major
+    pattern over ``rows`` block rows, so it shares the gather/reshape
+    carve selection with the unchunked path."""
+    out = alpha * _carve_full_pattern(cd, rows, nbc, bm, bn)
     return out.at[strip_pos].add(beta * c_blocks.astype(out.dtype), mode="drop")
 
 
