@@ -709,7 +709,10 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0):
             P, R = plan.pack
             launch_fn = (pallas_smm._pallas_crosspack_vmem if plan.cross_vmem
                          else pallas_smm._pallas_crosspack)
-            c_out = c_data
+            # numpy c_data would crash scatter_lane_outputs (.at[]) and
+            # the demotion handler would then blacklist a perfectly
+            # good kernel shape — coerce up front
+            c_out = jnp.asarray(c_data)
             for lc in plan.cross_launches:
                 with jax.enable_x64(False):
                     outs = launch_fn(
@@ -883,6 +886,14 @@ def _pallas_supported(cfg, c_data, a_data, b_data) -> bool:
     if cfg.mm_driver == "xla":
         return False
     if not cfg.use_pallas and cfg.mm_driver not in ("pallas", "pallas_cross"):
+        return False
+    # off-TPU, pallas_call runs in INTERPRET mode — a per-step Python
+    # evaluator meant for kernel testing, ~1000x slower at driver scale
+    # (measured: 2000^2 23^3 bf16 north-star slice, 22 s/rep vs 0.09 s
+    # for the f64 xla path on the same config).  Auto dispatch must
+    # never select it; only an explicit mm_driver force (tests, kernel
+    # debugging) may.
+    if not _on_tpu() and cfg.mm_driver not in ("pallas", "pallas_cross"):
         return False
     try:
         from dbcsr_tpu.acc.pallas_smm import supports

@@ -156,21 +156,35 @@ def test_validate_kernels_catches_corrupted_kernel(monkeypatch):
     smm._validated_kernels.difference_update(
         {k for k in smm._validated_kernels if k[:4] == (8, 8, 8, "float32")}
     )
-    set_config(validate_kernels=True)
-    with pytest.raises(smm.KernelValidationError):
-        process_stack(c.astype(np.float32), a, b, ai, bi, ci)
+    # force the base pallas kernel: auto dispatch never selects
+    # interpret-mode pallas off-TPU (and on "TPU" it would try
+    # crosspack first, whose separate validation key would pollute
+    # the assertion below)
+    set_config(mm_driver="pallas", validate_kernels=True)
+    try:
+        with pytest.raises(smm.KernelValidationError):
+            process_stack(c.astype(np.float32), a, b, ai, bi, ci)
+    finally:
+        set_config(mm_driver="auto")
     assert not any(k[:4] == (8, 8, 8, "float32") for k in smm._validated_kernels)
 
 
 def test_validate_kernels_passes_and_caches():
     from dbcsr_tpu.acc import smm
+    from dbcsr_tpu.core.config import set_config
 
     rng = np.random.default_rng(17)
     a, b, c, ai, bi, ci = _random_stack(rng, 8, 8, 6, 100, 9, 9, 9, np.float32)
     smm._validated_kernels.difference_update(
         {k for k in smm._validated_kernels if k[:4] == (9, 9, 9, "float32")}
     )
-    got = np.asarray(process_stack(c, a, b, ai, bi, ci))
+    # force the base pallas kernel (auto never selects interpret-mode
+    # pallas off-TPU, and a mocked-TPU auto would go crosspack instead)
+    set_config(mm_driver="pallas")
+    try:
+        got = np.asarray(process_stack(c, a, b, ai, bi, ci))
+    finally:
+        set_config(mm_driver="auto")
     np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.0), rtol=1e-4, atol=1e-4)
     assert any(k[:4] == (9, 9, 9, "float32") for k in smm._validated_kernels)
 
@@ -378,6 +392,7 @@ def test_crosspack_tuned_table_dispatch(tmp_path, monkeypatch):
     rng = np.random.default_rng(39)
     a, b, c, ai, bi, ci = _random_stack(rng, 20, 20, 12, 300, 12, 12, 12,
                                         np.float32)
+    monkeypatch.setattr(smm, "_on_tpu", lambda: True)
     set_config(mm_driver="auto", validate_kernels=True)
     plan = smm.prepare_stack(jnp.asarray(c), jnp.asarray(a), jnp.asarray(b),
                              ai, bi, ci)
@@ -412,6 +427,7 @@ def test_crosspack_predicted_donor_rederives_pack(tmp_path, monkeypatch):
     rng = np.random.default_rng(41)
     a, b, c, ai, bi, ci = _random_stack(rng, 20, 20, 12, 300, 23, 23, 23,
                                         np.float32)
+    monkeypatch.setattr(smm, "_on_tpu", lambda: True)
     set_config(mm_driver="auto")
     plan = smm.prepare_stack(jnp.asarray(c), jnp.asarray(a), jnp.asarray(b),
                              ai, bi, ci)
@@ -475,6 +491,7 @@ def test_crosspack_vmem_tuned_dispatch(tmp_path, monkeypatch):
     rng = np.random.default_rng(53)
     a, b, c, ai, bi, ci = _random_stack(rng, 20, 20, 12, 300, 12, 12, 12,
                                         np.float32)
+    monkeypatch.setattr(smm, "_on_tpu", lambda: True)
     set_config(mm_driver="auto", validate_kernels=True)
     plan = smm.prepare_stack(jnp.asarray(c), jnp.asarray(a), jnp.asarray(b),
                              ai, bi, ci)
@@ -555,3 +572,29 @@ def test_auto_crosspack_default_on_tpu(monkeypatch):
         assert plan2.driver != "pallas_cross"
     finally:
         smm._cross_disabled.discard((15, 15, 15, "float32"))
+
+
+def test_crosspack_numpy_input_not_blacklisted(recwarn):
+    """process_stack with NUMPY arrays through the crosspack path must
+    succeed (c coerced up front), not crash in scatter_lane_outputs and
+    silently blacklist the shape via the demotion handler."""
+    import warnings
+
+    from dbcsr_tpu.acc import smm
+    from dbcsr_tpu.core.config import set_config
+
+    rng = np.random.default_rng(67)
+    a, b, c, ai, bi, ci = _random_stack(rng, 16, 16, 10, 200, 8, 8, 8,
+                                        np.float32)
+    key = smm._stack_shape_key(c, a, b)
+    smm._cross_disabled.discard(key)
+    set_config(mm_driver="pallas_cross")
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)
+            got = np.asarray(smm.process_stack(c, a, b, ai, bi, ci))
+    finally:
+        set_config(mm_driver="auto")
+    assert key not in smm._cross_disabled
+    np.testing.assert_allclose(got, _oracle(c, a, b, ai, bi, ci, 1.0),
+                               rtol=2e-4, atol=2e-4)
