@@ -52,8 +52,57 @@ def _probe_tpu(timeout_s: int) -> bool:
         return False
 
 
+def _pick_carve_from_evidence() -> str:
+    """Choose the dense-carve lowering from committed on-chip A/B
+    evidence (BENCH_CAPTURES.jsonl): the tier-2.5 reshape leg vs the
+    tier-3 gather run.  Both lowerings are oracle-equal (tests pin it);
+    only time-to-solution differs, so picking the measured winner is a
+    tuned-parameter lookup, not a benchmark trick — the choice is
+    recorded in the output JSON.  Defaults to gather (the historically
+    measured path) without evidence or when the env already chose."""
+    if "DBCSR_TPU_DENSE_CARVE" in os.environ:
+        return os.environ["DBCSR_TPU_DENSE_CARVE"]
+    best = {"gather": None, "reshape": None}
+    try:
+        fh = open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_CAPTURES.jsonl"))
+    except OSError:
+        return "gather"
+    with fh:
+        for line in fh:
+            # per-line tolerance: a torn tail line (loop killed
+            # mid-append) must not discard the valid evidence above it
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if r.get("device_fallback") or r.get("algorithm") != "dense":
+                continue
+            env = r.get("env") or {}
+            if env.get("DBCSR_TPU_BENCH_DTYPE", "3") != "3":
+                continue
+            # the record's own "carve" field (what the run actually
+            # used, incl. evidence-auto-picked) wins over the recorded
+            # extra_env — classifying auto-picked reshape runs as
+            # "gather" would self-poison the A/B
+            carve = r.get("carve") or env.get("DBCSR_TPU_DENSE_CARVE",
+                                              "gather")
+            if carve in best:
+                try:
+                    v = float(r.get("value") or 0)
+                except (TypeError, ValueError):
+                    continue
+                if best[carve] is None or v > best[carve]:
+                    best[carve] = v
+    if best["reshape"] and best["gather"] and best["reshape"] > best["gather"]:
+        return "reshape"
+    return "gather"
+
+
 def main():
     probe_timeout = int(os.environ.get("DBCSR_TPU_BENCH_PROBE_TIMEOUT", "600"))
+    carve = _pick_carve_from_evidence()
+    os.environ["DBCSR_TPU_DENSE_CARVE"] = carve
     fallback = not _probe_tpu(probe_timeout)
     if fallback:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -125,6 +174,9 @@ def main():
         # for this config; "stack" on CPU) — GFLOP/s is always TRUE
         # sparse-product flops over wall time either way
         "algorithm": res.get("algorithm"),
+        # dense-carve lowering used (evidence-selected, see
+        # _pick_carve_from_evidence); null when no dense carve ran
+        "carve": carve if res.get("algorithm") == "dense" else None,
         # timing forces real device completion via a data-dependent
         # 8-byte fetch per rep (driver._force_completion): on the axon
         # tunnel, block_until_ready alone can return before the work
