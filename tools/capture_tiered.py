@@ -266,6 +266,8 @@ def run_tier4() -> tuple:
     entries are skipped and the sweep continues."""
     done = _tier4_done()
     for entry in TIER4_SWEEP:
+        if _past_deadline():
+            return len(done), False
         if tuple(entry) in done:
             continue
         m, n, k, dt, ss = entry
@@ -344,6 +346,19 @@ def _artifacts_done() -> dict:
 
 ACTIVE_FLAG = os.path.join(REPO, ".capture_active")
 
+# hard stop for STARTING new work (legs / tuner entries): the loop must
+# be quiet before the round driver runs its own BENCH on the tunnel —
+# a mid-sweep tuner entry contending with the driver's bench run would
+# corrupt the judged number.  Set from --deadline-hours in main().
+_DEADLINE = [float("inf")]
+
+
+def _past_deadline() -> bool:
+    if time.time() > _DEADLINE[0]:
+        log("deadline: not starting further capture work")
+        return True
+    return False
+
 
 def attempt() -> dict:
     """One full capture attempt.  Returns status flags."""
@@ -399,17 +414,20 @@ def _attempt_tiers(st: dict) -> dict:
     # committed tier-1 23^3 bf16 capture (post precision-fix).
     ok3 = done["tier3_f64"]
     if not ok3:
+        if _past_deadline():
+            return st
         log("tier 3 (full bench f64)")
         ok3 = run_bench({}, 1800, 3)
-    if ok3:
+    if ok3 and not _past_deadline():
         run_tier25(done)
-    if ok3 and not done["tier3_f32"]:
+    if ok3 and not done["tier3_f32"] and not _past_deadline():
         run_bench({"DBCSR_TPU_BENCH_DTYPE": "1"}, 1800, 3)
     st["tier3"] = ok3
-    if ok3:
+    if ok3 and not _past_deadline():
         log("tier 4 (autotuner sweep at production stack sizes)")
         st["tier4"], st["tier4_walked"] = run_tier4()
-    if ok3 and st.get("tier4_walked") and not done["tier3_bf16"]:
+    if ok3 and st.get("tier4_walked") and not done["tier3_bf16"] \
+            and not _past_deadline():
         if ("23x23x23", 9) in _tier1_captured():
             log("tier 3 (full bench bf16 — quarantined leg, last)")
             run_bench({"DBCSR_TPU_BENCH_DTYPE": "9"}, 1800, 3)
@@ -429,7 +447,16 @@ def main() -> int:
                 cadence_min = float(sys.argv[i + 1])
             except ValueError:
                 pass
-    deadline = time.time() + 11.5 * 3600
+    hours = 11.5
+    if "--deadline-hours" in sys.argv:
+        i = sys.argv.index("--deadline-hours")
+        if i + 1 < len(sys.argv):
+            try:
+                hours = float(sys.argv[i + 1])
+            except ValueError:
+                pass
+    deadline = time.time() + hours * 3600
+    _DEADLINE[0] = deadline
     while True:
         st = attempt()
         if st["tier3"] and st.get("tier4_walked"):
