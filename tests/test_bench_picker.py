@@ -1,0 +1,83 @@
+"""bench.py's evidence-based dense-carve selection: the round-end BENCH
+run must inherit the measured A/B winner from committed capture
+artifacts without ever self-poisoning or tripping on torn lines."""
+
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture()
+def bench_mod(tmp_path, monkeypatch):
+    import bench
+
+    # point the picker at a scratch captures file by faking the repo dir
+    real_dirname = os.path.dirname
+
+    def fake_dirname(p):
+        if p == os.path.abspath(bench.__file__):
+            return str(tmp_path)
+        return real_dirname(p)
+
+    monkeypatch.setattr(bench.os.path, "dirname", fake_dirname)
+    monkeypatch.delenv("DBCSR_TPU_DENSE_CARVE", raising=False)
+    return bench, tmp_path / "BENCH_CAPTURES.jsonl"
+
+
+def _write(path, rows, torn=False):
+    with open(path, "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+        if torn:
+            fh.write('{"torn": ')
+
+
+def test_no_evidence_defaults_to_gather(bench_mod):
+    bench, path = bench_mod
+    assert bench._pick_carve_from_evidence() == "gather"
+
+
+def test_reshape_wins_and_torn_tail_tolerated(bench_mod):
+    bench, path = bench_mod
+    _write(path, [
+        {"value": 3.7, "algorithm": "dense", "device_fallback": False,
+         "env": {}},
+        {"value": 9.9, "algorithm": "dense", "device_fallback": False,
+         "env": {"DBCSR_TPU_DENSE_CARVE": "reshape"}},
+        # stack-path and fallback rows must not count
+        {"value": 99.0, "algorithm": "stack", "device_fallback": False,
+         "env": {"DBCSR_TPU_BENCH_DTYPE": "1"}},
+        {"value": 50.0, "algorithm": "dense", "device_fallback": True,
+         "env": {}},
+    ], torn=True)
+    assert bench._pick_carve_from_evidence() == "reshape"
+
+
+def test_auto_picked_runs_classified_by_their_carve_field(bench_mod):
+    """A reshape run recorded with empty extra_env (auto-picked by a
+    previous selection) must count as reshape via its own 'carve'
+    field — filing it under gather would flip-flop the selection on
+    self-generated evidence."""
+    bench, path = bench_mod
+    _write(path, [
+        {"value": 4.0, "algorithm": "dense", "device_fallback": False,
+         "env": {}, "carve": "gather"},
+        {"value": 9.0, "algorithm": "dense", "device_fallback": False,
+         "env": {}, "carve": "reshape"},
+        {"value": 12.0, "algorithm": "dense", "device_fallback": False,
+         "env": {}, "carve": "reshape"},
+    ])
+    assert bench._pick_carve_from_evidence() == "reshape"
+    # a genuinely faster gather row flips it back
+    with open(path, "a") as fh:
+        fh.write(json.dumps({"value": 15.0, "algorithm": "dense",
+                             "device_fallback": False, "env": {},
+                             "carve": "gather"}) + "\n")
+    assert bench._pick_carve_from_evidence() == "gather"
+
+
+def test_env_override_respected(bench_mod, monkeypatch):
+    bench, path = bench_mod
+    monkeypatch.setenv("DBCSR_TPU_DENSE_CARVE", "reshape")
+    assert bench._pick_carve_from_evidence() == "reshape"
