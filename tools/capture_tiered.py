@@ -78,19 +78,32 @@ def _tier1_captured() -> set:
             for line in fh:
                 if not line.strip():
                     continue
-                r = json.loads(line)
+                # per-line tolerance: a torn tail line (loop killed
+                # mid-append) must not discard the valid resume state
+                # above it (same policy as bench.py's evidence picker)
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
                 if "TPU" in r.get("device", ""):
                     have.add((r.get("kernel"), r.get("dtype_enum")))
-    except (OSError, ValueError):
+    except OSError:
         pass
     return have
 
 
-def run_tier1() -> int:
+def run_tier1() -> tuple:
     """Kernel micro-benchmarks, one subprocess per kernel, artifact per
-    kernel.  Returns the number of kernels captured on a TPU device."""
+    kernel.  Returns (total_captured, fresh_this_window, timed_out):
+    total counts only TIER1_KERNELS pairs (resumed + fresh), fresh
+    counts THIS window's successes — the caller's unhealthy-window bail
+    must key on fresh, not total, or it can never trigger once any
+    artifact exists (ADVICE r4)."""
     have = _tier1_captured()
-    captured = len(have)
+    captured = sum(
+        1 for m, n, k, dt, _ in TIER1_KERNELS if (f"{m}x{n}x{k}", dt) in have
+    )
+    fresh = 0
     for m, n, k, dt, ss in TIER1_KERNELS:
         if (f"{m}x{n}x{k}", dt) in have:
             log(f"tier1 {m}x{n}x{k} dt={dt}: already captured; skipping")
@@ -112,16 +125,17 @@ def run_tier1() -> int:
             # a timeout IS the wedge signal: stop queuing more work on
             # the tunnel (queued programs are not cancelled)
             log(f"tier1 {m}x{n}x{k} dt={dt}: TIMEOUT (tunnel wedged mid-kernel)")
-            return captured
+            return captured, fresh, True
         line = next((l for l in r.stdout.splitlines()
                      if l.startswith("CAPTURE ")), None)
         if r.returncode == 0 and line:
             res = json.loads(line[len("CAPTURE "):])
             if "TFRT_CPU" in res["device"] or "cpu" in res["device"].lower():
                 log(f"tier1 {m}x{n}x{k}: landed on CPU, not recording")
-                return captured
+                return captured, fresh, True
             _append(PERF_CAPTURES, dict(res, tier=1, dtype_enum=dt))
             captured += 1
+            fresh += 1
             log(f"tier1 {m}x{n}x{k} dt={dt}: {res['gflops']:.1f} GFLOP/s "
                 f"on {res['device']} (err={res['max_rel_err']:.2e})")
         else:
@@ -139,7 +153,7 @@ def run_tier1() -> int:
             log(f"tier1 {m}x{n}x{k} dt={dt}: rc={r.returncode} "
                 f"(full output: {os.path.basename(errpath)}) "
                 f"{(r.stderr or '')[-300:]}")
-    return captured
+    return captured, fresh, False
 
 
 def run_bench(extra_env: dict, timeout_s: int, tier,
@@ -154,6 +168,12 @@ def run_bench(extra_env: dict, timeout_s: int, tier,
         )
     except subprocess.TimeoutExpired:
         log(f"tier{tier} bench: TIMEOUT after {timeout_s}s")
+        if stderr_to:
+            # overwrite any stale log from a prior attempt so a
+            # leftover profile can't be mistaken for this run's output
+            with open(os.path.join(REPO, stderr_to), "w") as fh:
+                fh.write(f"TIMEOUT after {timeout_s}s at "
+                         f"{time.strftime('%Y-%m-%dT%H:%M:%S')}\n")
         return False
     if stderr_to:
         with open(os.path.join(REPO, stderr_to), "w") as fh:
@@ -209,6 +229,52 @@ def run_tier25(done: dict) -> None:
                    "DBCSR_TPU_MM_DENSE": "1"}, 900, 2.5)
 
 
+def run_tier5() -> None:
+    """One-shot on-chip artifacts for the two paths that have never
+    been timed on hardware (VERDICT r4 items 7/8): the mesh engine on a
+    1x1x1 mesh at the north-star config, and a rank-3 tensor
+    contraction validated against the dense oracle.  Short legs (~min),
+    run once, resumed via their PERF_CAPTURES kernel tags."""
+    have = _tier1_captured()  # (kernel, dtype_enum) pairs; extras use
+    have_kernels = {k for k, _ in have}  # dtype_enum None
+    for leg, kernel, budget in (
+        ("mesh", "mesh_1x1x1_northstar", 1200),
+        ("tensor", "tensor_contract_r3", 600),
+    ):
+        if kernel in have_kernels:
+            log(f"tier5 {leg}: already captured; skipping")
+            continue
+        if _past_deadline():
+            return
+        log(f"tier5 {leg} leg (on-chip)")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.join(REPO, "tools",
+                                              "onchip_extras.py"), leg],
+                timeout=budget, capture_output=True, text=True, cwd=REPO,
+            )
+        except subprocess.TimeoutExpired:
+            log(f"tier5 {leg}: TIMEOUT after {budget}s")
+            return  # wedge signal: stop queueing extras this window
+        line = next((l for l in r.stdout.splitlines()
+                     if l.startswith("CAPTURE ")), None)
+        if r.returncode == 0 and line:
+            res = json.loads(line[len("CAPTURE "):])
+            if "cpu" in res["device"].lower():
+                log(f"tier5 {leg}: landed on CPU, not recording")
+                return
+            _append(PERF_CAPTURES, dict(res, tier=5))
+            log(f"tier5 {leg}: captured on {res['device']}")
+        else:
+            errpath = os.path.join(REPO, f"capture_err_tier5_{leg}.log")
+            with open(errpath, "w") as fh:
+                fh.write(r.stdout or "")
+                fh.write("\n==== stderr ====\n")
+                fh.write(r.stderr or "")
+            log(f"tier5 {leg}: rc={r.returncode} "
+                f"(full output: {os.path.basename(errpath)})")
+
+
 # (m, n, k, dtype_enum, stack_size): the production-scale tuner sweep
 # (VERDICT r3 item 3) in priority order — the north-star shapes first,
 # then MXU-friendly squares, then the small-block CI shapes.  Each run
@@ -242,6 +308,11 @@ TIER4_SWEEP = [
 ]
 
 
+# tier4_done.json is INTENTIONALLY git-tracked (not in .gitignore):
+# the sweep spans multiple windows/rounds and a workspace reset must
+# not erase which entries already tuned (the rows themselves persist
+# in acc/params/*.json, but re-walking completed entries would burn a
+# healthy window re-earning them).  Commit it with the params rows.
 _TIER4_STATE = os.path.join(REPO, "tier4_done.json")
 
 
@@ -319,7 +390,10 @@ def _artifacts_done() -> dict:
             for line in fh:
                 if not line.strip():
                     continue
-                r = json.loads(line)
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
                 if r.get("device_fallback"):
                     continue
                 if r.get("tier") == 2:
@@ -339,7 +413,7 @@ def _artifacts_done() -> dict:
                            "9": "tier3_bf16"}.get(dt)
                     if key:
                         done[key] = True
-    except (OSError, ValueError):
+    except OSError:
         pass
     return done
 
@@ -393,8 +467,11 @@ def _attempt_tiers(st: dict) -> dict:
         st["tier1"] = 1
     else:
         log("tunnel healthy; tier 1 (kernel micro-benchmarks)")
-        st["tier1"] = run_tier1()
-        if st["tier1"] == 0:
+        st["tier1"], fresh, timed_out = run_tier1()
+        # unhealthy-window bail keys on THIS window's outcome: a wedge
+        # signal (timeout/CPU landing) with zero fresh captures means
+        # the window is dead regardless of resumed artifacts (ADVICE r4)
+        if timed_out and fresh == 0:
             return st
     if done["tier2"]:
         st["tier2"] = True
@@ -423,6 +500,8 @@ def _attempt_tiers(st: dict) -> dict:
     if ok3 and not done["tier3_f32"] and not _past_deadline():
         run_bench({"DBCSR_TPU_BENCH_DTYPE": "1"}, 1800, 3)
     st["tier3"] = ok3
+    if ok3 and not _past_deadline():
+        run_tier5()
     if ok3 and not _past_deadline():
         log("tier 4 (autotuner sweep at production stack sizes)")
         st["tier4"], st["tier4_walked"] = run_tier4()

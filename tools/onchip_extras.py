@@ -1,0 +1,173 @@
+"""On-chip artifact legs for the two never-measured-on-hardware paths
+(VERDICT r4 items 7 and 8):
+
+  mesh    — `sparse_multiply_distributed` on a REAL-TPU 1x1x1 mesh at
+            the north-star config, timed against the single-chip engine
+            on the same inputs: quantifies the shard_map/psum/staging
+            overhead of the mesh path on hardware (reference analog:
+            the Cannon driver's own timing, dbcsr_mm_cannon.F:837).
+  tensor  — a rank-3 contraction (the (13|2)x(54|21)=(3|45) index
+            pattern of dbcsr_tensor_example_2, scaled to real block
+            sizes) on chip, validated against the dense einsum oracle
+            (reference analog: dbcsr_tensor.F:418 contract).
+
+Each leg prints ONE line `CAPTURE {json}`; tools/capture_tiered.py runs
+them as subprocesses with hard timeouts and appends the rows to
+PERF_CAPTURES.jsonl.  Timing fences are data-dependent fetches
+(utils/sync.fetch_fence) per PERF_NOTES — block_until_ready lies on
+the axon tunnel.
+
+Usage: python tools/onchip_extras.py {mesh|tensor} [nrep]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _device() -> str:
+    import jax
+
+    return str(jax.devices()[0])
+
+
+def mesh_leg(nrep: int = 3, nblk: int = 435) -> dict:
+    """North-star config (435x435 blocks of 23^2, occ 0.1, f64) through
+    the mesh engine on a 1-device mesh vs the single-chip engine."""
+    import numpy as np
+
+    import dbcsr_tpu as dt
+    from dbcsr_tpu.parallel import make_grid, sparse_multiply_distributed
+    from dbcsr_tpu.utils.sync import fetch_fence
+
+    dt.init_lib()
+    rbs = [23] * nblk
+    a = dt.make_random_matrix("A", rbs, rbs, dtype=np.float64,
+                              occupation=0.1, rng=np.random.default_rng(1))
+    b = dt.make_random_matrix("B", rbs, rbs, dtype=np.float64,
+                              occupation=0.1, rng=np.random.default_rng(2))
+    mesh = make_grid(1)
+
+    mesh_times, cks = [], set()
+    for _ in range(nrep):
+        t0 = time.perf_counter()
+        c = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh)
+        for bb in c.bins:
+            fetch_fence(bb.data)
+        mesh_times.append(time.perf_counter() - t0)
+        cks.add(dt.checksum(c))
+    assert len(cks) == 1, f"nondeterministic mesh multiply: {cks}"
+
+    sc_times = []
+    for _ in range(nrep):
+        c1 = dt.create("C1", rbs, rbs, dtype=np.float64)
+        t0 = time.perf_counter()
+        dt.multiply("N", "N", 1.0, a, b, 0.0, c1)
+        for bb in c1.bins:
+            fetch_fence(bb.data)
+        sc_times.append(time.perf_counter() - t0)
+    ck1 = dt.checksum(c1)
+    ckm = cks.pop()
+    rel = abs(ckm - ck1) / max(abs(ck1), 1.0)
+    assert rel < 1e-9, f"mesh vs single-chip checksum drift: {ckm} vs {ck1}"
+
+    return {
+        "kernel": "mesh_1x1x1_northstar",
+        "metric": f"mesh-vs-single-chip resident s ({nblk} blk/side 23^2, occ=0.1, f64)",
+        "mesh_best_s": round(min(mesh_times), 3),
+        "mesh_first_s": round(mesh_times[0], 3),
+        "single_chip_best_s": round(min(sc_times), 3),
+        "mesh_overhead_x": round(min(mesh_times) / min(sc_times), 2),
+        "checksum": ckm,
+        "nrep": nrep,
+        "device": _device(),
+        "sync": "forced-fetch",
+    }
+
+
+def tensor_leg(nrep: int = 3) -> dict:
+    """Rank-3 contraction t3[k,l,m] = sum_ij t1[i,j,k] t2[j,i,l,m] at
+    real block sizes, timed on chip and validated against the dense
+    einsum oracle computed on the host."""
+    import numpy as np
+
+    from dbcsr_tpu import init_lib
+    from dbcsr_tpu.tensor import contract, create_tensor
+    from dbcsr_tpu.utils.sync import fetch_fence
+
+    init_lib()
+    # per-dim totals: i=j=k=96 (6 blocks of 16), l=m=32 (4 of 8) —
+    # oracle einsum ~0.9 GFLOP on host, tensor path sparse at occ 0.5
+    si = sj = sk = [16] * 6
+    sl = sm = [8] * 4
+
+    def fill(t, occ, seed):
+        rng = np.random.default_rng(seed)
+        for idx in np.ndindex(*t.nblks_per_dim):
+            if rng.random() < occ:
+                t.put_block(idx, rng.standard_normal(t.block_shape(idx)))
+        return t.finalize()
+
+    times = []
+    flops = 0
+    for rep in range(nrep):
+        t1 = create_tensor("t1", [si, sj, sk], row_dims=(0, 2), col_dims=(1,))
+        t2 = create_tensor("t2", [sj, si, sl, sm], row_dims=(2, 3),
+                           col_dims=(0, 1))
+        t3 = create_tensor("t3", [sk, sl, sm], row_dims=(0,), col_dims=(1, 2))
+        fill(t1, 0.5, seed=10)
+        fill(t2, 0.5, seed=11)
+        t3.finalize()
+        t0 = time.perf_counter()
+        flops = contract(
+            1.0, t1, t2, 0.0, t3,
+            contract_a=(0, 1), notcontract_a=(2,),
+            contract_b=(1, 0), notcontract_b=(2, 3),
+            map_1=(0,), map_2=(1, 2),
+        )
+        for bb in t3.matrix.bins:
+            fetch_fence(bb.data)
+        times.append(time.perf_counter() - t0)
+
+    want = np.einsum("ijk,jilm->klm", t1.to_dense(), t2.to_dense())
+    got = t3.to_dense()
+    scale = max(np.abs(want).max(), 1.0)
+    err = float(np.abs(got - want).max() / scale)
+    assert err < 1e-12, f"tensor contraction oracle mismatch: {err}"
+
+    return {
+        "kernel": "tensor_contract_r3",
+        "metric": "rank-3 contraction (13|2)x(54|21)=(3|45), 96^3 x 32^2, occ=0.5, f64",
+        "best_s": round(min(times), 3),
+        "first_s": round(times[0], 3),
+        "true_flops": int(flops),
+        "gflops": round(flops / min(times) / 1e9, 3),
+        "max_rel_err": err,
+        "nrep": nrep,
+        "device": _device(),
+        "sync": "forced-fetch",
+    }
+
+
+def main() -> int:
+    leg = sys.argv[1] if len(sys.argv) > 1 else "mesh"
+    nrep = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    if leg == "mesh":
+        out = mesh_leg(nrep=nrep)
+    elif leg == "tensor":
+        out = tensor_leg(nrep=nrep)
+    else:
+        print(f"unknown leg {leg!r}", file=sys.stderr)
+        return 2
+    print("CAPTURE " + json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    main()
