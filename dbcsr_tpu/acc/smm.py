@@ -42,10 +42,13 @@ def emulated_dtype_on_tpu(dtype) -> bool:
     (f64/c128 on TPU: split-f32/bf16 passes).  The single gate shared
     by every driver decision that exists to counter the emulation
     penalty (the xla_group default here and the mesh path's
-    `_stack_r0`)."""
+    `_stack_r0`).  Keys on `effective_platform` so the CPU suite can
+    assert the TPU branch (config.platform_override seam)."""
+    from dbcsr_tpu.core.config import effective_platform
+
     return (
         np.dtype(dtype) in (np.float64, np.complex128)
-        and jax.devices()[0].platform == "tpu"
+        and effective_platform() == "tpu"
     )
 
 
@@ -841,7 +844,12 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
 
 
 def _on_tpu() -> bool:
-    return jax.devices()[0].platform == "tpu"
+    """Dispatch-decision platform gate — honors the CPU suite's
+    platform_override seam; execution-level interpret= flags read the
+    real platform directly (see config.effective_platform)."""
+    from dbcsr_tpu.core.config import effective_platform
+
+    return effective_platform() == "tpu"
 
 
 def _host_smm_available(dtype) -> bool:
@@ -849,7 +857,9 @@ def _host_smm_available(dtype) -> bool:
     backend (no device round-trip), a dtype the C++ kernel's switch
     handles (the reference enum codes r4/r8/c4/c8 — not bf16), and the
     native library built."""
-    if jax.devices()[0].platform != "cpu":
+    from dbcsr_tpu.core.config import effective_platform
+
+    if effective_platform() != "cpu":
         return False
     from dbcsr_tpu.core import kinds
 

@@ -60,8 +60,23 @@ class Config:
     # any value >= 1 is honored exactly (1 forces a 2D grid and raises
     # when the device count is not a square)
     num_layers_3d: int = 0
+    # platform-injection seam (VERDICT r4 item 5): "" = the real JAX
+    # backend platform; "tpu"/"cpu" makes every dispatch DECISION
+    # (_pallas_supported, _dense_mode_wanted, emulated-dtype R-tiling)
+    # behave as if running there, so the CPU suite can assert TPU-only
+    # dispatch branches without hardware.  Execution-level choices
+    # (pallas interpret=, device placement) always follow the REAL
+    # platform — the seam steers policy, never lowering, so a faked
+    # "tpu" still runs correctly (if non-production-shaped) on CPU.
+    # Analog of the careful-mode dispatch asserts the reference keeps
+    # testable off-GPU (dbcsr_mm_sched.F:295-321).
+    platform_override: str = ""
 
     def validate(self) -> None:
+        if self.platform_override not in ("", "tpu", "cpu"):
+            raise ValueError(
+                f"platform_override must be ''/'tpu'/'cpu', "
+                f"got {self.platform_override!r}")
         if self.mm_driver not in ("auto", "xla", "xla_group", "pallas",
                                   "pallas_cross", "dense", "host"):
             raise ValueError(f"unknown mm_driver {self.mm_driver!r}")
@@ -120,6 +135,19 @@ def print_config(out=print) -> None:
     """Ref `dbcsr_print_config`."""
     for f in dataclasses.fields(Config):
         out(f"  dbcsr_tpu.{f.name:<28} {getattr(_cfg, f.name)}")
+
+
+def effective_platform() -> str:
+    """The platform dispatch DECISIONS key on: `platform_override` when
+    set (the CPU suite's seam for asserting TPU-only branches), else
+    the real JAX backend platform.  Execution-level code (interpret=
+    flags, device placement) must NOT use this — it reads the real
+    platform directly, so an override never changes lowering."""
+    if _cfg.platform_override:
+        return _cfg.platform_override
+    import jax
+
+    return jax.devices()[0].platform
 
 
 def get_default_config() -> Config:

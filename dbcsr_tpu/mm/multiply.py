@@ -333,7 +333,9 @@ def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits,
         return False
     if np.dtype(c.dtype) not in (np.float64, np.complex128):
         return False
-    if jax.devices()[0].platform != "tpu":
+    from dbcsr_tpu.core.config import effective_platform
+
+    if effective_platform() != "tpu":
         return False
     mm, nn, kk = a.nfullrows, b.nfullcols, a.nfullcols
     if max(mm * kk, kk * nn, mm * nn) > _DENSE_MAX_CANVAS:
