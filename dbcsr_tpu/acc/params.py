@@ -94,6 +94,20 @@ def _load(kind: Optional[str] = None) -> Dict:
         return _cache[path]
 
 
+def _prefer_onchip(rows):
+    """Provenance quarantine (VERDICT r4 item 6): rows measured on the
+    real chip ("onchip") outrank tunnel-latency-bound ("tunnel") or
+    CPU-measured rows — when at least one onchip row exists in the
+    candidate set, the others get no vote.  Rows with no "env" field
+    (pre-provenance tables) rank with the non-onchip ones.  The
+    reference's analog is strictly per-device parameter files
+    (parameters_utils.h); here one device file can accumulate rows of
+    mixed measurement quality through the tunnel, so quality is a
+    per-row field."""
+    onchip = [e for e in rows if e.get("env") == "onchip"]
+    return onchip or rows
+
+
 def lookup(m: int, n: int, k: int, dtype,
            stack_size: Optional[int] = None) -> Optional[Dict]:
     """Tuned entry for this (m, n, k, dtype) on the current device.
@@ -113,6 +127,7 @@ def lookup(m: int, n: int, k: int, dtype,
     rows = _by_shape(path, table).get((m, n, k, np.dtype(dtype).name), [])
     if not rows:
         return None
+    rows = _prefer_onchip(rows)
     if stack_size is None:
         return max(rows, key=lambda e: e.get("stack_size", 0))
     want = math.log(max(int(stack_size), 1))
@@ -149,8 +164,11 @@ def predict(m: int, n: int, k: int, dtype,
     import numpy as np
 
     exact = lookup(m, n, k, dtype, stack_size)
-    if exact is not None:
+    if exact is not None and exact.get("env", "onchip") != "tunnel":
         return exact
+    # exact row exists but is tunnel-latency-poisoned: fall through to
+    # the donor pool, where an onchip donor (any shape in range) mutes
+    # it; with no onchip donor the exact row wins at distance 0 anyway
     # keyed by the resolved params file so env-redirected tables (tests,
     # DBCSR_TPU_PARAMS_DIR) never serve stale predictions.  Exact S in
     # the key: the engine buckets stack lengths already, so distinct S
@@ -170,12 +188,19 @@ def predict(m: int, n: int, k: int, dtype,
     target = np.log(float(m) * n * k)
     want_s = None if stack_size is None else np.log(float(max(stack_size, 1)))
     max_d = np.log(_PREDICT_MAX_FLOP_RATIO)
+    eligible = []
     for e in table.values():
         if e["dtype"] != want_dtype:
             continue
         d = abs(np.log(float(e["m"]) * e["n"] * e["k"]) - target)
         if d > max_d:
             continue
+        eligible.append(e)
+    # provenance quarantine across the whole donor pool: one onchip
+    # donor silences every tunnel/cpu row, so a latency-poisoned
+    # 0.1-GFLOP/s row can never steer dispatch once real evidence exists
+    for e in _prefer_onchip(eligible):
+        d = abs(np.log(float(e["m"]) * e["n"] * e["k"]) - target)
         if want_s is None:
             ds = -float(e.get("stack_size", 0))  # larger S preferred
         else:
@@ -186,7 +211,12 @@ def predict(m: int, n: int, k: int, dtype,
     out = None
     if best is not None:
         out = dict(best)
-        out["predicted_from"] = (best["m"], best["n"], best["k"])
+        if (best["m"], best["n"], best["k"]) != (m, n, k):
+            # an exact-shape row that won through the pool (tunnel row
+            # with no onchip donor) is still EXACT evidence, not a
+            # donor prediction — the tag gates bf16-crosspack/pack
+            # acceptance on exactness
+            out["predicted_from"] = (best["m"], best["n"], best["k"])
     with _lock:
         if _table_gen == gen0:  # table unchanged while we computed
             _predict_cache[ck] = out

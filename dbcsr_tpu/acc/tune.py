@@ -23,6 +23,18 @@ from dbcsr_tpu.acc import params as params_mod
 from dbcsr_tpu.core.kinds import dtype_of
 
 
+def _measure_env() -> str:
+    """Measurement provenance stamped on every saved row (VERDICT r4
+    item 6): the REAL backend platform — never the dispatch seam —
+    because this records where the number came from.  "tunnel" is
+    reserved for rows known to be tunnel-latency-bound (tagged by
+    maintenance, e.g. the legacy S=30k sweep); dispatch prefers
+    "onchip" rows whenever one exists for the candidate set."""
+    import jax
+
+    return "onchip" if jax.devices()[0].platform == "tpu" else "cpu"
+
+
 def _time_config(fn, nrep: int) -> float:
     """Times include a data-dependent 8-byte fetch of the result —
     `block_until_ready` alone can return before the device work ran on
@@ -62,7 +74,7 @@ class _Candidates(list):
     def __init__(self, m, n, k, dtype, stack_size, out):
         super().__init__()
         self._row = {"m": m, "n": n, "k": k, "dtype": np.dtype(dtype).name,
-                     "stack_size": stack_size}
+                     "stack_size": stack_size, "env": _measure_env()}
         self._out = out
         self._best = None
 
@@ -274,7 +286,7 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
     best = max(candidates, key=lambda c: c["gflops"])
     entry = {
         "m": m, "n": n, "k": k, "dtype": np.dtype(dtype).name,
-        "stack_size": stack_size, **best,
+        "stack_size": stack_size, "env": _measure_env(), **best,
         "gflops": round(best["gflops"], 2),
     }
     path = params_mod.save_entry(entry)

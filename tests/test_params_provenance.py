@@ -1,0 +1,99 @@
+"""Params-row provenance quarantine (VERDICT r4 item 6).
+
+Rows measured through a wedged/latency-bound tunnel ("env": "tunnel",
+e.g. the legacy 0.1-GFLOP/s S=30k rows) must not steer dispatch once a
+real on-chip row ("env": "onchip") exists in the candidate set — for
+both the exact-shape `lookup` and the nearest-neighbor `predict`.
+Reference analog: strictly per-device parameter files
+(`parameters_utils.h`); here measurement quality is a per-row field
+because one device file accumulates rows of mixed tunnel health.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import dbcsr_tpu  # noqa: F401 — jax config via conftest
+from dbcsr_tpu.acc import params as params_mod
+
+
+@pytest.fixture
+def table(tmp_path, monkeypatch):
+    path = tmp_path / "parameters_test.json"
+    monkeypatch.setenv("DBCSR_TPU_PARAMS_DIR", str(tmp_path))
+    monkeypatch.setattr(params_mod, "params_path",
+                        lambda kind=None: str(path))
+    params_mod._cache.clear()
+    params_mod._predict_cache.clear()
+    yield path
+    params_mod._cache.clear()
+    params_mod._predict_cache.clear()
+
+
+def _write(path, rows):
+    path.write_text(json.dumps(rows))
+    params_mod._cache.clear()
+    params_mod._predict_cache.clear()
+
+
+ROW_TUNNEL = {"m": 23, "n": 23, "k": 23, "dtype": "float64",
+              "stack_size": 30000, "driver": "pallas", "grouping": 4,
+              "gflops": 0.1, "env": "tunnel"}
+ROW_ONCHIP = {"m": 23, "n": 23, "k": 23, "dtype": "float64",
+              "stack_size": 100000, "driver": "xla_group", "r0": 8,
+              "grouping": None, "gflops": 7.3, "env": "onchip"}
+
+
+def test_lookup_prefers_onchip_over_nearer_stack_size(table):
+    _write(table, [ROW_TUNNEL, ROW_ONCHIP])
+    # S=30000 is EXACTLY the tunnel row's tuning size — provenance must
+    # still outrank stack-size proximity
+    got = params_mod.lookup(23, 23, 23, np.float64, stack_size=30000)
+    assert got["env"] == "onchip" and got["driver"] == "xla_group"
+
+
+def test_lookup_uses_tunnel_rows_when_no_onchip_exists(table):
+    _write(table, [ROW_TUNNEL])
+    got = params_mod.lookup(23, 23, 23, np.float64, stack_size=30000)
+    assert got["driver"] == "pallas"
+
+
+def test_predict_donor_pool_quarantines_tunnel_rows(table):
+    # tunnel donor at the EXACT target shape, onchip donor one shape
+    # away: the onchip donor must win the whole pool
+    near_onchip = dict(ROW_ONCHIP, m=32, n=32, k=32, gflops=8.03)
+    _write(table, [ROW_TUNNEL, near_onchip])
+    got = params_mod.predict(23, 23, 23, np.float64, stack_size=30000)
+    assert got["env"] == "onchip"
+    assert got["predicted_from"] == (32, 32, 32)
+
+
+def test_predict_falls_back_to_tunnel_donors(table):
+    _write(table, [ROW_TUNNEL])
+    got = params_mod.predict(32, 32, 32, np.float64, stack_size=30000)
+    assert got is not None and got["env"] == "tunnel"
+
+
+def test_tuner_stamps_real_platform_env():
+    from dbcsr_tpu.acc.tune import _measure_env
+    from dbcsr_tpu.core.config import set_config
+
+    # provenance records the REAL platform even under the dispatch seam
+    set_config(platform_override="tpu")
+    try:
+        assert _measure_env() == "cpu"
+    finally:
+        set_config(platform_override="")
+
+
+def test_committed_table_rows_all_tagged():
+    import glob
+    import os
+
+    pdir = os.path.join(os.path.dirname(params_mod.__file__), "params")
+    for path in glob.glob(os.path.join(pdir, "*.json")):
+        for e in json.load(open(path)):
+            assert e.get("env") in ("onchip", "tunnel", "cpu"), (
+                f"untagged row {e} in {os.path.basename(path)}"
+            )
