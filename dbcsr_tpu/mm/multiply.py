@@ -420,10 +420,19 @@ def _blocks_to_dense(data, rows, cols, nbr, nbc, bm, bn):
     return _scatter_bin_to_canvas(canvas, data, ro, co, bm=bm, bn=bn)
 
 
-def _carve_full_pattern(cd, nbr, nbc, bm, bn):
+def _carve_choice() -> str:
+    """The dense-carve lowering, read OUTSIDE jit at every call site
+    and threaded in as a static argument — so the choice keys the jit
+    cache and an env change mid-process retraces instead of silently
+    keeping the stale lowering (ADVICE r4)."""
+    return os.environ.get("DBCSR_TPU_DENSE_CARVE", "gather")
+
+
+def _carve_full_pattern(cd, nbr, nbc, bm, bn, carve):
     """Carve a product canvas into the FULL row-major block pattern.
 
-    Two lowerings, selected by ``DBCSR_TPU_DENSE_CARVE``:
+    Two lowerings, selected by ``carve`` (from ``DBCSR_TPU_DENSE_CARVE``
+    via `_carve_choice`, a static jit argument at every caller):
     * ``gather`` — element-offset advanced-indexing gather (the
       historical path): builds (nbr*nbc, bm, bn) index tensors, i.e. an
       element-granular XLA gather over the whole canvas.
@@ -433,9 +442,8 @@ def _carve_full_pattern(cd, nbr, nbc, bm, bn):
       intermediate is transient inside one fused program (the round-2
       HBM-thrash lesson was about MATERIALIZED grid temps across
       program boundaries) — but until it is A/B-timed on real
-      hardware the measured ``gather`` path stays the default.
-    The env is read at first trace; switch it only across processes."""
-    if os.environ.get("DBCSR_TPU_DENSE_CARVE", "gather") == "gather":
+      hardware the measured ``gather`` path stays the default."""
+    if carve == "gather":
         keys = jnp.arange(nbr * nbc, dtype=jnp.int32)
         ro = (keys // nbc) * bm
         co = (keys % nbc) * bn
@@ -447,8 +455,10 @@ def _carve_full_pattern(cd, nbr, nbc, bm, bn):
     )
 
 
-@functools.partial(jax.jit, donate_argnums=2, static_argnames=("nbr", "nbc", "bm", "bn"))
-def _dense_product_to_blocks(ad, bd, c_blocks, c_keys, alpha, beta, nbr, nbc, bm, bn):
+@functools.partial(jax.jit, donate_argnums=2,
+                   static_argnames=("nbr", "nbc", "bm", "bn", "carve"))
+def _dense_product_to_blocks(ad, bd, c_blocks, c_keys, alpha, beta, nbr, nbc,
+                             bm, bn, carve):
     """Matmul on 2-D canvases, then carve the FULL row-major block
     pattern straight off the product canvas and scatter-add beta*old
     in block layout (position of old key k in the full pattern = k)."""
@@ -457,7 +467,7 @@ def _dense_product_to_blocks(ad, bd, c_blocks, c_keys, alpha, beta, nbr, nbc, bm
         ad, bd, (((1,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=acc,
     )
-    out = alpha * _carve_full_pattern(cd, nbr, nbc, bm, bn)
+    out = alpha * _carve_full_pattern(cd, nbr, nbc, bm, bn, carve)
     return out.at[c_keys].add(beta * c_blocks.astype(acc), mode="drop")
 
 
@@ -471,10 +481,12 @@ def _dense_dot_only(ad, bd):
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("nbr", "nbc", "bm", "bn"))
-def _dense_carve_only(cd, c_blocks, c_keys, alpha, beta, nbr, nbc, bm, bn):
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnames=("nbr", "nbc", "bm", "bn", "carve"))
+def _dense_carve_only(cd, c_blocks, c_keys, alpha, beta, nbr, nbc, bm, bn,
+                      carve):
     """Profile-mode split: carve + beta-merge as its own program."""
-    out = alpha * _carve_full_pattern(cd, nbr, nbc, bm, bn)
+    out = alpha * _carve_full_pattern(cd, nbr, nbc, bm, bn, carve)
     return out.at[c_keys].add(beta * c_blocks.astype(out.dtype), mode="drop")
 
 
@@ -687,12 +699,14 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
             out = _dense_carve_only(
                 cd, c_blocks, c_keys_dev,
                 alpha_dev, beta_dev, nbr, nbc, bm, bn,
+                carve=_carve_choice(),
             )
             _ff(out)
     else:
         out = _dense_product_to_blocks(
             ad, bd, c_blocks, c_keys_dev,
             alpha_dev, beta_dev, nbr, nbc, bm, bn,
+            carve=_carve_choice(),
         )
     with timed("dense_finalize"):
         new_keys = np.arange(nbr * nbc, dtype=np.int64)  # full pattern, row-major
@@ -735,16 +749,16 @@ def _dense_strip_matmul(cd, a_data, a_ro, a_co, b_data, b_ro, b_co,
 
 @functools.partial(
     jax.jit, donate_argnums=0,
-    static_argnames=("nbc", "bm", "bn", "rows"),
+    static_argnames=("nbc", "bm", "bn", "rows", "carve"),
 )
 def _dense_strip_to_blocks(cd, c_blocks, strip_pos, alpha, beta,
-                           *, nbc, bm, bn, rows):
+                           *, nbc, bm, bn, rows, carve):
     """Carve one C m-strip canvas into its full row-major block pattern
     and merge beta*old (strip_pos: old block -> strip-local full-pattern
     position, out-of-strip dropped).  A strip is a full row-major
     pattern over ``rows`` block rows, so it shares the gather/reshape
     carve selection with the unchunked path."""
-    out = alpha * _carve_full_pattern(cd, rows, nbc, bm, bn)
+    out = alpha * _carve_full_pattern(cd, rows, nbc, bm, bn, carve)
     return out.at[strip_pos].add(beta * c_blocks.astype(out.dtype), mode="drop")
 
 
@@ -825,7 +839,7 @@ def _dense_multiply_chunked(a, b, c, alpha, beta) -> int:
         )
         out = _dense_strip_to_blocks(
             cd, c_data, jnp.asarray(strip_pos), alpha_dev, beta_dev,
-            nbc=nbc, bm=bm, bn=bn, rows=mrb,
+            nbc=nbc, bm=bm, bn=bn, rows=mrb, carve=_carve_choice(),
         )
         parts.append(out[: (r1 - r0) * nbc])
     out = jnp.concatenate(parts) if len(parts) > 1 else parts[0]

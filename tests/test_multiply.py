@@ -484,10 +484,8 @@ def test_dense_carve_variants_equal(monkeypatch):
     nbr, nbc, bm, bn = 3, 4, 5, 7
     cd_np = rng.standard_normal((nbr * bm, nbc * bn))
     cd = jnp.asarray(cd_np)
-    monkeypatch.setenv("DBCSR_TPU_DENSE_CARVE", "gather")
-    g = np.asarray(mm._carve_full_pattern(cd, nbr, nbc, bm, bn))
-    monkeypatch.setenv("DBCSR_TPU_DENSE_CARVE", "reshape")
-    r = np.asarray(mm._carve_full_pattern(cd, nbr, nbc, bm, bn))
+    g = np.asarray(mm._carve_full_pattern(cd, nbr, nbc, bm, bn, "gather"))
+    r = np.asarray(mm._carve_full_pattern(cd, nbr, nbc, bm, bn, "reshape"))
     assert np.array_equal(g, r)
     for bi in range(nbr):
         for bj in range(nbc):
@@ -495,6 +493,42 @@ def test_dense_carve_variants_equal(monkeypatch):
                 r[bi * nbc + bj],
                 cd_np[bi * bm : (bi + 1) * bm, bj * bn : (bj + 1) * bn],
             )
+
+
+def test_carve_choice_keys_jit_cache(monkeypatch):
+    """Changing DBCSR_TPU_DENSE_CARVE mid-process must RETRACE the
+    jitted dense programs, not silently keep the stale lowering
+    (ADVICE r4): the choice is read outside jit at every call site and
+    threaded through as a static argument."""
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.mm import multiply as mm
+
+    monkeypatch.setenv("DBCSR_TPU_DENSE_CARVE", "gather")
+    assert mm._carve_choice() == "gather"
+    monkeypatch.setenv("DBCSR_TPU_DENSE_CARVE", "reshape")
+    assert mm._carve_choice() == "reshape"
+    monkeypatch.delenv("DBCSR_TPU_DENSE_CARVE")
+    assert mm._carve_choice() == "gather"
+
+    nbr, nbc, bm, bn = 2, 2, 3, 3
+    rng = np.random.default_rng(3)
+    cd_np = rng.standard_normal((nbr * bm, nbc * bn))
+
+    def run(carve):
+        # fresh buffers per call: donate_argnums consumes them
+        cd = jnp.asarray(cd_np)
+        cb = jnp.zeros((1, bm, bn))
+        ck = jnp.zeros((1,), jnp.int32)
+        return np.asarray(mm._dense_carve_only(
+            cd, cb, ck, 1.0, 0.0, nbr, nbc, bm, bn, carve=carve))
+
+    n0 = mm._dense_carve_only._cache_size()
+    g = run("gather")
+    r = run("reshape")
+    # distinct carve values -> distinct compiled programs, equal results
+    assert mm._dense_carve_only._cache_size() == n0 + 2
+    np.testing.assert_array_equal(g, r)
 
 
 def test_dense_profile_mode_matches_default(monkeypatch):
