@@ -5,6 +5,7 @@ import numpy as np
 
 from dbcsr_tpu.acc import params as params_mod
 from dbcsr_tpu.acc.bench import bench_smm, bench_trans
+import pytest
 
 
 def test_bench_smm_validates(capsys):
@@ -88,6 +89,7 @@ def test_params_stack_size_rows_coexist(tmp_path, monkeypatch):
         params_mod._predict_cache.clear()
 
 
+@pytest.mark.slow
 def test_tune_smm_writes_entry(tmp_path, monkeypatch):
     from dbcsr_tpu.acc.tune import tune_smm
 

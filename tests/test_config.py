@@ -64,6 +64,7 @@ def test_max_kernel_dim_gates_pallas():
         set_config(max_kernel_dim=Config.max_kernel_dim)
 
 
+@pytest.mark.slow
 def test_tas_split_factor_scales_nsplit():
     from dbcsr_tpu.ops.test_methods import make_random_matrix
     from dbcsr_tpu.tas import batched_mm, tas_multiply

@@ -15,11 +15,13 @@ rebuilt every rep — costs an order of magnitude, not a factor.
 
 import sys
 import os
+import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "tools"))
 
 
+@pytest.mark.slow
 def test_mesh_residency_speedup():
     from mesh_perf import run
 

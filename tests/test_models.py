@@ -12,6 +12,7 @@ from dbcsr_tpu.models.purify import (
 )
 from dbcsr_tpu.ops.test_methods import to_dense
 from dbcsr_tpu.parallel import collect, distribute, make_grid
+import pytest
 
 
 def test_mcweeny_step_vs_dense():
@@ -103,6 +104,7 @@ def test_sign_iteration_symmetric_storage_input():
     np.testing.assert_allclose(got, got.T, atol=1e-10)  # sign(A) symmetric
 
 
+@pytest.mark.slow
 def test_invsqrt_newton_schulz_converges():
     """Z/sqrt(sf) must converge to S^-1/2 (dense eig oracle)."""
     from dbcsr_tpu.models.invsqrt import invsqrt_iteration

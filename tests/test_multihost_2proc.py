@@ -49,6 +49,10 @@ assert err < 1e-12, err
 # rank-aggregated timing report: printed by rank 0 only, every rank
 # participates in the allgather (ref dbcsr_timings_report.F:51-301)
 from dbcsr_tpu.core import timings
+
+import pytest
+
+pytestmark = pytest.mark.slow  # randomized sweep / multiproc world: full-suite runs only
 lines = []
 timings.report(out=lines.append, aggregate=True)
 if pid == 0:

@@ -190,6 +190,7 @@ def test_multiply_empty_matrices():
     assert c.nblks == 0
 
 
+@pytest.mark.slow
 def test_multiply_mixed_block_sizes_stress():
     """ref dbcsr_unittest3 flavor: block-size triplets incl. odd sizes."""
     rbs = [1, 3, 4, 23]
@@ -287,6 +288,7 @@ def test_dense_mode_matches_sparse_path():
                                rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_dense_mode_nonuniform_blocking_matches_sparse_path():
     """Non-uniform blockings now take the general make_dense path
     (densify -> one matmul -> carve back into the original blocking,
@@ -351,6 +353,7 @@ def test_multiply_large_blocks_stress():
                                rtol=1e-11, atol=1e-11)
 
 
+@pytest.mark.slow
 def test_multiply_mixed_tiny_and_large_blocks():
     """1-element blocks alongside 100+ blocks in one multiply."""
     rbs = [1, 88, 3]

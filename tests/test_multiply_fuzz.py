@@ -7,6 +7,8 @@ pattern).  Seeded: every run checks the same 24 configurations."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # randomized sweep / multiproc world: full-suite runs only
+
 from dbcsr_tpu import create, make_random_matrix, multiply, to_dense
 from dbcsr_tpu.core.config import get_config, set_config
 from dbcsr_tpu.ops.test_methods import impose_sparsity

@@ -85,12 +85,14 @@ def test_multiply_limits_dense_f64(limits):
     (1, 50, 9, 18, 1, 50),    # LIMITS_COL_3
     (11, 20, 11, 20, 13, 18), # LIMITS_MIX_5
 ])
+@pytest.mark.slow
 def test_multiply_limits_sparse_retain(limits):
     _run_case((50, 50, 50), (0.5, 0.5, 0.5), alpha=1.0, beta=0.0,
               bs_m=[(1, 1), (1, 2)], bs_n=[(1, 1), (1, 2)], bs_k=[(1, 1), (1, 2)],
               limits=limits, retain_sparsity=True, dtype=np.float64)
 
 
+@pytest.mark.slow
 def test_multiply_limits_rect():
     """ref LIMITS_COL_4 / K_4: rectangular shapes."""
     _run_case((25, 50, 75), (0.5, 0.5, 0.5), alpha=1.0, beta=0.0,
@@ -108,6 +110,7 @@ def test_block_and_element_limits_conflict():
                  element_limits=(0, 1, None, None, None, None))
 
 
+@pytest.mark.slow
 def test_windowed_beta_agrees_between_engines():
     """Single-chip and mesh engines must produce identical results for
     a limited multiply with beta != 1 (C blocks outside the window keep

@@ -72,6 +72,7 @@ def test_npcols_square_grid_on_mesh():
     assert res["grid"] == {"kl": 1, "pr": 2, "pc": 2}
 
 
+@pytest.mark.slow
 def test_npcols_excess_becomes_kl_layers():
     """npcols=1 on 4 devices -> (kl=4, 1x1): pure 2.5D k-layer split
     (the NUM_LAYERS_3D analog), same checksums."""
@@ -82,6 +83,7 @@ def test_npcols_excess_becomes_kl_layers():
     assert res["grid"] == {"kl": 4, "pr": 1, "pc": 1}
 
 
+@pytest.mark.slow
 def test_rma_config_prefers_layered_mesh():
     """use_rma=T (the reference's one-sided 3D algorithm) maps to a
     layered kl>1 mesh when npcols is auto and devices allow."""
@@ -108,6 +110,7 @@ def test_transpose_config_on_mesh():
     assert res["grid"] == {"kl": 1, "pr": 2, "pc": 2}
 
 
+@pytest.mark.slow
 def test_unaligned_limits_on_mesh_match_single_chip():
     """Deliberately block-UNaligned element limits through the mesh
     driver (previously a NotImplementedError): exact via the engine's
@@ -126,6 +129,7 @@ def test_unaligned_limits_on_mesh_match_single_chip():
     assert r1["flops"] == r4["flops"]  # same true flop count both paths
 
 
+@pytest.mark.slow
 def test_multiproc_driver_two_ranks():
     """--nproc mode: a 2-process jax.distributed world runs the config
     over the combined multihost mesh with rank-identical checksums and
@@ -144,6 +148,7 @@ def test_multiproc_driver_two_ranks():
     assert all(r["checksum"] == agg["checksum"] for r in agg["per_rank"])
 
 
+@pytest.mark.slow
 def test_multiproc_driver_four_ranks_square_grid():
     """4 ranks x 1 device each: the world mesh must factor to a square
     Cannon grid (1, 2, 2) across PROCESS boundaries, with
@@ -183,6 +188,7 @@ def test_aggregate_rank_results_straggler():
         aggregate_rank_results([mk(0, fast), bad])
 
 
+@pytest.mark.slow
 def test_multiproc_driver_rect_world():
     """2 ranks x 3 devices = a 6-device world: the multihost mesh goes
     RECTANGULAR (1, 2, 3) and the all-gather engine's collectives run

@@ -32,6 +32,7 @@ def test_sparse_cannon_uniform_blocks(mesh8):
     )
 
 
+@pytest.mark.slow
 def test_sparse_cannon_mixed_blocks(mesh8):
     rng = np.random.default_rng(3)
     rbs = rng.choice([2, 3, 5], 11)
@@ -242,6 +243,7 @@ def test_tas_grouped_multiply_tall_matrix(mesh8):
     assert grp_bytes < ungrp_bytes, (grp_bytes, ungrp_bytes)
 
 
+@pytest.mark.slow
 def test_tas_grouped_nsplit_decoupled_from_kl(mesh8):
     """nsplit=8 on a kl=2 mesh runs 8 distinct groups (kl position x
     in-slot chunk) and matches the oracle exactly — the computed nsplit
@@ -337,6 +339,7 @@ def test_tas_grouped_column_long(mesh8):
     )
 
 
+@pytest.mark.slow
 def test_sparse_cannon_r_tiled_stacks(mesh8):
     """mm_driver='xla_group' forces the R-tiled mesh stack layout (the
     TPU-emulation path) on any platform; results and determinism must
@@ -361,6 +364,7 @@ def test_sparse_cannon_r_tiled_stacks(mesh8):
     np.testing.assert_allclose(to_dense(c_plain), want, rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_mesh_element_limits_unaligned_match_single_chip(mesh4):
     """Element-granular limits that do NOT align with block boundaries
     are exact on the mesh path (crop + elementwise windowed beta, ref
@@ -389,6 +393,7 @@ def test_mesh_element_limits_unaligned_match_single_chip(mesh4):
     assert checksum(c_rep) == checksum(c_mesh)
 
 
+@pytest.mark.slow
 def test_mesh_element_limits_k_window(mesh4):
     """A k-only element window (crops both operands, no beta window)."""
     from dbcsr_tpu import multiply
@@ -478,6 +483,7 @@ def test_mesh_residency_c_feedback_loop(mesh8):
     clear_mesh_plans()
 
 
+@pytest.mark.slow
 def test_sparse_cannon_complex128(mesh8):
     """c128 with complex alpha/beta through the mesh Cannon (CPU
     backend; the chip rejects C128) vs the dense oracle, incl. a
@@ -499,6 +505,7 @@ def test_sparse_cannon_complex128(mesh8):
     assert checksum(c) == checksum(c2)
 
 
+@pytest.mark.slow
 def test_sparse_cannon_complex128_r_tiled(mesh8):
     """c128 through the R-tiled (r0) mesh layout — mm_driver='xla_group'
     forces on CPU the layout auto mode would pick for c128 on TPU

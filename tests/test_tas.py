@@ -168,6 +168,7 @@ def test_batched_pgrid_reoptimization():
     np.testing.assert_allclose(to_dense(c), 2.0 * want, rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_nsplit_traffic_optimal():
     """The mesh TAS split choice must be traffic-optimal (+-1) against
     MEASURED collective bytes on the virtual mesh, for the three

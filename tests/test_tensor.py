@@ -131,6 +131,7 @@ def test_contract_rank3_rank3_over_two_dims():
     np.testing.assert_allclose(c.to_dense(), want, rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_contract_rank3_mesh_matches_oracle():
     """rank-3 contraction routed over the 8-device mesh
     (`contract(mesh=...)` -> the distributed TAS/Cannon path) against

@@ -10,6 +10,8 @@ the coverage the reference gets from its libsmm_acc kernel sweep.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # randomized sweep / multiproc world: full-suite runs only
+
 from dbcsr_tpu.mm.multiply import multiply
 from dbcsr_tpu.ops.test_methods import make_random_matrix, to_dense
 from dbcsr_tpu.perf.driver import expand_block_sizes
