@@ -140,10 +140,56 @@ def _pick_cpu_driver_from_evidence(dtype_enum: int) -> str:
     return "auto"
 
 
+def _pick_dense_mode_from_evidence(dtype_enum: int):
+    """For dtypes OUTSIDE the emulated-dtype cost model (f32/bf16,
+    where the engine's default is the stack path), decide whether to
+    force dense mode from committed on-chip A/B evidence: the tier-2.5
+    `DBCSR_TPU_MM_DENSE=1` leg vs the best stack-path run of the same
+    dtype.  Returns True (force dense), False (default), mirroring the
+    carve pick — the A/B leg exists precisely to teach this default
+    (PERF_NOTES: a 10k^3 f32 MXU dot costs ~0.2 s vs the banked 15.46
+    GFLOP/s stack run).  f64/c128 route through the cost model, which
+    is already dense for the north star; returns False there."""
+    if dtype_enum not in (1, 9) or "DBCSR_TPU_MM_DENSE" in os.environ:
+        return False
+    best = {"dense": None, "stack": None}
+    try:
+        fh = open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_CAPTURES.jsonl"))
+    except OSError:
+        return False
+    with fh:
+        for line in fh:
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if r.get("device_fallback"):
+                continue
+            env = r.get("env") or {}
+            if env.get("DBCSR_TPU_BENCH_DTYPE", "3") != str(dtype_enum):
+                continue
+            alg = "dense" if (r.get("algorithm") == "dense"
+                              or env.get("DBCSR_TPU_MM_DENSE") == "1") \
+                else "stack"
+            try:
+                v = float(r.get("value") or 0)
+            except (TypeError, ValueError):
+                continue
+            if best[alg] is None or v > best[alg]:
+                best[alg] = v
+    return bool(best["dense"] and best["stack"]
+                and best["dense"] > best["stack"])
+
+
 def main():
     probe_timeout = int(os.environ.get("DBCSR_TPU_BENCH_PROBE_TIMEOUT", "600"))
     carve = _pick_carve_from_evidence()
     os.environ["DBCSR_TPU_DENSE_CARVE"] = carve
+    dense_forced = _pick_dense_mode_from_evidence(
+        int(os.environ.get("DBCSR_TPU_BENCH_DTYPE", "3")))
+    if dense_forced:
+        os.environ["DBCSR_TPU_MM_DENSE"] = "1"
     fallback = not _probe_tpu(probe_timeout)
     if fallback:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -238,6 +284,9 @@ def main():
         # regression-guarded, see _pick_cpu_driver_from_evidence);
         # null on-device where auto dispatch decides per stack
         "mm_driver": mm_driver,
+        # f32/bf16 dense-mode force, evidence-selected from the
+        # tier-2.5 A/B (see _pick_dense_mode_from_evidence)
+        "mm_dense_forced": dense_forced or None,
         # timing forces real device completion via a data-dependent
         # 8-byte fetch per rep (driver._force_completion): on the axon
         # tunnel, block_until_ready alone can return before the work

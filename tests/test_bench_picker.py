@@ -81,3 +81,70 @@ def test_env_override_respected(bench_mod, monkeypatch):
     bench, path = bench_mod
     monkeypatch.setenv("DBCSR_TPU_DENSE_CARVE", "reshape")
     assert bench._pick_carve_from_evidence() == "reshape"
+
+
+def test_cpu_driver_pick_defaults_to_auto(bench_mod, monkeypatch):
+    bench, path = bench_mod
+    monkeypatch.delenv("DBCSR_TPU_BENCH_CPU_DRIVER", raising=False)
+    assert bench._pick_cpu_driver_from_evidence(3) == "auto"
+
+
+def test_cpu_driver_pick_follows_fallback_evidence(bench_mod, monkeypatch):
+    """The r04 regression class: the fallback driver must come from
+    committed fallback measurements, not an uncommitted claim."""
+    bench, path = bench_mod
+    monkeypatch.delenv("DBCSR_TPU_BENCH_CPU_DRIVER", raising=False)
+    # on-chip rows and other dtypes must not count toward the pick
+    rows = [
+        {"value": 2.25, "device_fallback": True, "mm_driver": "host",
+         "env": {}},
+        {"value": 3.73, "device_fallback": True, "mm_driver": "auto",
+         "env": {}},
+        {"value": 99.0, "device_fallback": False, "mm_driver": "host",
+         "env": {}},
+        {"value": 88.0, "device_fallback": True, "mm_driver": "host",
+         "env": {"DBCSR_TPU_BENCH_DTYPE": "1"}},
+    ]
+    _write(path, rows, torn=True)
+    assert bench._pick_cpu_driver_from_evidence(3) == "auto"
+    _write(path, rows + [{"value": 4.4, "device_fallback": True,
+                          "mm_driver": "host", "env": {}}])
+    assert bench._pick_cpu_driver_from_evidence(3) == "host"
+    monkeypatch.setenv("DBCSR_TPU_BENCH_CPU_DRIVER", "host")
+    assert bench._pick_cpu_driver_from_evidence(3) == "host"
+
+
+def test_dense_mode_pick_needs_both_sides(bench_mod, monkeypatch):
+    """f32/bf16 dense-forcing flips only on a measured on-chip win of
+    dense over stack for the SAME dtype."""
+    bench, path = bench_mod
+    monkeypatch.delenv("DBCSR_TPU_MM_DENSE", raising=False)
+    # f64 routes through the cost model: never forced here
+    assert bench._pick_dense_mode_from_evidence(3) is False
+    assert bench._pick_dense_mode_from_evidence(1) is False  # no evidence
+    _write(path, [
+        {"value": 15.46, "algorithm": "stack", "device_fallback": False,
+         "env": {"DBCSR_TPU_BENCH_DTYPE": "1"}},
+    ])
+    assert bench._pick_dense_mode_from_evidence(1) is False  # stack only
+    with open(path, "a") as fh:
+        fh.write(json.dumps(
+            {"value": 44.0, "algorithm": "dense", "device_fallback": False,
+             "env": {"DBCSR_TPU_BENCH_DTYPE": "1",
+                     "DBCSR_TPU_MM_DENSE": "1"}}) + "\n")
+    assert bench._pick_dense_mode_from_evidence(1) is True
+    # an explicit env choice disables the auto-pick
+    monkeypatch.setenv("DBCSR_TPU_MM_DENSE", "0")
+    assert bench._pick_dense_mode_from_evidence(1) is False
+
+
+def test_dense_mode_pick_stack_still_winning(bench_mod, monkeypatch):
+    bench, path = bench_mod
+    monkeypatch.delenv("DBCSR_TPU_MM_DENSE", raising=False)
+    _write(path, [
+        {"value": 15.46, "algorithm": "stack", "device_fallback": False,
+         "env": {"DBCSR_TPU_BENCH_DTYPE": "1"}},
+        {"value": 9.0, "algorithm": "dense", "device_fallback": False,
+         "env": {"DBCSR_TPU_BENCH_DTYPE": "1", "DBCSR_TPU_MM_DENSE": "1"}},
+    ])
+    assert bench._pick_dense_mode_from_evidence(1) is False
