@@ -232,6 +232,7 @@ def test_run_tests_mm():
     assert out  # produced a report line
 
 
+@pytest.mark.slow
 def test_run_tests_mm_transposed_retain():
     cs = run_tests((30, 30, 40), trs=(True, True),
                    sparsities=(0.3, 0.3, 0.5), retain_sparsity=True,

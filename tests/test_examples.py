@@ -13,10 +13,10 @@ EXAMPLES = os.path.join(
 @pytest.mark.parametrize("name", [
     "example_1_create.py",
     "example_2_set.py",
-    "example_3_multiply.py",
-    "tensor_example_contract.py",
+    pytest.param("example_3_multiply.py", marks=pytest.mark.slow),
+    pytest.param("tensor_example_contract.py", marks=pytest.mark.slow),
     "example_4_tensor_api.py",
-    "example_5_any_grid.py",
+    pytest.param("example_5_any_grid.py", marks=pytest.mark.slow),
 ])
 def test_example_runs(name, capsys):
     runpy.run_path(os.path.join(EXAMPLES, name), run_name="__main__")

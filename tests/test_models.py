@@ -72,6 +72,7 @@ def test_sign_iteration_mixed_spectrum():
     np.testing.assert_allclose(to_dense(x), want, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_mcweeny_sparse_distributed_matches_single():
     import numpy as np
 

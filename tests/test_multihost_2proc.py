@@ -11,6 +11,10 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow  # real 2-process world: full-suite runs only
+
 _WORKER = r'''
 import sys
 sys.path.insert(0, {repo!r})
@@ -50,9 +54,6 @@ assert err < 1e-12, err
 # participates in the allgather (ref dbcsr_timings_report.F:51-301)
 from dbcsr_tpu.core import timings
 
-import pytest
-
-pytestmark = pytest.mark.slow  # randomized sweep / multiproc world: full-suite runs only
 lines = []
 timings.report(out=lines.append, aggregate=True)
 if pid == 0:

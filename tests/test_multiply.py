@@ -316,6 +316,7 @@ def test_dense_mode_nonuniform_blocking_matches_sparse_path():
     assert c_dense.nblks == len(rbs) * len(cbs)
 
 
+@pytest.mark.slow
 def test_dense_mode_nonuniform_auto_at_full_occupancy():
     """occ=1 non-uniform matrices take dense mode automatically."""
     rbs, kbs = [3, 5, 4], [2, 6]
@@ -337,6 +338,7 @@ def test_dense_mode_not_used_with_filter():
     assert c.nblks == 0  # all filtered -> sparse machinery ran
 
 
+@pytest.mark.slow
 def test_multiply_large_blocks_stress():
     """ref dbcsr_unittest2.F:80-102: large and rectangular block sizes
     (up to 100s) must flow through the engine like small ones — these

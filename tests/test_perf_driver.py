@@ -100,6 +100,7 @@ def test_indivisible_npcols_rejected():
         run_perf(cfg, verbose=False, n_devices=4)
 
 
+@pytest.mark.slow
 def test_transpose_config_on_mesh():
     """rect2 (transa=T) through the mesh path: op(A) resolution happens
     in the driver before panel assembly."""

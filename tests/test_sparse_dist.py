@@ -141,6 +141,7 @@ def test_comm_statistics_recorded(mesh8):
     stats.reset()
 
 
+@pytest.mark.slow
 def test_sparse_cannon_honors_distribution(mesh8):
     """Checksum invariance across 3 different Distributions of the same
     matrices (ref `dbcsr_distribution_new` arbitrary maps,
@@ -175,6 +176,7 @@ def test_sparse_cannon_honors_distribution(mesh8):
     assert sums[0] == sums[1] == sums[2]
 
 
+@pytest.mark.slow
 def test_sparse_cannon_filter_eps_matches_single_chip(mesh8):
     from dbcsr_tpu import multiply
 
@@ -212,6 +214,7 @@ def test_sparse_cannon_retain_sparsity_matches_single_chip(mesh8):
     )
 
 
+@pytest.mark.slow
 def test_tas_grouped_multiply_tall_matrix(mesh8):
     """Group-parallel TAS on the mesh: per-group Cannons over 'kl' with
     the short matrix replicated (ref dbcsr_tas_mm.F:79-806).  Traffic
@@ -269,6 +272,7 @@ def test_tas_grouped_nsplit_decoupled_from_kl(mesh8):
     )
 
 
+@pytest.mark.slow
 def test_tas_grouped_nsplit_r_tiled(mesh8):
     """Chunked groups compose with the R-tiled stack layout (slot
     offsets + the guaranteed-zero pad row at the chunked buffer end)."""
@@ -322,6 +326,7 @@ def test_tas_multiply_mesh_routes_to_grouped(mesh8):
     )
 
 
+@pytest.mark.slow
 def test_tas_grouped_column_long(mesh8):
     """n-long C goes through the transposed grouped path."""
     from dbcsr_tpu.tas import tas_multiply
@@ -573,6 +578,7 @@ def test_mesh_dense_mode_high_fill_routes_dense(mesh8):
     assert c_dense._last_flops == c_stack._last_flops
 
 
+@pytest.mark.slow
 def test_mesh_dense_mode_mixed_blockings(mesh4):
     """Non-uniform blockings run the general canvas path under the mesh
     dense Cannon (padded to grid divisibility)."""
@@ -669,6 +675,7 @@ def test_rect_grid_shapes():
     assert dict(make_grid(8, layers=1).shape) == {"kl": 1, "pr": 2, "pc": 4}
 
 
+@pytest.mark.slow
 def test_rect_sparse_multiply_mixed_blocks(mesh6):
     rng = np.random.default_rng(61)
     rbs = rng.choice([2, 3, 5], 11)
@@ -682,6 +689,7 @@ def test_rect_sparse_multiply_mixed_blocks(mesh6):
     )
 
 
+@pytest.mark.slow
 def test_rect_8dev_one_layer_beta():
     mesh = make_grid(8, layers=1)  # (1, 2, 4)
     rbs = [3] * 9
@@ -693,6 +701,7 @@ def test_rect_8dev_one_layer_beta():
     np.testing.assert_allclose(to_dense(c), want, rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_rect_with_k_layers():
     mesh = make_grid(6, layers=2)  # (2, 1, 3): layers + rectangular
     rbs = [4] * 8
@@ -704,6 +713,7 @@ def test_rect_with_k_layers():
     )
 
 
+@pytest.mark.slow
 def test_rect_r_tiled_stacks(mesh6):
     """Forced xla_group exercises the R-tiled stack layout against the
     GATHERED panel indexing (in-tile pads must hit the zero rows)."""
@@ -766,6 +776,7 @@ def test_rect_block_limits(mesh6):
                                rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_rect_complex128(mesh6):
     rbs = [3] * 8
     a = _rand("A", rbs, rbs, 0.5, 77, dtype=np.complex128)

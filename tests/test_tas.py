@@ -15,6 +15,7 @@ def _tall(name, nlong, nshort, seed, occ=0.3):
     return long_sizes, short_sizes, rng
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("transa,transb", [("N", "N"), ("T", "N"), ("N", "T"), ("T", "T")])
 def test_tas_multiply_transposes(transa, transb):
     """Tall A (m long), small B; all transpose combos vs dense oracle."""
@@ -107,6 +108,7 @@ def test_tas_multiply_on_mesh_matches_host():
     np.testing.assert_allclose(to_dense(c), to_dense(c_host), rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_tensor_contract_on_mesh():
     import numpy as np
 
@@ -228,6 +230,7 @@ def test_nsplit_traffic_optimal():
         )
 
 
+@pytest.mark.slow
 def test_tas_auto_split_on_rectangular_mesh():
     """Auto-split TAS on a rectangular kl>1 mesh must route to the
     all-gather engine (the grouped path needs a square Cannon grid),

@@ -158,6 +158,7 @@ def test_contract_rank3_mesh_matches_oracle():
                                rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_contract_rank3_rank3_mesh_double_contraction():
     """A(i,a,b) * B(j,a,b) -> C(i,j) over the mesh, with alpha/beta."""
     from dbcsr_tpu.parallel import make_grid
@@ -305,6 +306,7 @@ def test_tas_batched_mm_state_machine():
     np.testing.assert_allclose(to_dense(c), want, rtol=1e-10, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_tas_batched_split_reoptimizes_on_sparsity_change():
     """The cached batch split is re-chosen when it leaves the
     acceptance window of the current-sparsity optimum (the analog of
@@ -445,6 +447,7 @@ def test_contract_test_with_bounds_and_filter_reject():
                       filter_eps=1e-10, io=lambda *_: None)
 
 
+@pytest.mark.slow
 def test_contract_rank3_rect_mesh_matches_oracle():
     """Tensor contraction over a RECTANGULAR 6-device mesh: the
     nd->2d-mapped product runs through the all-gather engine with
