@@ -188,9 +188,13 @@ def main():
     os.environ["DBCSR_TPU_DENSE_CARVE"] = carve
     dense_forced = _pick_dense_mode_from_evidence(
         int(os.environ.get("DBCSR_TPU_BENCH_DTYPE", "3")))
-    if dense_forced:
-        os.environ["DBCSR_TPU_MM_DENSE"] = "1"
     fallback = not _probe_tpu(probe_timeout)
+    if dense_forced and not fallback:
+        # the evidence is on-chip evidence: it must not steer a CPU
+        # fallback run, where f32 dense has never been measured
+        os.environ["DBCSR_TPU_MM_DENSE"] = "1"
+    else:
+        dense_forced = False
     if fallback:
         os.environ["JAX_PLATFORMS"] = "cpu"
 
