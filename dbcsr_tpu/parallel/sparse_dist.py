@@ -203,6 +203,27 @@ def _stack_r0(dtype) -> int:
     return 8 if emulated_dtype_on_tpu(dtype) else 0
 
 
+_TICK_CHUNK_ENTRIES = 32768
+
+
+def _tick_chunks(s_cap: int, r0: int) -> tuple:
+    """(nchunk, rows_per_chunk) bounding per-tick gather/product temps
+    to ~`_TICK_CHUNK_ENTRIES` entry-equivalents (R-tiled rows count as
+    r0 entries each).  Small grids concentrate the whole product in ONE
+    tick (a 1x1 grid: everything), and an unchunked tick materializes
+    (E, bm, bn) gather/product temps — 3 x 3.5 GB f64 at the north
+    star, which thrashes memory (measured: a 1x1x1 CPU-mesh rep ran 7x
+    the single-chip engine, nonlinearly worse with size; the
+    single-chip path chunks at mm_stack_size for exactly this reason).
+    `bucket_size` capacities are {4..7}*2^k, so the power-of-two chunk
+    count always divides s_cap exactly (no tail, no re-read)."""
+    target = max(1, _TICK_CHUNK_ENTRIES // max(r0, 1))
+    nchunk = 1
+    while s_cap // nchunk > target and s_cap % (nchunk * 2) == 0:
+        nchunk *= 2
+    return nchunk, s_cap // nchunk
+
+
 def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=0, nticks=None):
     """The shared Cannon metronome: ticks of gather → batched matmul →
     sorted segment-sum, ring-shifting A along 'pc' and B along 'pr'
@@ -210,16 +231,18 @@ def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=0, nticks=None):
     ``r0 > 0``: R-tiled stacks (k-merged dots, `_fill_stacks` layout).
     ``s == 0`` disables the ring shifts (the all-gather engine's chunk
     loop: operands already complete, ticks bound peak memory only);
-    ``nticks`` overrides the tick count (defaults to s)."""
+    ``nticks`` overrides the tick count (defaults to s).  Each tick's
+    stack additionally runs in `_tick_chunks` sub-chunks so peak temp
+    memory stays bounded no matter how much product one tick carries."""
     bm, bk, bn = a.shape[1], a.shape[2], b.shape[2]
     from dbcsr_tpu.parallel.cannon import mark_varying
 
     c = jnp.zeros((cap_c, bm, bn), acc_dtype)
     c = mark_varying(c, ("kl", "pr", "pc"))
+    nchunk, rows = _tick_chunks(st.shape[1], r0)
+    width = st.shape[2]
 
-    def tick(t, carry):
-        a, b, c = carry
-        entries = st[t]
+    def _contrib(a, b, c, entries):
         if r0:
             ia = entries[:, :r0]
             ib = entries[:, r0:2 * r0]
@@ -236,10 +259,20 @@ def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=0, nticks=None):
             precision=jax.lax.Precision.HIGHEST,
             preferred_element_type=acc_dtype,
         )
-        c = c + jax.ops.segment_sum(
+        return c + jax.ops.segment_sum(
             prod, ic, num_segments=cap_c,
             indices_are_sorted=True,
         )
+
+    def tick(t, carry):
+        a, b, c = carry
+        if nchunk > 1:
+            st_t = st[t].reshape(nchunk, rows, width)
+            c = jax.lax.fori_loop(
+                0, nchunk, lambda j, cc: _contrib(a, b, cc, st_t[j]), c
+            )
+        else:
+            c = _contrib(a, b, c, st[t])
         if s > 1:
             shift_a = tuple(((j + 1) % s, j) for j in range(s))
             shift_b = tuple(((i + 1) % s, i) for i in range(s))

@@ -797,3 +797,29 @@ def test_rect_comm_statistics(mesh6):
     stats.reset()
     sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh6)
     assert "all_gather" in stats._comm and stats._comm["all_gather"].nbytes > 0
+
+
+def test_tick_chunks_bound_temp_memory():
+    """Per-tick sub-chunking (the 1x1-grid memory-thrash fix): chunk
+    counts divide the bucket capacity exactly and bound rows at the
+    entry-equivalent target."""
+    from dbcsr_tpu.parallel.sparse_dist import (
+        _TICK_CHUNK_ENTRIES,
+        _tick_chunks,
+    )
+    from dbcsr_tpu.utils.rounding import bucket_size
+
+    for n in (1, 16, 30000, 823000, 5_000_000):
+        cap = bucket_size(n)
+        for r0 in (0, 8):
+            nchunk, rows = _tick_chunks(cap, r0)
+            assert nchunk * rows == cap
+            target = max(1, _TICK_CHUNK_ENTRIES // max(r0, 1))
+            if cap > target:
+                # bounded: a further halving would be possible only if
+                # it broke divisibility
+                assert rows <= target or cap % (nchunk * 2) != 0
+            else:
+                assert nchunk == 1
+    assert _tick_chunks(bucket_size(823000), 0)[1] <= 32768
+    assert _tick_chunks(bucket_size(823000), 8)[1] <= 4096
