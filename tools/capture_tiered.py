@@ -229,6 +229,44 @@ def run_tier25(done: dict) -> None:
                    "DBCSR_TPU_MM_DENSE": "1"}, 900, 2.5)
 
 
+def _rerun_tier3_on_new_evidence() -> None:
+    """Tier 3 runs BEFORE the tier-2.5 A/Bs, so the first committed
+    tier-3 artifacts use the pre-A/B defaults.  If the A/B evidence
+    flips a production default (reshape carve for f64, dense mode for
+    f32 — both consumed by bench.py's evidence pickers), re-run that
+    tier-3 leg ONCE so a best-configuration artifact is committed even
+    if the round-end driver bench never gets a healthy tunnel."""
+    import bench
+
+    rows = []
+    try:
+        with open(BENCH_CAPTURES) as fh:
+            for line in fh:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if not r.get("device_fallback") and r.get("tier") == 3:
+                    rows.append(r)
+    except OSError:
+        return
+    pick = bench._pick_carve_from_evidence()
+    f64_rows = [r for r in rows
+                if (r.get("env") or {}).get("DBCSR_TPU_BENCH_DTYPE", "3") == "3"]
+    if f64_rows and pick == "reshape" \
+            and not any(r.get("carve") == "reshape" for r in f64_rows):
+        log("tier3 f64 re-run: carve evidence flipped to reshape")
+        run_bench({}, 1800, 3)
+    if _past_deadline():
+        return
+    f32_rows = [r for r in rows
+                if (r.get("env") or {}).get("DBCSR_TPU_BENCH_DTYPE") == "1"]
+    if f32_rows and bench._pick_dense_mode_from_evidence(1) \
+            and not any(r.get("algorithm") == "dense" for r in f32_rows):
+        log("tier3 f32 re-run: dense-mode evidence flipped")
+        run_bench({"DBCSR_TPU_BENCH_DTYPE": "1"}, 1800, 3)
+
+
 def run_tier5() -> None:
     """One-shot on-chip artifacts for the two paths that have never
     been timed on hardware (VERDICT r4 items 7/8): the mesh engine on a
@@ -500,6 +538,8 @@ def _attempt_tiers(st: dict) -> dict:
     if ok3 and not done["tier3_f32"] and not _past_deadline():
         run_bench({"DBCSR_TPU_BENCH_DTYPE": "1"}, 1800, 3)
     st["tier3"] = ok3
+    if ok3 and not _past_deadline():
+        _rerun_tier3_on_new_evidence()
     if ok3 and not _past_deadline():
         run_tier5()
     if ok3 and not _past_deadline():
