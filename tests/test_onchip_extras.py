@@ -1,0 +1,30 @@
+"""The tier-5 capture legs (tools/onchip_extras.py) must stay runnable:
+a healthy tunnel window is too precious to spend discovering bitrot.
+CPU-validated here at reduced scale; the real artifacts come from the
+capture loop on hardware."""
+
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow  # ~20 s combined: full-suite runs only
+
+sys.path.insert(0, "tools")
+
+
+def test_mesh_leg_small():
+    from onchip_extras import mesh_leg
+
+    r = mesh_leg(nrep=2, nblk=30)
+    assert r["kernel"] == "mesh_1x1x1_northstar"
+    assert r["mesh_best_s"] > 0 and r["single_chip_best_s"] > 0
+    assert r["sync"] == "forced-fetch"
+
+
+def test_tensor_leg():
+    from onchip_extras import tensor_leg
+
+    r = tensor_leg(nrep=1)
+    assert r["kernel"] == "tensor_contract_r3"
+    assert r["max_rel_err"] < 1e-12
+    assert r["true_flops"] > 0 and r["gflops"] > 0
