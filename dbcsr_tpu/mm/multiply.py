@@ -584,23 +584,45 @@ def _dense_multiply_general(a, b, c, alpha, beta) -> int:
     """Dense mode for arbitrary (non-uniform) blockings: densify on
     device, one MXU matmul, carve C back into its own full blocking
     (the `dbcsr_make_dense`/`dbcsr_make_undense` re-blocking pair,
-    `dbcsr_mm.F:593-617`, generalized to one flat dense canvas)."""
-    ad = _dense_canvas_cached(a, lambda: _to_dense_device(a))
-    bd = _dense_canvas_cached(b, lambda: _to_dense_device(b))
+    `dbcsr_mm.F:593-617`, generalized to one flat dense canvas).
+
+    THIS is the production north-star path: m=10000 with (1,23) sizes
+    expands to 434x23 + one 18 block (ceil-division blocking), so the
+    uniform `_dense_multiply` never fires for it.  The profile buckets
+    and the gather/reshape carve A/B therefore live here too — a
+    hardware window spent profiling the uniform path would attribute
+    the wrong program."""
+    profile = os.environ.get("DBCSR_TPU_DENSE_PROFILE") == "1"
+    if profile:
+        from dbcsr_tpu.utils.sync import fetch_fence as _ff
+
+    with timed("dense_canvas_ab"):
+        ad = _dense_canvas_cached(a, lambda: _to_dense_device(a))
+        bd = _dense_canvas_cached(b, lambda: _to_dense_device(b))
+        if profile:
+            _ff(ad), _ff(bd)
     acc = ad.dtype
-    cd = jax.lax.dot_general(
-        ad, bd, (((1,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST,
-        preferred_element_type=acc,
-    )
-    dt_name = str(np.dtype(c.dtype))
-    alpha_dev = _dense_const(("scalar", complex(alpha), dt_name),
-                             lambda: jnp.asarray(alpha, dtype=c.dtype))
-    beta_dev = _dense_const(("scalar", complex(beta), dt_name),
-                            lambda: jnp.asarray(beta, dtype=c.dtype))
-    cd = alpha_dev * cd
-    if beta != 0 and c.nblks:
-        cd = cd + beta_dev * _to_dense_device(c)
-    carve_full_pattern(c, cd)
+    with timed("dense_dot"):
+        cd = jax.lax.dot_general(
+            ad, bd, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=acc,
+        )
+        dt_name = str(np.dtype(c.dtype))
+        alpha_dev = _dense_const(("scalar", complex(alpha), dt_name),
+                                 lambda: jnp.asarray(alpha, dtype=c.dtype))
+        beta_dev = _dense_const(("scalar", complex(beta), dt_name),
+                                lambda: jnp.asarray(beta, dtype=c.dtype))
+        cd = alpha_dev * cd
+        if beta != 0 and c.nblks:
+            cd = cd + beta_dev * _to_dense_device(c)
+        if profile:
+            _ff(cd)
+    with timed("dense_carve"):
+        carve_full_pattern(c, cd)
+        if profile:
+            for bb in c.bins:
+                _ff(bb.data)
     # marketing flops = the dense work performed; the RETURN value is the
     # true flops of the sparse product (comparable across algorithms,
     # ref marketing-vs-true `dbcsr_mm.F:664-667`)
@@ -608,28 +630,85 @@ def _dense_multiply_general(a, b, c, alpha, beta) -> int:
     return _true_product_flops(a, b)
 
 
+def _near_uniform(sizes) -> bool:
+    """All block sizes equal except a possibly-smaller LAST one — the
+    shape every ceil-division blocking (the perf driver's (1, s) sizes,
+    `expand_block_sizes`) produces.  Offsets then align to multiples of
+    the leading size, so a zero-padded canvas carves as a pure layout
+    permutation."""
+    if len(sizes) == 0:
+        return False
+    s0 = int(sizes[0])
+    return bool(np.all(np.asarray(sizes[:-1]) == s0) and int(sizes[-1]) <= s0)
+
+
+@functools.partial(jax.jit, static_argnames=("nbr", "nbc", "bm", "bn"))
+def _carve_padded_reshape(cd, nbr, nbc, bm, bn):
+    """Pad the canvas to (nbr*bm, nbc*bn) and carve the full row-major
+    pattern via reshape/transpose — a near-bandwidth layout permutation
+    instead of an element-granular gather (the `reshape` leg of the
+    DBCSR_TPU_DENSE_CARVE A/B for near-uniform blockings)."""
+    pm = nbr * bm - cd.shape[0]
+    pn = nbc * bn - cd.shape[1]
+    if pm or pn:
+        cd = jnp.pad(cd, ((0, pm), (0, pn)))
+    return (
+        cd.reshape(nbr, bm, nbc, bn)
+        .transpose(0, 2, 1, 3)
+        .reshape(nbr * nbc, bm, bn)
+    )
+
+
 def carve_full_pattern(c, cd) -> None:
     """Carve a dense device canvas into ``c``'s FULL block pattern, bin
     by bin (`dbcsr_make_undense`, `dbcsr_mm.F:770-810`); shared by the
-    single-chip and mesh dense modes."""
+    single-chip and mesh dense modes.
+
+    Two lowerings (the production side of the DBCSR_TPU_DENSE_CARVE
+    A/B — `_carve_choice` is read outside jit on every call):
+    * ``gather`` — per-bin element-offset gathers off the canvas (the
+      historical path; at the north star that is ~10^8 index entries).
+    * ``reshape`` — for near-uniform blockings (uniform except a
+      smaller last row/col block, i.e. every ceil-division blocking):
+      one padded reshape/transpose carve, then per-bin BLOCK-granular
+      takes and edge slices.  Falls back to gather when the blocking
+      is genuinely irregular."""
     nbr, nbc = c.nblkrows, c.nblkcols
     new_keys = np.arange(nbr * nbc, dtype=np.int64)
     rows = new_keys // nbc
     cols = new_keys % nbc
     nb, nsl, shapes = _bin_entries(c.row_blk_sizes, c.col_blk_sizes, rows, cols)
+    use_reshape = (
+        _carve_choice() == "reshape"
+        and _near_uniform(c.row_blk_sizes)
+        and _near_uniform(c.col_blk_sizes)
+    )
+    carved = None
+    if use_reshape:
+        carved = _carve_padded_reshape(
+            cd, nbr, nbc,
+            int(c.row_blk_sizes[0]), int(c.col_blk_sizes[0]),
+        )
     roff = c.row_blk_offsets[rows]
     coff = c.col_blk_offsets[cols]
     bins = []
     for b_id, (bm, bn) in enumerate(shapes):
         sel = np.nonzero(nb == b_id)[0]
         count = len(sel)
-        ro = np.empty(count, np.int64)
-        co = np.empty(count, np.int64)
-        ro[nsl[sel]] = roff[sel]
-        co[nsl[sel]] = coff[sel]
-        data = _gather_bin_from_canvas(
-            cd, jnp.asarray(ro), jnp.asarray(co), bm=int(bm), bn=int(bn)
-        )
+        if use_reshape:
+            idx = np.empty(count, np.int64)
+            idx[nsl[sel]] = sel  # block-granular: flat key IS the
+            data = jnp.take(carved, jnp.asarray(idx), axis=0)  # carved row
+            if data.shape[1] != bm or data.shape[2] != bn:
+                data = data[:, :int(bm), :int(bn)]  # edge blocks: crop pad
+        else:
+            ro = np.empty(count, np.int64)
+            co = np.empty(count, np.int64)
+            ro[nsl[sel]] = roff[sel]
+            co[nsl[sel]] = coff[sel]
+            data = _gather_bin_from_canvas(
+                cd, jnp.asarray(ro), jnp.asarray(co), bm=int(bm), bn=int(bn)
+            )
         cap = bucket_size(count)
         if cap > count:
             data = jnp.concatenate(

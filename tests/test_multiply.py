@@ -548,3 +548,51 @@ def test_dense_profile_mode_matches_default(monkeypatch):
     monkeypatch.setenv("DBCSR_TPU_DENSE_PROFILE", "1")
     multiply("N", "N", 1.5, a, b, 0.5, c_prof)
     np.testing.assert_array_equal(to_dense(c_ref), to_dense(c_prof))
+
+
+@pytest.mark.parametrize("carve", ["gather", "reshape"])
+def test_dense_general_carve_variants_match_oracle(carve, monkeypatch):
+    """The PRODUCTION north-star shape is near-uniform (ceil-division
+    blocking: uniform 23s + one trailing 18), which routes through
+    _dense_multiply_general/carve_full_pattern — both carve lowerings
+    must be oracle-exact there (the on-chip A/B measures this path)."""
+    monkeypatch.setenv("DBCSR_TPU_DENSE_CARVE", carve)
+    from dbcsr_tpu.core.config import set_config
+
+    rbs = [23] * 6 + [18]   # near-uniform rows
+    cbs = [13] * 5 + [7]    # near-uniform cols, different size
+    kbs = [23] * 4 + [11]
+    a = _rand("a", rbs, kbs, 0.6, seed=31)
+    b = _rand("b", kbs, cbs, 0.6, seed=32)
+    c = _rand("c", rbs, cbs, 0.4, seed=33)
+    c0 = to_dense(c)
+    set_config(mm_dense=True)
+    try:
+        multiply("N", "N", 1.5, a, b, 0.5, c)
+    finally:
+        set_config(mm_dense=None)
+    want = 1.5 * (to_dense(a) @ to_dense(b)) + 0.5 * c0
+    np.testing.assert_allclose(to_dense(c), want, rtol=1e-12, atol=1e-12)
+
+
+def test_dense_general_irregular_blocking_reshape_falls_back(monkeypatch):
+    """A genuinely irregular blocking (odd size in the middle) cannot
+    reshape-carve; the choice must silently fall back to gather."""
+    monkeypatch.setenv("DBCSR_TPU_DENSE_CARVE", "reshape")
+    from dbcsr_tpu.core.config import set_config
+    from dbcsr_tpu.mm.multiply import _near_uniform
+
+    rbs = [23, 11, 23, 23]
+    assert not _near_uniform(np.asarray(rbs))
+    assert _near_uniform(np.asarray([23] * 3 + [18]))
+    assert _near_uniform(np.asarray([23, 23, 23]))
+    a = _rand("a", rbs, rbs, 0.7, seed=34)
+    b = _rand("b", rbs, rbs, 0.7, seed=35)
+    c = create("c", rbs, rbs)
+    set_config(mm_dense=True)
+    try:
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+    finally:
+        set_config(mm_dense=None)
+    np.testing.assert_allclose(
+        to_dense(c), to_dense(a) @ to_dense(b), rtol=1e-12, atol=1e-12)
