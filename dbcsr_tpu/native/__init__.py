@@ -28,20 +28,30 @@ _SRCS = [
 
 
 def _isa_tag() -> str:
-    """CPU-capability tag baked into the .so filename: the build uses
-    -march=native, so a binary cached on a shared filesystem must never
-    be loaded by a rank on a CPU with different ISA extensions (SIGILL
-    is not catchable).  Different flags -> different file -> rebuild."""
+    """CPU-capability + SOURCE tag baked into the .so filename: the
+    build uses -march=native, so a binary cached on a shared filesystem
+    must never be loaded by a rank on a CPU with different ISA
+    extensions (SIGILL is not catchable), and a cached binary must
+    never shadow edited sources.  Different flags or sources ->
+    different file -> rebuild."""
+    import hashlib
+
+    h = hashlib.sha1()
     try:
         with open("/proc/cpuinfo") as fh:
             for line in fh:
                 if line.startswith(("flags", "Features")):
-                    import hashlib
-
-                    return hashlib.sha1(line.encode()).hexdigest()[:8]
+                    h.update(line.encode())
+                    break
     except OSError:
-        pass
-    return "generic"
+        h.update(b"generic")
+    for src in _SRCS:
+        try:
+            with open(src, "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            pass
+    return h.hexdigest()[:8]
 
 
 _SO = os.path.join(os.path.dirname(__file__),
