@@ -86,7 +86,7 @@ def test_env_override_respected(bench_mod, monkeypatch):
 def test_cpu_driver_pick_defaults_to_auto(bench_mod, monkeypatch):
     bench, path = bench_mod
     monkeypatch.delenv("DBCSR_TPU_BENCH_CPU_DRIVER", raising=False)
-    assert bench._pick_cpu_driver_from_evidence(3) == "auto"
+    assert bench._pick_cpu_driver_from_evidence(3) == ("auto", False)
 
 
 def test_cpu_driver_pick_follows_fallback_evidence(bench_mod, monkeypatch):
@@ -106,12 +106,12 @@ def test_cpu_driver_pick_follows_fallback_evidence(bench_mod, monkeypatch):
          "env": {"DBCSR_TPU_BENCH_DTYPE": "1"}},
     ]
     _write(path, rows, torn=True)
-    assert bench._pick_cpu_driver_from_evidence(3) == "auto"
+    assert bench._pick_cpu_driver_from_evidence(3) == ("auto", True)
     _write(path, rows + [{"value": 4.4, "device_fallback": True,
                           "mm_driver": "host", "env": {}}])
-    assert bench._pick_cpu_driver_from_evidence(3) == "host"
+    assert bench._pick_cpu_driver_from_evidence(3) == ("host", True)
     monkeypatch.setenv("DBCSR_TPU_BENCH_CPU_DRIVER", "host")
-    assert bench._pick_cpu_driver_from_evidence(3) == "host"
+    assert bench._pick_cpu_driver_from_evidence(3) == ("host", True)
 
 
 def test_dense_mode_pick_needs_both_sides(bench_mod, monkeypatch):
