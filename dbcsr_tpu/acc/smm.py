@@ -379,22 +379,26 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
     from dbcsr_tpu.acc import params as params_mod
 
     # native host stack driver (the reference's CPU path,
-    # dbcsr_mm_hostdrv.F:90 / tools/build_libsmm): explicit opt-in on
-    # CPU backends only — through the axon tunnel a host round-trip per
-    # stack would be catastrophic, so on TPU it demotes to auto
+    # dbcsr_mm_hostdrv.F:90 / tools/build_libsmm): explicit opt-in, or
+    # a tuned-table row, on CPU backends only — through the axon tunnel
+    # a host round-trip per stack would be catastrophic, so on TPU it
+    # demotes to auto
+    def _host_plan():
+        plan = StackPlan()
+        plan.nseg = c_data.shape[0]
+        plan.driver = "host"
+        plan.a_pad_row = a_pad_row
+        plan.b_pad_row = b_pad_row
+        plan.host_idx = (
+            np.ascontiguousarray(a_idx, np.int32),
+            np.ascontiguousarray(b_idx, np.int32),
+            np.ascontiguousarray(c_idx, np.int32),
+        )
+        return plan
+
     if cfg.mm_driver == "host":
         if _host_smm_available(c_data.dtype):
-            plan = StackPlan()
-            plan.nseg = c_data.shape[0]
-            plan.driver = "host"
-            plan.a_pad_row = a_pad_row
-            plan.b_pad_row = b_pad_row
-            plan.host_idx = (
-                np.ascontiguousarray(a_idx, np.int32),
-                np.ascontiguousarray(b_idx, np.int32),
-                np.ascontiguousarray(c_idx, np.int32),
-            )
-            return plan
+            return _host_plan()
         import warnings
 
         warnings.warn(
@@ -408,6 +412,12 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
         stack_size=S,
     )
     tuned_driver = tuned.get("driver") if tuned else None
+    if (cfg.mm_driver == "auto" and tuned_driver == "host"
+            and _host_smm_available(c_data.dtype)):
+        # the autotuner measured the native driver fastest for this
+        # shape on this (CPU) device kind — the reference's MM_DRIVER=
+        # smm per-shape dispatch (dbcsr_config.F:34-38)
+        return _host_plan()
     plan = StackPlan()
     plan.nseg = c_data.shape[0]
     # R-tiled grouped layout (see _process_stack_xla_group): the default

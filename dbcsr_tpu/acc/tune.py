@@ -143,6 +143,30 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
     candidates.append({"driver": "xla_flat", "grouping": None, "gflops": flops / t / 1e9})
     out(f"  xla_flat: {flops / t / 1e9:.1f} GFLOP/s")
 
+    # native host stack driver (CPU backends; the reference's tuned CPU
+    # SMM library is likewise a per-shape dispatch candidate,
+    # dbcsr_mm_hostdrv.F:90) — auto dispatch takes a tuned "host" row
+    # via prepare_stack when the native library is available
+    from dbcsr_tpu.acc.smm import _host_smm_available
+
+    if _host_smm_available(dtype):
+        from dbcsr_tpu import native
+
+        a_np = np.asarray(a)
+        b_np = np.asarray(b)
+
+        def run_host():
+            c_np = np.zeros((nc, m, n), dtype)
+            ok = native.host_smm(c_np, a_np, b_np, ai, bi, ci, 1.0)
+            assert ok
+            return jnp.asarray(c_np)
+
+        t = _time_config(run_host, nrep)
+        candidates.append(
+            {"driver": "host", "grouping": None, "gflops": flops / t / 1e9}
+        )
+        out(f"  host: {flops / t / 1e9:.1f} GFLOP/s")
+
     # R-tiled grouped layout (k-merged dots; see _process_stack_xla_group)
     from dbcsr_tpu.acc.smm import _process_stack_xla_group, build_group_tiles
 
@@ -296,6 +320,16 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
 
 
 def main(argv=None):
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # the axon sitecustomize force-sets jax_platforms="axon,cpu" at
+        # interpreter start, overriding the env var — honor an explicit
+        # CPU request (the CPU-device-kind tuning sweep) here, or the
+        # process hangs connecting to a wedged tunnel
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     argv = list(sys.argv[1:] if argv is None else argv)
     if len(argv) < 3:
         print(__doc__)
