@@ -97,7 +97,7 @@ def test_tune_smm_writes_entry(tmp_path, monkeypatch):
     params_mod._cache.clear()
     entry = tune_smm(4, 4, 4, dtype_enum=1, stack_size=200, nrep=1,
                      out=lambda *a: None)
-    assert entry["driver"] in ("pallas", "xla", "xla_flat", "xla_group")
+    assert entry["driver"] in ("pallas", "xla", "xla_flat", "xla_group", "host")
     params_mod._cache.clear()
     got = params_mod.lookup(4, 4, 4, np.float32)
     assert got is not None and got["gflops"] > 0
