@@ -58,11 +58,27 @@ def _params_dir() -> str:
     )
 
 
-@functools.lru_cache(maxsize=1)
-def device_kind() -> str:
+@functools.lru_cache(maxsize=4)
+def _device_kind_real() -> str:
     import jax
 
     return re.sub(r"\W+", "_", jax.devices()[0].device_kind).strip("_")
+
+
+def device_kind() -> str:
+    """Device kind keying the parameter table.  Under the CPU suite's
+    platform_override seam a PRETEND platform must not consume the real
+    device's tuned rows (a cpu-kind "host" row would steer pretend-TPU
+    dispatch to a driver the real TPU never uses), so overrides that
+    differ from the real platform get their own (normally empty) kind."""
+    import jax
+
+    from dbcsr_tpu.core.config import get_config
+
+    ov = get_config().platform_override
+    if ov and ov != jax.devices()[0].platform:
+        return f"pretend_{ov}"
+    return _device_kind_real()
 
 
 def params_path(kind: Optional[str] = None) -> str:
