@@ -1,0 +1,70 @@
+"""McWeeny density-matrix purification — the flagship workload.
+
+P_{n+1} = 3 P_n^2 - 2 P_n^3: the linear-scaling-DFT kernel DBCSR was
+built for (CP2K `dm_ls_scf`; ref `dbcsr_multiply` call chains in
+`src/mm/dbcsr_mm.F:336`).  Build a near-idempotent block-sparse P,
+purify with on-the-fly norm filtering, and watch tr(P) converge to the
+electron count while the sparsity pattern stays bounded; then run the
+same iteration through the mesh engine on a virtual device grid.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    if jax.default_backend() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import dbcsr_tpu as dt
+    from dbcsr_tpu.models import mcweeny_purify, mcweeny_step_sparse_distributed
+    from dbcsr_tpu.parallel import make_grid
+
+    dt.init_lib()
+    rng = np.random.default_rng(7)
+    sizes = [4] * 25  # 100x100, 4x4 blocks
+    nocc = 30
+
+    # near-idempotent start: P0 = V diag(f) V^T with occupations f
+    # pushed toward {0, 1} plus noise, re-sparsified by magnitude
+    q, _ = np.linalg.qr(rng.standard_normal((100, 100)))
+    f = np.clip(np.concatenate([
+        1.0 - 0.12 * rng.random(nocc), 0.12 * rng.random(100 - nocc)
+    ]), 0.0, 1.0)
+    dense_p = (q * f) @ q.T
+
+    p = dt.create("P", sizes, sizes)
+    for i in range(25):
+        for j in range(25):
+            blk = dense_p[4 * i:4 * i + 4, 4 * j:4 * j + 4]
+            if np.abs(blk).max() > 1e-6:
+                p.put_block(i, j, blk)
+    p.finalize()
+
+    print(f"P0: tr={dt.trace(p):.4f} (target {nocc}), {p.nblks} blocks")
+    p_out, hist = mcweeny_purify(p, steps=8, filter_eps=1e-9, tol=1e-10)
+    for it, tr in enumerate(hist, 1):
+        print(f"  step {it}: tr(P) = {tr:.8f}")
+    assert abs(hist[-1] - nocc) < 1e-6, "purification must converge to nocc"
+
+    # the same step through the sparse mesh engine (2x2x2 grid here;
+    # the real thing runs unchanged over a multi-host TPU mesh)
+    mesh = make_grid(8)
+    p_mesh = mcweeny_step_sparse_distributed(p, mesh, filter_eps=1e-9)
+    p_single = mcweeny_purify(p, steps=1, filter_eps=1e-9)[0]
+    err = np.abs(dt.to_dense(p_mesh) - dt.to_dense(p_single)).max()
+    print(f"mesh step vs single-chip: max|err| = {err:.2e} on {mesh.shape}")
+    assert err < 1e-10
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,7 @@ EXAMPLES = os.path.join(
     pytest.param("tensor_example_contract.py", marks=pytest.mark.slow),
     "example_4_tensor_api.py",
     pytest.param("example_5_any_grid.py", marks=pytest.mark.slow),
+    pytest.param("example_6_mcweeny.py", marks=pytest.mark.slow),
 ])
 def test_example_runs(name, capsys):
     runpy.run_path(os.path.join(EXAMPLES, name), run_name="__main__")
