@@ -636,15 +636,24 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
     return plan
 
 
-def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0):
-    """Device side: run a prepared plan against (possibly new) data."""
+def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
+                  c_zero: bool = False):
+    """Device side: run a prepared plan against (possibly new) data.
+
+    ``c_zero``: caller guarantees ``c_data`` is identically zero (the
+    engine's beta==0 rebuild, first touch per bin) — the host driver
+    then synthesizes its writable buffer as np.zeros instead of
+    fetching hundreds of MB of device zeros."""
     if plan is None:
         return c_data
     if plan.driver == "host":
         from dbcsr_tpu import native
 
         ai, bi, ci = plan.host_idx
-        c_np = np.array(c_data)  # writable host copy (CPU backend: memcpy)
+        if c_zero:
+            c_np = np.zeros(c_data.shape, np.dtype(c_data.dtype))
+        else:
+            c_np = np.array(c_data)  # writable host copy (memcpy)
         ok = native.host_smm(
             c_np, np.asarray(a_data), np.asarray(b_data), ai, bi, ci, alpha
         )

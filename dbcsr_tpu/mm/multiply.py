@@ -230,7 +230,8 @@ def multiply(
 
         with timed("multiply_stacks"):
             flops = _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha,
-                                plan_key=plan_key)
+                                plan_key=plan_key,
+                                c_zero=(beta == 0 and beta_window is None))
 
         if filter_eps is not None and not retain_sparsity:
             with timed("multiply_filter"):
@@ -1172,7 +1173,8 @@ def _plan_cache_insert(key, spans_meta) -> None:
         _plan_cache.popitem(last=False)
 
 
-def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None) -> int:
+def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
+                c_zero=False) -> int:
     """Group candidate triples by (m,n,k) shape-bin, sort by C block, run
     the SMM kernel per group; returns true flops."""
     if len(cand_keys) == 0:
@@ -1224,10 +1226,18 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None) -> int:
         if plan_key is not None:
             _plan_cache_insert(plan_key, spans_meta)
     flops = 0
+    # beta == 0 (no window): _rebuild_c left every bin as untouched
+    # jnp.zeros — the host driver can then synthesize its writable host
+    # buffer as np.zeros instead of fetching ~hundreds of MB of zeros
+    # off the device (first touch per bin only: later spans accumulate
+    # onto real contributions)
+    zero_bins = set(range(len(c.bins))) if c_zero else set()
     for cbin, abin, bbin, m, n, k, cnt, plan in spans_meta:
         c.bins[cbin].data = execute_stack(
-            c.bins[cbin].data, a.bins[abin].data, b.bins[bbin].data, plan, alpha
+            c.bins[cbin].data, a.bins[abin].data, b.bins[bbin].data, plan,
+            alpha, c_zero=cbin in zero_bins,
         )
+        zero_bins.discard(cbin)
         stats.record_stack(m, n, k, cnt, driver=plan.driver)
         flops += 2 * m * n * k * cnt
     return flops

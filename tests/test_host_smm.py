@@ -162,3 +162,38 @@ def test_host_driver_bf16_falls_back():
         assert plan.driver != "host"
     finally:
         set_config(mm_driver="auto")
+
+
+def test_host_engine_beta_zero_multi_span_per_bin():
+    """beta=0 zero-C fast path: a C bin hit by MULTIPLE stacks (mixed k
+    blockings -> several (m,n,k) spans onto one C shape bin) must use
+    the zeros shortcut only on the FIRST touch — later spans accumulate
+    real contributions (first-touch tracking in _run_stacks)."""
+    import numpy as np
+
+    import dbcsr_tpu as dt
+    from dbcsr_tpu.core.config import set_config
+
+    rng = np.random.default_rng(11)
+    rbs = [6] * 5
+    kbs = [4, 7, 4, 7, 4]  # two k shapes -> two spans per C bin
+    a = dt.make_random_matrix("A", rbs, kbs, dtype=np.float64,
+                              occupation=0.9, rng=rng)
+    b = dt.make_random_matrix("B", kbs, rbs, dtype=np.float64,
+                              occupation=0.9, rng=rng)
+    set_config(mm_driver="host")
+    try:
+        c = dt.create("C", rbs, rbs, dtype=np.float64)
+        dt.multiply("N", "N", 1.0, a, b, 0.0, c)
+        want = dt.to_dense(a) @ dt.to_dense(b)
+        np.testing.assert_allclose(dt.to_dense(c), want,
+                                   rtol=1e-12, atol=1e-12)
+        # beta != 0 keeps the fetch path: old values must survive
+        c2 = dt.make_random_matrix("C2", rbs, rbs, dtype=np.float64,
+                                   occupation=0.5, rng=rng)
+        old = dt.to_dense(c2)
+        dt.multiply("N", "N", 2.0, a, b, 0.5, c2)
+        np.testing.assert_allclose(dt.to_dense(c2), 2.0 * want + 0.5 * old,
+                                   rtol=1e-12, atol=1e-12)
+    finally:
+        set_config(mm_driver="auto")
