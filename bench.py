@@ -99,7 +99,7 @@ def _pick_carve_from_evidence() -> str:
     return "gather"
 
 
-def _pick_cpu_driver_from_evidence(dtype_enum: int) -> str:
+def _pick_cpu_driver_from_evidence(dtype_enum: int) -> tuple[str, bool]:
     """Choose the CPU-fallback mm_driver the same way the carve is
     chosen: from committed fallback measurements (BENCH_CAPTURES rows
     carrying an "mm_driver" field), best value wins.  BENCH_r04 showed
@@ -107,7 +107,13 @@ def _pick_cpu_driver_from_evidence(dtype_enum: int) -> str:
     claim force-picked the host driver and regressed the judged number
     to 0.755x the round-2/3 auto runs (VERDICT r4 item 2).  Without
     evidence, default "auto" — the configuration behind every committed
-    >=3.6 GFLOP/s fallback artifact."""
+    >=3.6 GFLOP/s fallback artifact.
+
+    Returns ``(driver, have_evidence)``: the second element is True
+    when the pick is backed by an env override or a committed capture
+    row (the caller's cross-driver regression guard only re-measures
+    the alternate driver when it is False or the pick undercuts the
+    committed CPU history)."""
     env = os.environ.get("DBCSR_TPU_BENCH_CPU_DRIVER")
     if env:
         return env, True
@@ -182,6 +188,45 @@ def _pick_dense_mode_from_evidence(dtype_enum: int):
                 and best["dense"] > best["stack"])
 
 
+def _run_bench(cfg, fallback: bool, dtype_enum: int):
+    """Run the configured workload, returning ``(res, mm_driver)``:
+    the direct run on device (mm_driver None — auto dispatch decides
+    per stack), or the evidence-picked (and regression-guarded)
+    CPU-fallback driver selection."""
+    from dbcsr_tpu.core.config import set_config
+    from dbcsr_tpu.perf.driver import run_perf
+
+    if not fallback:
+        return run_perf(cfg, verbose=False), None
+    from dbcsr_tpu.acc.smm import _host_smm_available
+    from dbcsr_tpu.core.kinds import dtype_of as _dtype_of
+
+    mm_driver, have_evidence = _pick_cpu_driver_from_evidence(dtype_enum)
+    if mm_driver == "host" and not _host_smm_available(
+            _dtype_of(dtype_enum)):
+        mm_driver = "auto"
+    set_config(mm_driver=mm_driver)
+    res = run_perf(cfg, verbose=False)
+    # regression guard (VERDICT r4 item 2): with no committed
+    # fallback evidence, or a run undercutting the committed CPU
+    # history (picked driver losing / host contention), measure the
+    # alternate driver too and report the honest best of the two —
+    # best-of-nrep extended across drivers.  2.98 is the committed
+    # engine baseline; later runs short-circuit on the recorded
+    # evidence rows.
+    if (dtype_enum == 3
+            and (not have_evidence
+                 or res["gflops_best"] < CPU_BASELINE_GFLOPS * 1.05)
+            and "DBCSR_TPU_BENCH_CPU_DRIVER" not in os.environ):
+        alt = "host" if mm_driver != "host" else "auto"
+        if alt != "host" or _host_smm_available(_dtype_of(dtype_enum)):
+            set_config(mm_driver=alt)
+            res_alt = run_perf(cfg, verbose=False)
+            if res_alt["gflops_best"] > res["gflops_best"]:
+                res, mm_driver = res_alt, alt
+    return res, mm_driver
+
+
 def main():
     probe_timeout = int(os.environ.get("DBCSR_TPU_BENCH_PROBE_TIMEOUT", "600"))
     carve = _pick_carve_from_evidence()
@@ -203,9 +248,8 @@ def main():
     if fallback:
         jax.config.update("jax_platforms", "cpu")
 
-    from dbcsr_tpu.core.config import set_config
     from dbcsr_tpu.core.lib import init_lib
-    from dbcsr_tpu.perf.driver import PerfConfig, run_perf
+    from dbcsr_tpu.perf.driver import PerfConfig
 
     init_lib()  # jax_enable_x64 — this is a double-precision library
 
@@ -219,36 +263,15 @@ def main():
         data_type=dtype_enum, beta=0.0, nrep=nrep,
         m_sizes=[(1, 23)], n_sizes=[(1, 23)], k_sizes=[(1, 23)],
     )
-    mm_driver = None
-    if not fallback:
-        res = run_perf(cfg, verbose=False)
-    else:
-        from dbcsr_tpu.acc.smm import _host_smm_available
-        from dbcsr_tpu.core.kinds import dtype_of as _dtype_of
+    try:
+        res, mm_driver = _run_bench(cfg, fallback, dtype_enum)
+    except Exception:
+        # black-box dump before dying: the obs flight recorder holds the
+        # last N multiplies (shapes, driver decisions, per-phase ms)
+        from dbcsr_tpu.obs import flight
 
-        mm_driver, have_evidence = _pick_cpu_driver_from_evidence(dtype_enum)
-        if mm_driver == "host" and not _host_smm_available(
-                _dtype_of(dtype_enum)):
-            mm_driver = "auto"
-        set_config(mm_driver=mm_driver)
-        res = run_perf(cfg, verbose=False)
-        # regression guard (VERDICT r4 item 2): with no committed
-        # fallback evidence, or a run undercutting the committed CPU
-        # history (picked driver losing / host contention), measure the
-        # alternate driver too and report the honest best of the two —
-        # best-of-nrep extended across drivers.  2.98 is the committed
-        # engine baseline; later runs short-circuit on the recorded
-        # evidence rows.
-        if (dtype_enum == 3
-                and (not have_evidence
-                     or res["gflops_best"] < CPU_BASELINE_GFLOPS * 1.05)
-                and "DBCSR_TPU_BENCH_CPU_DRIVER" not in os.environ):
-            alt = "host" if mm_driver != "host" else "auto"
-            if alt != "host" or _host_smm_available(_dtype_of(dtype_enum)):
-                set_config(mm_driver=alt)
-                res_alt = run_perf(cfg, verbose=False)
-                if res_alt["gflops_best"] > res["gflops_best"]:
-                    res, mm_driver = res_alt, alt
+        flight.dump()
+        raise
     if os.environ.get("DBCSR_TPU_BENCH_TIMINGS") == "1":
         # phase breakdown to stderr (with DBCSR_TPU_DENSE_PROFILE=1 the
         # dense path fences between phases so the buckets are honest
@@ -256,6 +279,17 @@ def main():
         from dbcsr_tpu.core import timings
 
         timings.report(out=lambda s: print(s, file=sys.stderr))
+    if os.environ.get("DBCSR_TPU_BENCH_METRICS") == "1":
+        # machine-readable observability dump (obs subsystem): the
+        # Prometheus metrics snapshot to stderr
+        from dbcsr_tpu.obs import metrics as obs_metrics
+
+        print(obs_metrics.prometheus_text(), file=sys.stderr)
+    if os.environ.get("DBCSR_TPU_BENCH_FLIGHT") == "1":
+        # on-demand flight-recorder dump (last N multiplies) to stderr
+        from dbcsr_tpu.obs import flight as obs_flight
+
+        obs_flight.dump()
     from dbcsr_tpu.core.kinds import dtype_of
 
     dname = {"float64": "dreal", "float32": "sreal"}.get(

@@ -43,6 +43,7 @@ from dbcsr_tpu.core.dist import (
 )
 from dbcsr_tpu.core.matrix import BlockIterator, BlockSparseMatrix, create
 from dbcsr_tpu.mm.multiply import multiply
+from dbcsr_tpu import obs
 from dbcsr_tpu.ops.operations import (
     FUNC_ARTANH,
     FUNC_ASIN,
@@ -188,6 +189,7 @@ __all__ = [
     "maxabs_norm",
     "multiply",
     "new_transposed",
+    "obs",
     "print_block_sum",
     "print_config",
     "print_matrix",

@@ -180,11 +180,14 @@ def predict(m: int, n: int, k: int, dtype,
     import numpy as np
 
     exact = lookup(m, n, k, dtype, stack_size)
-    if exact is not None and exact.get("env", "onchip") != "tunnel":
+    if exact is not None and exact.get("env") == "onchip":
         return exact
-    # exact row exists but is tunnel-latency-poisoned: fall through to
-    # the donor pool, where an onchip donor (any shape in range) mutes
-    # it; with no onchip donor the exact row wins at distance 0 anyway
+    # exact row exists but is not proven on-chip (tunnel-latency-bound,
+    # cpu-measured, or a legacy untagged row — ONE policy for missing
+    # env, matching _prefer_onchip's quarantine; ADVICE r5): fall
+    # through to the donor pool, where an onchip donor (any shape in
+    # range) mutes it; with no onchip donor the exact row wins through
+    # the exact-shape tie-break term below
     # keyed by the resolved params file so env-redirected tables (tests,
     # DBCSR_TPU_PARAMS_DIR) never serve stale predictions.  Exact S in
     # the key: the engine buckets stack lengths already, so distinct S
@@ -221,7 +224,12 @@ def predict(m: int, n: int, k: int, dtype,
             ds = -float(e.get("stack_size", 0))  # larger S preferred
         else:
             ds = abs(np.log(float(max(e.get("stack_size", 1), 1))) - want_s)
-        key = (d, ds)
+        # exact-shape term (ADVICE r5): permuted shapes share the m*n*k
+        # product, so d alone ties at 0 and table iteration order would
+        # pick a donor row (wrong tuned params, exactness-gated
+        # crosspack disabled) over the exact row.  Exact (m, n, k)
+        # outranks any same-distance donor.
+        key = (d, 0 if (e["m"], e["n"], e["k"]) == (m, n, k) else 1, ds)
         if best_d is None or key < best_d:
             best, best_d = e, key
     out = None

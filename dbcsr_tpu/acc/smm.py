@@ -34,6 +34,8 @@ import numpy as np
 
 from dbcsr_tpu.core.config import get_config
 from dbcsr_tpu.core.kinds import real_dtype_of
+from dbcsr_tpu.obs import flight as _flight
+from dbcsr_tpu.obs import metrics as _metrics
 from dbcsr_tpu.utils.rounding import bucket_size
 
 
@@ -362,6 +364,19 @@ class StackPlan:
         return total
 
 
+def _note_driver(driver: str, why: str, S: int, c_data, a_data, b_data,
+                 tuned=None) -> None:
+    """Feed the dispatch decision (and its reason) to the flight
+    recorder — `prepare_stack` is the only place the *why* is known."""
+    if tuned is not None and "predicted_from" in tuned:
+        why += f"+predicted_from={tuned['predicted_from']}"
+    _flight.note_driver(
+        driver, why,
+        mnk=(a_data.shape[1], b_data.shape[2], a_data.shape[2]),
+        entries=S,
+    )
+
+
 def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
                   a_pad_row=None, b_pad_row=None) -> Optional[StackPlan]:
     """Host side of stack processing: driver selection (tuned table +
@@ -398,6 +413,7 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
 
     if cfg.mm_driver == "host":
         if _host_smm_available(c_data.dtype):
+            _note_driver("host", "config-forced", S, c_data, a_data, b_data)
             return _host_plan()
         import warnings
 
@@ -417,6 +433,7 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
         # the autotuner measured the native driver fastest for this
         # shape on this (CPU) device kind — the reference's MM_DRIVER=
         # smm per-shape dispatch (dbcsr_config.F:34-38)
+        _note_driver("host", "tuned", S, c_data, a_data, b_data, tuned)
         return _host_plan()
     plan = StackPlan()
     plan.nseg = c_data.shape[0]
@@ -453,6 +470,13 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
         plan.a_pad_row = a_pad_row
         plan.b_pad_row = b_pad_row
         plan.group_idx = (jnp.asarray(ga), jnp.asarray(gb), jnp.asarray(gc))
+        _note_driver(
+            "xla_group",
+            "config-forced" if cfg.mm_driver == "xla_group"
+            else ("tuned" if tuned_driver == "xla_group"
+                  else "auto:emulated-f64-large-stack"),
+            S, c_data, a_data, b_data, tuned,
+        )
         return plan
     if _pallas_supported(cfg, c_data, a_data, b_data):
         prefer_xla = (
@@ -581,6 +605,13 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
                             np.asarray(b_idx[:s], np.int32),
                             np.asarray(c_idx[:s], np.int32),
                         )
+                    _note_driver(
+                        "pallas_cross",
+                        "config-forced" if cfg.mm_driver == "pallas_cross"
+                        else ("tuned" if tuned_cross
+                              else "auto:untuned-f32-on-tpu"),
+                        S, c_data, a_data, b_data, tuned,
+                    )
                     return plan
             ai2, bi2, ci2, r_grp = pallas_smm.build_grouped_stack(
                 np.asarray(c_idx), np.asarray(a_idx), np.asarray(b_idx),
@@ -604,6 +635,13 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
                     np.asarray(b_idx[:s], np.int32),
                     np.asarray(c_idx[:s], np.int32),
                 )
+            _note_driver(
+                "pallas",
+                "config-forced" if cfg.mm_driver in ("pallas", "pallas_cross")
+                else ("tuned" if tuned_driver == "pallas"
+                      else "auto:pallas-default"),
+                S, c_data, a_data, b_data, tuned,
+            )
             return plan
     elif cfg.mm_driver in ("pallas", "pallas_cross"):
         import warnings
@@ -633,7 +671,45 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
         jnp.asarray(bi.reshape(nchunks, chunk)),
         jnp.asarray(ci.reshape(nchunks, chunk)),
     )
+    if plan.driver == "xla_flat":
+        why = "config.flat_gather" if cfg.flat_gather else "tuned"
+    else:
+        why = ("tuned" if tuned_driver == "xla"
+               else ("config-forced" if cfg.mm_driver == "xla"
+                     else "auto:default"))
+    _note_driver(plan.driver, why, S, c_data, a_data, b_data, tuned)
     return plan
+
+
+def _record_stack_jit(plan: StackPlan, c_data, a_data, b_data) -> None:
+    """Mirror the XLA jit cache for the stack kernels (the reference's
+    per-(m,n,k) NVRTC kernel cache, `libsmm_acc.cpp:89-224`): each
+    launch reports the shape/dtype signature that keys the real cache,
+    so `obs.metrics` exposes compile-vs-hit counters per kernel — a
+    fresh (m,n,k,dtype,bucket) bin shows up as one compile."""
+    drv = plan.driver
+    dt = str(jnp.dtype(c_data.dtype))
+    if drv in ("xla", "xla_flat"):
+        key = (c_data.shape, a_data.shape, b_data.shape, dt,
+               plan.xla_idx[0].shape)
+        fn = ("_process_stack_xla_flat" if drv == "xla_flat"
+              else "_process_stack_xla")
+    elif drv == "xla_group":
+        key = (c_data.shape, a_data.shape, b_data.shape, dt,
+               plan.group_idx[0].shape)
+        fn = "_process_stack_xla_group"
+    elif drv == "pallas":
+        key = (c_data.shape, a_data.shape, b_data.shape, dt, plan.r_grp,
+               plan.kmerge, tuple(lc[0].shape for lc in plan.launches))
+        fn = "_pallas_process"
+    elif drv == "pallas_cross":
+        key = (c_data.shape, a_data.shape, b_data.shape, dt, plan.pack,
+               plan.cross_vmem,
+               tuple(lc["ai"].shape for lc in plan.cross_launches))
+        fn = "_pallas_crosspack"
+    else:  # host driver: no device compilation to account
+        return
+    _metrics.record_jit(f"acc.smm.{fn}", key)
 
 
 def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
@@ -646,6 +722,7 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
     fetching hundreds of MB of device zeros."""
     if plan is None:
         return c_data
+    _record_stack_jit(plan, c_data, a_data, b_data)
     if plan.driver == "host":
         from dbcsr_tpu import native
 
@@ -875,10 +952,20 @@ def _host_smm_available(dtype) -> bool:
     """True when the native C++ stack driver can run this stack: CPU
     backend (no device round-trip), a dtype the C++ kernel's switch
     handles (the reference enum codes r4/r8/c4/c8 — not bf16), and the
-    native library built."""
+    native library built.
+
+    Gates on the REAL backend platform as well as `effective_platform`
+    (ADVICE r5): the host driver changes where compute RUNS, not just
+    policy, so `platform_override='cpu'` on a real TPU must never route
+    stacks through a per-stack device->host->device tunnel round trip —
+    the behavior `prepare_stack`'s own comment calls catastrophic.
+    config.py's contract is that execution-level choices always follow
+    the real platform; the seam only steers decisions."""
     from dbcsr_tpu.core.config import effective_platform
 
     if effective_platform() != "cpu":
+        return False
+    if jax.devices()[0].platform != "cpu":
         return False
     from dbcsr_tpu.core import kinds
 

@@ -10,6 +10,10 @@ from __future__ import annotations
 import collections
 import dataclasses
 
+# stdlib-only module; record_* feed the span tracer when one is active
+# (one attribute check on the off path — see obs/tracer.py)
+from dbcsr_tpu.obs import tracer as _trace
+
 
 @dataclasses.dataclass
 class _MnkStat:
@@ -44,6 +48,11 @@ def record_stack(m: int, n: int, k: int, nentries: int, *,
     st.nentries += nentries
     st.flops += 2 * m * n * k * nentries
     st.by_driver[driver] = st.by_driver.get(driver, 0) + 2 * m * n * k * nentries
+    t = _trace._tracer
+    if t is not None:
+        t.instant("stack", {"mnk": f"{m}x{n}x{k}", "entries": nentries,
+                            "driver": driver})
+        t.add("stack_entries", nentries)
 
 
 def record_comm(kind: str, nmessages: int, nbytes: int) -> None:
@@ -59,6 +68,11 @@ def record_comm(kind: str, nmessages: int, nbytes: int) -> None:
     st = _comm[kind]
     st.nmessages += int(nmessages)
     st.nbytes += int(nbytes)
+    t = _trace._tracer
+    if t is not None:
+        t.instant(f"comm:{kind}", {"messages": int(nmessages),
+                                   "bytes": int(nbytes)})
+        t.add("comm_bytes", int(nbytes))
 
 
 def record_multiply(marketing_flops: int) -> None:

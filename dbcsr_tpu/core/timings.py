@@ -13,6 +13,11 @@ import contextlib
 import dataclasses
 import time
 
+# stdlib-only module; feeds every timed() region to the span tracer
+# when one is active (obs.tracer._tracer is None otherwise — a single
+# attribute check on the off path)
+from dbcsr_tpu.obs import tracer as _trace
+
 
 @dataclasses.dataclass
 class _RoutineStat:
@@ -42,6 +47,8 @@ def timeset(name: str) -> None:
         _hooks[0](name)
         return
     _stack.append([name, time.perf_counter(), 0.0])
+    if _trace._tracer is not None:
+        _trace._tracer.begin(name)
 
 
 def timestop(name: str) -> None:
@@ -51,6 +58,8 @@ def timestop(name: str) -> None:
     ent = _stack.pop()
     assert ent[0] == name, f"timer mismatch: stopped {name}, open {ent[0]}"
     dt = time.perf_counter() - ent[1]
+    if _trace._tracer is not None:
+        _trace._tracer.end(name, dur_s=dt)
     st = _stats.setdefault(name, _RoutineStat())
     st.calls += 1
     st.total += dt
@@ -63,6 +72,12 @@ def timestop(name: str) -> None:
         pst.callees[name] = (c + 1, t + dt)
 
 
+# resolved once on first use: timed() sits on every phase boundary and
+# the per-call import lookup is measurable at driver-loop frequency
+_TraceAnnotation = None
+_ta_resolved = False
+
+
 @contextlib.contextmanager
 def timed(name: str):
     """Timer + device-profiler range.
@@ -71,17 +86,25 @@ def timed(name: str):
     `jax.profiler.TraceAnnotation` so xprof/perfetto traces show the
     engine phases — the NVTX/ROCTX range analog
     (`src/acc/cuda/dbcsr_cuda_nvtx_cu.cpp`, `dbcsr_cuda_profiling.F`).
+    The host-side span goes to `obs.tracer` (via timeset/timestop) with
+    the same name, so the Chrome-trace export lines up with device
+    profiles.
     """
-    try:
-        from jax.profiler import TraceAnnotation
-    except ImportError:  # pragma: no cover - jax always present in practice
-        TraceAnnotation = None
+    global _TraceAnnotation, _ta_resolved
+    if not _ta_resolved:
+        try:
+            from jax.profiler import TraceAnnotation as _ta
+
+            _TraceAnnotation = _ta
+        except ImportError:  # pragma: no cover - jax always present
+            _TraceAnnotation = None
+        _ta_resolved = True
     timeset(name)
     try:
-        if TraceAnnotation is None:
+        if _TraceAnnotation is None:
             yield
         else:
-            with TraceAnnotation(f"dbcsr_tpu:{name}"):
+            with _TraceAnnotation(f"dbcsr_tpu:{name}"):
                 yield
     finally:
         timestop(name)
@@ -90,6 +113,9 @@ def timed(name: str):
 def reset() -> None:
     _stats.clear()
     _stack.clear()
+    if _trace._tracer is not None:
+        # keep the tracer's span stack in sync with the timer stack
+        _trace._tracer._span_stack.clear()
 
 
 def report(out=print, top: int = 30, aggregate: bool = False) -> None:

@@ -44,6 +44,9 @@ from dbcsr_tpu.core.matrix import (
     _bin_entries,
 )
 from dbcsr_tpu.core.timings import timed
+from dbcsr_tpu.obs import flight as _flight
+from dbcsr_tpu.obs import metrics as _metrics
+from dbcsr_tpu.obs import tracer as _trace
 from dbcsr_tpu.ops.operations import compress
 from dbcsr_tpu.ops.transformations import desymmetrize, new_transposed
 from dbcsr_tpu.utils.rounding import bucket_size
@@ -178,70 +181,107 @@ def multiply(
         no_limits = all(
             x is None for x in (first_row, last_row, first_col, last_col, first_k, last_k)
         )
-        if _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits,
-                              allow_chunked=True):
-            with timed("multiply_dense"):
-                c._mm_algorithm = "dense"
-                return _dense_multiply(a, b, c, alpha, beta)
-        c._mm_algorithm = "stack"
-
-        with timed("multiply_index"):
-            cand = _candidates(
-                a, b, c, filter_eps,
+        # flight record + span attributes for this product (obs layer):
+        # shapes/occupancy now, driver decisions and per-phase ms as the
+        # engine makes them, committed on return OR error
+        _flight.begin(
+            op="multiply", name=c.name,
+            mnk=(c.nfullrows, c.nfullcols, a.nfullcols),
+            occ_a=round(a.occupation(), 4), occ_b=round(b.occupation(), 4),
+            occ_c=round(c.occupation(), 4),
+            filter_eps=filter_eps, retain_sparsity=retain_sparsity,
+        )
+        _trace.annotate(
+            name=c.name, m=c.nfullrows, n=c.nfullcols, k=a.nfullcols,
+        )
+        try:
+            flops = _multiply_body(
+                a, b, c, alpha, beta, retain_sparsity, filter_eps,
                 first_row, last_row, first_col, last_col, first_k, last_k,
+                beta_window, no_limits,
             )
-            i, j, a_ent, b_ent = cand
-            # new C pattern
-            old_keys = c.keys
-            cand_keys = i * c.nblkcols + j
-            if retain_sparsity:
-                ok = mask_in_sorted(cand_keys, old_keys)
-                i, j, a_ent, b_ent = i[ok], j[ok], a_ent[ok], b_ent[ok]
-                cand_keys = cand_keys[ok]
-                new_keys = old_keys
-            else:
-                new_keys = np.union1d(old_keys, np.unique(cand_keys))
+        except Exception as exc:
+            _flight.commit(error=f"{type(exc).__name__}: {exc}")
+            raise
+        _flight.note("flops", flops)
+        _flight.note("algorithm", getattr(c, "_mm_algorithm", "?"))
+        _trace.annotate(algorithm=getattr(c, "_mm_algorithm", "?"))
+        _flight.commit()
+        return flops
 
-        # plan-cache key: patterns + product options fully determine the
-        # stack plan; filtered products depend on VALUES (norms), so
-        # they are not cached (ref: the reference rebuilds stacks every
-        # multiply — caching across same-pattern repeats beats it)
-        plan_key = None
-        if filter_eps is None:
-            from dbcsr_tpu.acc import params as params_mod
-            from dbcsr_tpu.core.config import get_config as _cfg
 
-            cfg_ = _cfg()
-            plan_key = (
-                a.pattern_fingerprint(), b.pattern_fingerprint(),
-                c.pattern_fingerprint(),
-                str(np.dtype(a.dtype)), str(np.dtype(b.dtype)),
-                str(np.dtype(c.dtype)),
-                c.matrix_type, retain_sparsity,
-                (first_row, last_row, first_col, last_col, first_k, last_k),
-                (cfg_.mm_driver, cfg_.use_pallas, cfg_.flat_gather,
-                 cfg_.mm_stack_size, cfg_.max_kernel_dim,
-                 cfg_.validate_kernels),
-                params_mod._table_gen,
-            )
+def _multiply_body(a, b, c, alpha, beta, retain_sparsity, filter_eps,
+                   first_row, last_row, first_col, last_col, first_k,
+                   last_k, beta_window, no_limits) -> int:
+    """The dense-vs-stack engine body of `multiply` (split out so the
+    flight recorder brackets every exit path exactly once)."""
+    if _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits,
+                          allow_chunked=True):
+        with timed("multiply_dense"):
+            c._mm_algorithm = "dense"
+            return _dense_multiply(a, b, c, alpha, beta)
+    c._mm_algorithm = "stack"
 
-        with timed("multiply_c_assemble"):
-            _rebuild_c(c, new_keys, beta, beta_window=beta_window)
+    with timed("multiply_index"):
+        cand = _candidates(
+            a, b, c, filter_eps,
+            first_row, last_row, first_col, last_col, first_k, last_k,
+        )
+        i, j, a_ent, b_ent = cand
+        # new C pattern
+        old_keys = c.keys
+        cand_keys = i * c.nblkcols + j
+        if retain_sparsity:
+            ok = mask_in_sorted(cand_keys, old_keys)
+            i, j, a_ent, b_ent = i[ok], j[ok], a_ent[ok], b_ent[ok]
+            cand_keys = cand_keys[ok]
+            new_keys = old_keys
+        else:
+            new_keys = np.union1d(old_keys, np.unique(cand_keys))
 
-        with timed("multiply_stacks"):
-            flops = _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha,
-                                plan_key=plan_key,
-                                c_zero=(beta == 0 and beta_window is None))
+    # plan-cache key: patterns + product options fully determine the
+    # stack plan; filtered products depend on VALUES (norms), so
+    # they are not cached (ref: the reference rebuilds stacks every
+    # multiply — caching across same-pattern repeats beats it)
+    plan_key = None
+    if filter_eps is None:
+        from dbcsr_tpu.acc import params as params_mod
+        from dbcsr_tpu.core.config import get_config as _cfg
 
-        if filter_eps is not None and not retain_sparsity:
-            with timed("multiply_filter"):
-                norms = c.block_norms()
-                compress(c, norms.astype(np.float64) ** 2 >= float(filter_eps) ** 2)
+        cfg_ = _cfg()
+        plan_key = (
+            a.pattern_fingerprint(), b.pattern_fingerprint(),
+            c.pattern_fingerprint(),
+            str(np.dtype(a.dtype)), str(np.dtype(b.dtype)),
+            str(np.dtype(c.dtype)),
+            c.matrix_type, retain_sparsity,
+            (first_row, last_row, first_col, last_col, first_k, last_k),
+            (cfg_.mm_driver, cfg_.use_pallas, cfg_.flat_gather,
+             cfg_.mm_stack_size, cfg_.max_kernel_dim,
+             cfg_.validate_kernels),
+            params_mod._table_gen,
+        )
 
-        mflops = 2 * c.nfullrows * c.nfullcols * a.nfullcols
-        stats.record_multiply(mflops)
-        stats.sample_memory()
-        return int(flops)
+    with timed("multiply_c_assemble"):
+        _rebuild_c(c, new_keys, beta, beta_window=beta_window)
+
+    with timed("multiply_stacks"):
+        flops = _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha,
+                            plan_key=plan_key,
+                            c_zero=(beta == 0 and beta_window is None))
+
+    if filter_eps is not None and not retain_sparsity:
+        with timed("multiply_filter"):
+            nblks_pre = c.nblks
+            norms = c.block_norms()
+            compress(c, norms.astype(np.float64) ** 2 >= float(filter_eps) ** 2)
+            _flight.note("filtered_blocks", nblks_pre - c.nblks)
+            _flight.note("kept_blocks", c.nblks)
+
+    mflops = 2 * c.nfullrows * c.nfullcols * a.nfullcols
+    stats.record_multiply(mflops)
+    stats.sample_memory()
+    return int(flops)
 
 
 def mask_in_sorted(cand_keys: np.ndarray, sorted_keys: np.ndarray) -> np.ndarray:
@@ -319,9 +359,11 @@ def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits,
     if c.matrix_type != NO_SYMMETRY:
         return False
     if cfg.mm_dense is True or cfg.mm_driver == "dense":
+        _flight.note("dense_why", "config-forced")
         return True
     th = cfg.dense_occ_threshold
     if a.occupation() >= th and b.occupation() >= th:
+        _flight.note("dense_why", f"occupancy>={th}")
         return True
     # emulated-dtype cost model (TPU only).  Guards beyond the flop
     # ratio: an explicitly forced stack driver wins, and the product's
@@ -360,7 +402,10 @@ def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits,
     if _candidate_fill(a, b) < 0.5:
         return False
     dense_flops = 2.0 * mm * nn * kk
-    return dense_flops < cfg.dense_flop_ratio * _true_product_flops(a, b)
+    wanted = dense_flops < cfg.dense_flop_ratio * _true_product_flops(a, b)
+    if wanted:
+        _flight.note("dense_why", "cost-model:emulated-dtype")
+    return wanted
 
 
 _fill_cache: "OrderedDict" = None  # created lazily; pattern-keyed
@@ -597,6 +642,11 @@ def _dense_multiply_general(a, b, c, alpha, beta) -> int:
     if profile:
         from dbcsr_tpu.utils.sync import fetch_fence as _ff
 
+    _metrics.record_jit(
+        "mm.multiply._dense_general_dot",
+        (a.nfullrows, b.nfullcols, a.nfullcols, str(np.dtype(c.dtype)),
+         _carve_choice()),
+    )
     with timed("dense_canvas_ab"):
         ad = _dense_canvas_cached(a, lambda: _to_dense_device(a))
         bd = _dense_canvas_cached(b, lambda: _to_dense_device(b))
@@ -745,6 +795,10 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
     if profile:
         from dbcsr_tpu.utils.sync import fetch_fence as _ff
 
+    _metrics.record_jit(
+        "mm.multiply._dense_product_to_blocks",
+        (nbr, nbc, nbk, bm, bn, bk, str(np.dtype(c.dtype)), _carve_choice()),
+    )
     with timed("dense_canvas_ab"):
         ad = _dense_canvas_cached(a, lambda: _build(a, nbr, nbk, bm, bk))
         bd = _dense_canvas_cached(b, lambda: _build(b, nbk, nbc, bk, bn))
@@ -1185,6 +1239,20 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
     if plan_key is not None and plan_key in _plan_cache:
         _plan_cache.move_to_end(plan_key)
         spans_meta = _plan_cache[plan_key]
+    _metrics.counter(
+        "dbcsr_tpu_plan_cache_total",
+        "stack-plan cache outcomes per multiply (uncacheable = "
+        "value-dependent filtered products)",
+    ).inc(result=("hit" if spans_meta is not None
+                  else "miss" if plan_key is not None else "uncacheable"))
+    if spans_meta is not None:
+        _flight.note("plan_cache", "hit")
+        # a cache hit skips prepare_stack (where decisions are noted);
+        # the flight record still names the drivers actually launched
+        for _cb, _ab, _bb, m, n, k, cnt, plan in spans_meta:
+            if plan is not None:
+                _flight.note_driver(plan.driver, "plan-cache-hit",
+                                    mnk=(m, n, k), entries=cnt)
     if spans_meta is None:
         c_ent = np.searchsorted(c.keys, cand_keys)
         cb = c.ent_bin[c_ent]

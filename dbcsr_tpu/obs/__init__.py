@@ -1,0 +1,60 @@
+"""dbcsr_tpu.obs — structured tracing, metrics and the flight recorder.
+
+The observability subsystem the reference spreads across
+`dbcsr_timings_report.F` (MPI-aggregated timer reports + cachegrind
+export), the STATISTICS block (`dbcsr_mm_sched.F:390-546`) and the
+NVTX/cachegrind hooks — rebuilt machine-readable:
+
+* `tracer` — span tracer recording every `core.timings.timed()` region
+  with structured attributes; JSONL streamed while running, Chrome
+  ``trace_event`` JSON (Perfetto-loadable) on flush.  Enable with
+  ``DBCSR_TPU_TRACE=<path>`` or `enable_trace(path)`.
+* `metrics` — counter/gauge/histogram registry layered over
+  `core.stats`: `metrics.snapshot()` → dict,
+  `metrics.prometheus_text()` → Prometheus exposition; includes
+  per-jitted-hot-function recompile/cache-hit counters.
+* `flight` — bounded ring of the last N multiplies (shapes, driver
+  decisions + why, per-phase ms, memory high-water), dumped on error
+  by `perf/driver.py` / `bench.py` or on demand via `flight.dump()`.
+
+Existing call sites need no churn: `core.timings.timed()` and
+`core.stats.record_*` feed the tracer automatically, and the multiply
+engine feeds the flight recorder.  With tracing disabled the only
+hot-path cost is one attribute check per event site.
+"""
+
+from dbcsr_tpu.obs import tracer
+from dbcsr_tpu.obs import flight
+from dbcsr_tpu.obs import metrics
+
+from dbcsr_tpu.obs.tracer import (  # noqa: F401
+    add as trace_add,
+    annotate,
+    instant,
+    write_chrome_trace,
+)
+
+
+def enable_trace(path: str | None = None) -> "tracer.Tracer":
+    """Start a trace session (see `tracer.enable`)."""
+    return tracer.enable(path)
+
+
+def disable_trace() -> None:
+    """End the trace session, flushing JSONL + Chrome trace."""
+    tracer.disable()
+
+
+def trace_enabled() -> bool:
+    return tracer.active()
+
+
+def get_tracer() -> "tracer.Tracer | None":
+    return tracer.get()
+
+
+__all__ = [
+    "tracer", "flight", "metrics",
+    "enable_trace", "disable_trace", "trace_enabled", "get_tracer",
+    "annotate", "trace_add", "instant", "write_chrome_trace",
+]

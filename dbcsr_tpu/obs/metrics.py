@@ -1,0 +1,289 @@
+"""Counter/gauge/histogram registry with snapshot + Prometheus export.
+
+Layered over `core/stats` (the reference's STATISTICS block,
+`dbcsr_mm_sched.F:390-546`): `snapshot()` folds the raw per-(m,n,k)
+flop counters, collective-traffic counters and memory meters into one
+machine-readable dict, alongside metrics this module owns directly —
+most importantly the **JIT-recompile counters**: every stack-kernel
+launch reports its specialization key via `record_jit()`, so each
+jitted hot function exposes how many distinct XLA compilations it
+triggered versus how often it reused one.  A stack-plan or jit-cache
+churn problem (new (m,n,k)/bucket shapes arriving every multiply) is
+invisible in wall time until it dominates; here it is a counter.
+
+Label model: each metric holds values keyed by a sorted
+``(label, value)`` tuple — enough for Prometheus text exposition
+without pulling in a client library (the container has none; the
+export format is the stable contract, see `prometheus_text()`).
+
+Module-level imports are stdlib-only (`core.stats` is imported lazily
+inside `snapshot`): `acc.smm` imports this module on its hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from dbcsr_tpu.obs import tracer as _trace
+
+_lock = threading.Lock()
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Counter:
+    """Monotone counter with optional labels."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.values: dict = {}
+
+    def inc(self, n: float = 1, **labels) -> None:
+        key = _label_key(labels)
+        self.values[key] = self.values.get(key, 0) + n
+
+    def value(self, **labels) -> float:
+        return self.values.get(_label_key(labels), 0)
+
+
+class Gauge:
+    """Point-in-time value with optional labels."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.values: dict = {}
+
+    def set(self, v: float, **labels) -> None:
+        self.values[_label_key(labels)] = v
+
+    def value(self, **labels) -> float:
+        return self.values.get(_label_key(labels), 0)
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative counts, Prometheus ``le``
+    convention) + running sum/count."""
+
+    DEFAULT_BUCKETS = (1e-4, 1e-3, 1e-2, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+    def __init__(self, name: str, help: str = "", buckets=None):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self.values: dict = {}  # label key -> [counts per bucket, +inf]
+        self.sums: dict = {}
+        self.counts: dict = {}
+
+    def observe(self, v: float, **labels) -> None:
+        key = _label_key(labels)
+        counts = self.values.setdefault(key, [0] * (len(self.buckets) + 1))
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                counts[i] += 1
+        counts[-1] += 1
+        self.sums[key] = self.sums.get(key, 0.0) + v
+        self.counts[key] = self.counts.get(key, 0) + 1
+
+
+_counters: dict = {}
+_gauges: dict = {}
+_histograms: dict = {}
+# per-fn specialization keys already seen (the jit-cache mirror)
+_jit_seen: dict = {}
+
+
+def counter(name: str, help: str = "") -> Counter:
+    with _lock:
+        c = _counters.get(name)
+        if c is None:
+            c = _counters[name] = Counter(name, help)
+        return c
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    with _lock:
+        g = _gauges.get(name)
+        if g is None:
+            g = _gauges[name] = Gauge(name, help)
+        return g
+
+
+def histogram(name: str, help: str = "", buckets=None) -> Histogram:
+    with _lock:
+        h = _histograms.get(name)
+        if h is None:
+            h = _histograms[name] = Histogram(name, help, buckets)
+        return h
+
+
+def record_jit(fn: str, key) -> bool:
+    """Report one launch of jitted function ``fn`` specialized by
+    ``key`` (shapes/dtype/static args — whatever keys its jit cache).
+    First sighting of a key counts as a compile, every later launch as
+    a cache hit.  Returns True when this launch compiled.
+
+    The mirror can only over-count compiles (e.g. after an external
+    `jax.clear_caches()` the real cache recompiles while the mirror
+    still records hits is the one way it under-counts; a process sees
+    that rarely enough that the counter stays a faithful churn signal).
+    """
+    seen = _jit_seen.setdefault(fn, set())
+    if key in seen:
+        counter("dbcsr_tpu_jit_cache_hits_total",
+                "stack-kernel launches served by an existing XLA "
+                "specialization").inc(fn=fn)
+        return False
+    seen.add(key)
+    counter("dbcsr_tpu_jit_compiles_total",
+            "distinct XLA specializations triggered per jitted hot "
+            "function").inc(fn=fn)
+    # compiles also land in the trace stream, so tools/trace_summary.py
+    # can rank recompile offenders from the JSONL alone
+    _trace.instant("jit_compile", {"fn": fn, "key": str(key)})
+    return True
+
+
+def jit_stats() -> dict:
+    """{fn: {"compiles": n, "cache_hits": n}} for every function that
+    reported through `record_jit`."""
+    comp = _counters.get("dbcsr_tpu_jit_compiles_total")
+    hits = _counters.get("dbcsr_tpu_jit_cache_hits_total")
+    out: dict = {}
+    for c, field in ((comp, "compiles"), (hits, "cache_hits")):
+        if c is None:
+            continue
+        for key, v in c.values.items():
+            fn = dict(key).get("fn", "?")
+            out.setdefault(fn, {"compiles": 0, "cache_hits": 0})[field] = v
+    return out
+
+
+def reset() -> None:
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _histograms.clear()
+        _jit_seen.clear()
+
+
+def _stats_snapshot() -> dict:
+    """Fold core.stats' registries into plain dicts (per-driver flops,
+    per-(m,n,k) stack counts, collective traffic, memory meters)."""
+    from dbcsr_tpu.core import stats
+
+    by_driver: dict = {}
+    by_mnk = {}
+    for (m, n, k), st in stats._by_mnk.items():
+        by_mnk[f"{m}x{n}x{k}"] = {
+            "stacks": st.nstacks,
+            "entries": st.nentries,
+            "flops": st.flops,
+            "by_driver": dict(st.by_driver),
+        }
+        for d, f in st.by_driver.items():
+            by_driver[d] = by_driver.get(d, 0) + f
+    comm = {
+        kind: {"messages": st.nmessages, "bytes": st.nbytes}
+        for kind, st in stats._comm.items()
+    }
+    return {
+        "flops_by_driver": by_driver,
+        "by_mnk": by_mnk,
+        "comm": comm,
+        "totals": dict(stats._totals),
+        "memory": stats.memory_high_water(),
+    }
+
+
+def snapshot() -> dict:
+    """One machine-readable dict of everything observable right now:
+    the core.stats layers + this registry's own metrics + the
+    jit-recompile mirror."""
+    def expand(metrics):
+        return {
+            name: {json.dumps(dict(k)): v for k, v in m.values.items()}
+            for name, m in metrics.items()
+        }
+
+    snap = _stats_snapshot()
+    snap["counters"] = expand(_counters)
+    snap["gauges"] = expand(_gauges)
+    snap["histograms"] = {
+        name: {
+            json.dumps(dict(k)): {
+                "buckets": dict(zip([str(b) for b in h.buckets] + ["+Inf"],
+                                    v)),
+                "sum": h.sums.get(k, 0.0),
+                "count": h.counts.get(k, 0),
+            }
+            for k, v in h.values.items()
+        }
+        for name, h in _histograms.items()
+    }
+    snap["jit"] = jit_stats()
+    return snap
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition (v0.0.4) of the full snapshot —
+    registry metrics plus the core.stats layers rendered as
+    ``dbcsr_tpu_*`` families."""
+    from dbcsr_tpu.core import stats
+
+    lines: list = []
+
+    def emit(name, kind, help, values):
+        lines.append(f"# HELP {name} {help}")
+        lines.append(f"# TYPE {name} {kind}")
+        for key, v in values:
+            lines.append(f"{name}{_fmt_labels(key)} {v}")
+
+    # core.stats layers
+    by_driver: dict = {}
+    for st in stats._by_mnk.values():
+        for d, f in st.by_driver.items():
+            by_driver[d] = by_driver.get(d, 0) + f
+    emit("dbcsr_tpu_flops_total", "counter",
+         "true flops per stack driver",
+         [((("driver", d),), f) for d, f in sorted(by_driver.items())])
+    emit("dbcsr_tpu_comm_bytes_total", "counter",
+         "collective traffic bytes per collective kind",
+         [((("kind", k),), st.nbytes) for k, st in sorted(stats._comm.items())])
+    emit("dbcsr_tpu_comm_messages_total", "counter",
+         "collective message counts per collective kind",
+         [((("kind", k),), st.nmessages)
+          for k, st in sorted(stats._comm.items())])
+    emit("dbcsr_tpu_multiplies_total", "counter",
+         "multiply() invocations",
+         [((), stats._totals["multiplies"])])
+    emit("dbcsr_tpu_memory_bytes", "gauge",
+         "host/device memory meters (peak and current)",
+         [((("meter", k),), v)
+          for k, v in sorted(stats.memory_high_water().items())])
+    # registry metrics
+    for name, c in sorted(_counters.items()):
+        emit(name, "counter", c.help or name, sorted(c.values.items()))
+    for name, g in sorted(_gauges.items()):
+        emit(name, "gauge", g.help or name, sorted(g.values.items()))
+    for name, h in sorted(_histograms.items()):
+        lines.append(f"# HELP {name} {h.help or name}")
+        lines.append(f"# TYPE {name} histogram")
+        for key, counts in sorted(h.values.items()):
+            for b, cnt in zip([str(b) for b in h.buckets] + ["+Inf"], counts):
+                lines.append(
+                    f"{name}_bucket{_fmt_labels(key + (('le', b),))} {cnt}")
+            lines.append(f"{name}_sum{_fmt_labels(key)} {h.sums.get(key, 0.0)}")
+            lines.append(f"{name}_count{_fmt_labels(key)} {h.counts.get(key, 0)}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,310 @@
+"""Low-overhead span tracer with JSONL + Chrome-trace export.
+
+The structured-observability analog of the reference's profiling hooks:
+where DBCSR offers cachegrind callgraph export
+(`dbcsr_timings_report.F:303`) and NVTX ranges
+(`dbcsr_cuda_profiling.F`), this tracer records every `timed()` region
+as a machine-readable span — name, start, duration, nesting depth,
+process index, plus structured attributes attached mid-span by the hot
+paths (mnk bin, driver decision, stack entries, comm bytes).
+
+Two export formats from one event stream:
+
+* **JSONL** — streamed to the trace path one event per line while the
+  run executes (crash-safe: whatever completed is on disk).
+* **Chrome ``trace_event`` JSON** — written on `flush()`/`disable()`
+  (and atexit) next to the JSONL as ``<path>.chrome.json``; loads in
+  Perfetto / ``chrome://tracing`` so host phases line up with device
+  profiles captured by `jax.profiler` (the `timed()` regions carry the
+  same names as their `TraceAnnotation` ranges).
+
+Activation: ``DBCSR_TPU_TRACE=<path>`` at import, or
+`dbcsr_tpu.obs.enable_trace(path)`.  When inactive, the only cost at
+every call site is one module-attribute ``is None`` check — the
+off-path no-op contract the <2% multiply-overhead budget requires.
+
+This module is deliberately stdlib-only: `core.timings` and
+`core.stats` import it at module level, so it must not pull in any
+dbcsr_tpu (or jax) module.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+
+# bound on the in-memory event list backing the Chrome export; the
+# JSONL stream is unbounded (it goes straight to disk)
+_MAX_EVENTS = 500_000
+
+# the active tracer, or None.  Hot paths check this single attribute.
+_tracer = None
+_lock = threading.Lock()
+
+
+def _json_default(o):
+    return str(o)
+
+
+class Tracer:
+    """One trace session: an open JSONL stream + the in-memory event
+    list the Chrome export is built from."""
+
+    def __init__(self, path: str, chrome_path: str | None = None,
+                 max_events: int = _MAX_EVENTS):
+        self.path = path
+        self.chrome_path = chrome_path or (path + ".chrome.json")
+        self.max_events = max_events
+        self.events: list = []
+        self.dropped = 0
+        self._t0 = time.perf_counter()
+        # span stack entries: [name, t_start_us, attrs_dict]
+        self._span_stack: list = []
+        # pid resolves lazily: at enable time (often import time, via
+        # DBCSR_TPU_TRACE) the backend may not be up yet, and resolving
+        # it must never force backend init — re-checked at flush()
+        pid = _process_index()
+        self._pid_final = pid is not None
+        self.process_index = pid or 0
+        self._fh = open(path, "a")
+        self._emit({
+            "ev": "meta",
+            "t0_unix": time.time(),
+            "pid": self.process_index,
+            "clock": "perf_counter_us_since_enable",
+        })
+
+    # -- clock ---------------------------------------------------------
+    def now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    # -- span lifecycle (driven by core.timings) -----------------------
+    def begin(self, name: str, t_us: float | None = None) -> None:
+        self._span_stack.append(
+            [name, self.now_us() if t_us is None else t_us, None]
+        )
+
+    def end(self, name: str, dur_s: float | None = None) -> None:
+        if not self._span_stack:
+            return
+        ent = self._span_stack.pop()
+        if ent[0] != name:
+            # a mismatched stop (host hooks, reset mid-span): resync by
+            # dropping silently rather than corrupting the trace
+            return
+        t_start = ent[1]
+        dur_us = (dur_s * 1e6) if dur_s is not None else self.now_us() - t_start
+        rec = {
+            "ev": "span",
+            "name": name,
+            "ts_us": round(t_start, 1),
+            "dur_us": round(dur_us, 1),
+            "depth": len(self._span_stack),
+            "pid": self.process_index,
+            "tid": threading.get_ident() % 10**6,
+        }
+        if ent[2]:
+            rec["attrs"] = ent[2]
+        self._emit(rec)
+
+    # -- attributes ----------------------------------------------------
+    def annotate(self, **attrs) -> None:
+        """Attach attributes to the innermost open span (no-op when no
+        span is open)."""
+        if not self._span_stack:
+            return
+        top = self._span_stack[-1]
+        if top[2] is None:
+            top[2] = {}
+        top[2].update(attrs)
+
+    def add(self, key: str, value) -> None:
+        """Accumulate a numeric attribute onto the innermost open span
+        (comm bytes, entry counts): repeated adds sum."""
+        if not self._span_stack:
+            return
+        top = self._span_stack[-1]
+        if top[2] is None:
+            top[2] = {}
+        top[2][key] = top[2].get(key, 0) + value
+
+    def instant(self, name: str, args: dict | None = None) -> None:
+        rec = {
+            "ev": "instant",
+            "name": name,
+            "ts_us": round(self.now_us(), 1),
+            "pid": self.process_index,
+            "tid": threading.get_ident() % 10**6,
+        }
+        if args:
+            rec["args"] = args
+        self._emit(rec)
+
+    # -- output --------------------------------------------------------
+    def _emit(self, rec: dict) -> None:
+        line = json.dumps(rec, default=_json_default)
+        self._fh.write(line + "\n")
+        if len(self.events) < self.max_events:
+            self.events.append(rec)
+        else:
+            self.dropped += 1
+
+    def flush(self) -> None:
+        """Flush the JSONL stream and (re)write the Chrome trace."""
+        if not self._pid_final:
+            pid = _process_index()
+            if pid is not None:
+                self._pid_final = True
+                if pid != self.process_index:
+                    self.process_index = pid  # events from here on
+                    self._emit({"ev": "meta", "pid": pid,
+                                "note": "process index resolved late"})
+        self._fh.flush()
+        write_chrome_trace(self.chrome_path, self.events,
+                           dropped=self.dropped)
+
+    def close(self) -> None:
+        if self.dropped:
+            self._emit({"ev": "meta", "dropped_events": self.dropped})
+        self.flush()
+        self._fh.close()
+
+
+def _process_index() -> int | None:
+    """jax process index when a backend is ALREADY initialized; None
+    otherwise.  Calling `jax.process_index()` would itself initialize
+    the backend — on a wedged axon tunnel that hangs the bare import,
+    and in multi-process runs it races `jax.distributed.initialize()` —
+    so only consult it once the backend registry is provably populated
+    (best-effort peek at xla_bridge's cache; falls back to None)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is None or not getattr(xb, "_backends", None):
+        return None  # no backend up yet: do NOT force one
+    try:
+        return int(jax.process_index())
+    except Exception:
+        return None
+
+
+def chrome_events(events: list) -> list:
+    """Map the native event records onto Chrome ``trace_event`` dicts
+    (the `X` complete-event / `i` instant-event subset Perfetto loads)."""
+    out = []
+    for rec in events:
+        ev = rec.get("ev")
+        if ev == "span":
+            ce = {
+                "name": rec["name"],
+                "cat": "dbcsr_tpu",
+                "ph": "X",
+                "ts": rec["ts_us"],
+                "dur": rec["dur_us"],
+                "pid": rec["pid"],
+                "tid": rec.get("tid", 0),
+            }
+            if rec.get("attrs"):
+                ce["args"] = rec["attrs"]
+            out.append(ce)
+        elif ev == "instant":
+            ce = {
+                "name": rec["name"],
+                "cat": "dbcsr_tpu",
+                "ph": "i",
+                "s": "t",
+                "ts": rec["ts_us"],
+                "pid": rec["pid"],
+                "tid": rec.get("tid", 0),
+            }
+            if rec.get("args"):
+                ce["args"] = rec["args"]
+            out.append(ce)
+    return out
+
+
+def write_chrome_trace(path: str, events: list, dropped: int = 0) -> None:
+    doc = {
+        "traceEvents": chrome_events(events),
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "dbcsr_tpu.obs.tracer",
+                      "dropped_events": dropped},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, default=_json_default)
+
+
+# -- module-level API (what timings/stats/hot paths call) --------------
+
+def enable(path: str | None = None) -> Tracer:
+    """Start tracing to ``path`` (default: $DBCSR_TPU_TRACE).  Replaces
+    any active tracer (the old one is closed)."""
+    global _tracer
+    path = path or os.environ.get("DBCSR_TPU_TRACE")
+    if not path:
+        raise ValueError(
+            "no trace path: pass one or set DBCSR_TPU_TRACE")
+    with _lock:
+        if _tracer is not None:
+            _tracer.close()
+        _tracer = Tracer(path)
+    return _tracer
+
+
+def disable() -> None:
+    """Stop tracing; flushes the JSONL stream and writes the Chrome
+    trace next to it."""
+    global _tracer
+    with _lock:
+        if _tracer is not None:
+            _tracer.close()
+            _tracer = None
+
+
+def active() -> bool:
+    return _tracer is not None
+
+
+def get() -> Tracer | None:
+    return _tracer
+
+
+def annotate(**attrs) -> None:
+    t = _tracer
+    if t is not None:
+        t.annotate(**attrs)
+
+
+def add(key: str, value) -> None:
+    t = _tracer
+    if t is not None:
+        t.add(key, value)
+
+
+def instant(name: str, args: dict | None = None) -> None:
+    t = _tracer
+    if t is not None:
+        t.instant(name, args)
+
+
+@atexit.register
+def _atexit_flush() -> None:  # pragma: no cover - process teardown
+    t = _tracer
+    if t is not None:
+        try:
+            t.close()
+        except Exception:
+            pass
+
+
+# env activation: DBCSR_TPU_TRACE set at import time starts the session
+# immediately, so `DBCSR_TPU_TRACE=t.jsonl python -m dbcsr_tpu.perf...`
+# needs no code changes anywhere
+if os.environ.get("DBCSR_TPU_TRACE"):
+    enable(os.environ["DBCSR_TPU_TRACE"])

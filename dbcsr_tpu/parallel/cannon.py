@@ -25,6 +25,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dbcsr_tpu.core import stats
+from dbcsr_tpu.core.timings import timed
+from dbcsr_tpu.obs import tracer as _trace
+
 
 def mark_varying(x, axes):
     """Mark an array device-varying over mesh axes (pcast with a
@@ -106,16 +110,35 @@ def cannon_multiply_dense(mesh: Mesh, a, b, acc_dtype=None):
         raise ValueError("inner dims differ")
     if m % s or n % s or k % (kl * s):
         raise ValueError(f"shapes {(m, k, n)} not divisible by grid {(kl, s, s)}")
-    a = jax.device_put(a, NamedSharding(mesh, P("pr", ("kl", "pc"))))
-    b = jax.device_put(b, NamedSharding(mesh, P(("kl", "pr"), "pc")))
-    fn = jax.jit(
-        jax.shard_map(
-            functools.partial(
-                _local_cannon, s=s, acc_dtype=acc_dtype or a.dtype
-            ),
-            mesh=mesh,
-            in_specs=(P("pr", ("kl", "pc")), P(("kl", "pr"), "pc")),
-            out_specs=P("pr", "pc"),
+    with timed("cannon_dense"):
+        _trace.annotate(m=m, n=n, k=k, kl=kl, s=s)
+        a = jax.device_put(a, NamedSharding(mesh, P("pr", ("kl", "pc"))))
+        b = jax.device_put(b, NamedSharding(mesh, P(("kl", "pr"), "pc")))
+        # collective-traffic accounting (host-side model of the static
+        # comm pattern; the mesh engine's upload/permute counters in
+        # sparse_dist follow the same convention): with s > 1 the skew
+        # plus s-1 metronome ticks move every A and B shard s times
+        # over 'pr'/'pc'; kl > 1 adds the 2.5D layer psum of C
+        ndev = kl * s * s
+        itemsize = jnp.dtype(a.dtype).itemsize
+        if s > 1:
+            stats.record_comm(
+                "ppermute", 2 * s * ndev,
+                s * (m * k + k * n) * itemsize,
+            )
+        if kl > 1:
+            # same convention as sparse_dist's ring-reduce model: each
+            # of the kl-1 steps moves every (pr,pc) position's C panel
+            stats.record_comm("psum", (kl - 1) * s * s,
+                              (kl - 1) * m * n * itemsize)
+        fn = jax.jit(
+            jax.shard_map(
+                functools.partial(
+                    _local_cannon, s=s, acc_dtype=acc_dtype or a.dtype
+                ),
+                mesh=mesh,
+                in_specs=(P("pr", ("kl", "pc")), P(("kl", "pr"), "pc")),
+                out_specs=P("pr", "pc"),
+            )
         )
-    )
-    return fn(a, b)
+        return fn(a, b)

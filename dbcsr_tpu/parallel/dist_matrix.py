@@ -25,7 +25,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dbcsr_tpu.core import stats
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
+from dbcsr_tpu.core.timings import timed
+from dbcsr_tpu.obs import tracer as _trace
 from dbcsr_tpu.parallel.cannon import cannon_multiply_dense
 from dbcsr_tpu.utils.rounding import ceil_div
 
@@ -93,6 +96,11 @@ def distribute(
     block-dense sharded array."""
     if not matrix.valid:
         raise RuntimeError("finalize() before distributing")
+    with timed("dist_distribute"):
+        return _distribute_impl(matrix, mesh, role, name)
+
+
+def _distribute_impl(matrix, mesh, role, name) -> DistMatrix:
     bm = int(matrix.row_blk_sizes.max()) if matrix.nblkrows else 1
     bn = int(matrix.col_blk_sizes.max()) if matrix.nblkcols else 1
     rq, cq = _pad_counts(mesh, role)
@@ -118,6 +126,11 @@ def distribute(
                     tb = tb.conj()
                 grid4[c_s[off], r_s[off], :bnb, :bmb] = tb
     host = grid4.transpose(0, 2, 1, 3).reshape(nbr_pad * bm, nbc_pad * bn)
+    # staging traffic: one host->device scatter of the padded canvas
+    # (ref count_mpi_statistics's message-size accounting)
+    stats.record_comm("host2dev", 1, host.nbytes)
+    _trace.annotate(role=role, nbytes=int(host.nbytes),
+                    shape=list(host.shape))
     data = jax.device_put(host, NamedSharding(mesh, _ROLE_SPECS[role]))
     return DistMatrix(
         data=data,

@@ -283,6 +283,12 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
         # mode; GFLOP/s above is always TRUE sparse-product flops / time)
         "algorithm": getattr(c_run, "_mm_algorithm", "mesh"),
     }
+    from dbcsr_tpu.obs import tracer as _obs_tracer
+
+    if _obs_tracer.active():
+        # a traced perf run leaves its JSONL *and* the Chrome trace on
+        # disk even if the process lives on (bench loops, pytest)
+        _obs_tracer.get().flush()
     if verbose:
         print(f" matrix sizes M/N/K          {cfg.m} {cfg.n} {cfg.k}")
         print(f" sparsities A/B/C            {cfg.sparsity_a} {cfg.sparsity_b} {cfg.sparsity_c}")
@@ -298,7 +304,15 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
         print(f" checksum(C_out)             {cs:.15e}")
         print(f" checksum(C_out) POS         {cs_pos:.15e}")
     if cfg.check:
-        _verify_checksums(cfg, cs, cs_pos, verbose)
+        try:
+            _verify_checksums(cfg, cs, cs_pos, verbose)
+        except PerfChecksumError:
+            # black-box dump: what was the engine doing for the last N
+            # multiplies when the checksum tripped (obs flight recorder)
+            from dbcsr_tpu.obs import flight
+
+            flight.dump()
+            raise
     return result
 
 

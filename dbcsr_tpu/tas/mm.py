@@ -18,6 +18,7 @@ from typing import Optional, Union
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
 from dbcsr_tpu.core.timings import timed
 from dbcsr_tpu.mm.multiply import multiply
+from dbcsr_tpu.obs import tracer as _trace
 from dbcsr_tpu.ops.operations import scale
 from dbcsr_tpu.tas.base import TASMatrix
 from dbcsr_tpu.tas.split import choose_nsplit, estimate_split_factor
@@ -83,6 +84,8 @@ def tas_multiply(
     with timed("tas_multiply"):
         dims = {"m": m_full, "n": n_full, "k": k_full}
         long_dim = max(dims, key=dims.get)
+        _trace.annotate(name=c.name, m=m_full, n=n_full, k=k_full,
+                        long_dim=long_dim)
 
         def _fresh_opt() -> int:
             import numpy as _np
@@ -152,6 +155,7 @@ def tas_multiply(
                         batch["nsplit"] = nsplit = opt
                         batch["resplit_count"] = batch.get("resplit_count", 0) + 1
 
+        _trace.annotate(nsplit=int(nsplit or 1))
         if mesh is not None:
             if batch is not None:
                 # batched pgrid re-optimization (ref the reference

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dbcsr_tpu.core.timings import timed
+from dbcsr_tpu.obs import tracer as _trace
 from dbcsr_tpu.ops.operations import scale
 from dbcsr_tpu.tas.mm import tas_multiply
 from dbcsr_tpu.tensor.types import BlockSparseTensor
@@ -250,6 +251,12 @@ def contract(
         filter_eps = None
 
     with timed("tensor_contract"):
+        _trace.annotate(
+            a=tensor_a.name, b=tensor_b.name, c=tensor_c.name,
+            contract_a=list(ca), contract_b=list(cb),
+            ndim_a=tensor_a.ndim, ndim_b=tensor_b.ndim,
+            bounded=bool(a_bounds or b_bounds),
+        )
         restricted_a = restrict_tensor(tensor_a, a_bounds)
         restricted_b = restrict_tensor(tensor_b, b_bounds)
         # remap operands into matrix-compatible layouts (ref :1183)

@@ -1,0 +1,323 @@
+"""Observability subsystem tests (`dbcsr_tpu.obs`): span tracer
+(JSONL + Chrome-trace export), metrics registry (snapshot / Prometheus
+text / JIT-recompile counters), flight recorder (ring bound,
+error-dump), and the `tools/trace_summary.py` smoke path.
+
+All runnable under JAX_PLATFORMS=cpu (conftest forces it)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import dbcsr_tpu as dt
+from dbcsr_tpu import obs
+from dbcsr_tpu.core import stats, timings
+from dbcsr_tpu.core.config import set_config
+from dbcsr_tpu.obs import flight, metrics
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import trace_summary  # noqa: E402
+
+
+@pytest.fixture
+def trace(tmp_path):
+    """An enabled trace session; always disabled afterwards."""
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable_trace(path)
+    yield path
+    obs.disable_trace()
+
+
+def setup_function(_):
+    timings.reset()
+    stats.reset()
+
+
+def _read_jsonl(path):
+    return [json.loads(ln) for ln in open(path) if ln.strip()]
+
+
+def _small_multiply(seed=0, occ=0.5, **kwargs):
+    rng = np.random.default_rng(seed)
+    rbs = [4] * 6
+    a = dt.make_random_matrix("A", rbs, rbs, occupation=occ, rng=rng)
+    b = dt.make_random_matrix("B", rbs, rbs, occupation=occ, rng=rng)
+    c = dt.create("C", rbs, rbs)
+    dt.multiply("N", "N", 1.0, a, b, 0.0, c, **kwargs)
+    return c
+
+
+# ---------------------------------------------------------------- tracer
+
+def test_span_nesting_and_attributes(trace):
+    with timings.timed("outer"):
+        obs.annotate(role="outer-attr", n=3)
+        with timings.timed("inner"):
+            obs.annotate(role="inner-attr")
+        obs.trace_add("bytes", 10)
+        obs.trace_add("bytes", 32)
+    obs.disable_trace()
+    spans = {r["name"]: r for r in _read_jsonl(trace) if r["ev"] == "span"}
+    assert spans["inner"]["depth"] == 1 and spans["outer"]["depth"] == 0
+    # inner completes first (JSONL order is completion order)
+    names = [r["name"] for r in _read_jsonl(trace) if r["ev"] == "span"]
+    assert names.index("inner") < names.index("outer")
+    assert spans["outer"]["attrs"] == {"role": "outer-attr", "n": 3,
+                                       "bytes": 42}
+    assert spans["inner"]["attrs"] == {"role": "inner-attr"}
+    # nesting containment in time
+    o, i = spans["outer"], spans["inner"]
+    assert o["ts_us"] <= i["ts_us"]
+    assert i["ts_us"] + i["dur_us"] <= o["ts_us"] + o["dur_us"] + 1.0
+
+
+def test_trace_off_is_noop(tmp_path):
+    """With no tracer, timed()/annotate cost one attribute check and
+    record nothing (the <2% off-path overhead contract)."""
+    assert not obs.trace_enabled()
+    with timings.timed("untraced"):
+        obs.annotate(ignored=1)
+        obs.instant("ignored")
+    assert timings._stats["untraced"].calls == 1  # timer still works
+
+
+def test_jsonl_and_chrome_trace_roundtrip(trace):
+    _small_multiply()
+    obs.disable_trace()
+    recs = _read_jsonl(trace)
+    assert recs[0]["ev"] == "meta"
+    spans = [r for r in recs if r["ev"] == "span"]
+    assert {"multiply", "multiply_stacks"} <= {s["name"] for s in spans}
+    # chrome trace: valid trace_event schema Perfetto accepts
+    doc = json.load(open(trace + ".chrome.json"))
+    evs = doc["traceEvents"]
+    assert evs, "empty chrome trace"
+    for e in evs:
+        assert e["ph"] in ("X", "i")
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["name"], str) and "pid" in e and "tid" in e
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        else:
+            assert e["s"] in ("t", "p", "g")
+    # the span attrs (mnk) made it into the chrome args
+    mult = [e for e in evs if e["name"] == "multiply" and e["ph"] == "X"]
+    assert mult and mult[0]["args"]["m"] == 24
+
+
+def test_stack_and_comm_instants_in_trace(trace):
+    stats.record_stack(4, 4, 4, 7, driver="xla")
+    stats.record_comm("ppermute", 2, 4096)
+    obs.disable_trace()
+    inst = {r["name"]: r for r in _read_jsonl(trace) if r["ev"] == "instant"}
+    assert inst["stack"]["args"] == {"mnk": "4x4x4", "entries": 7,
+                                     "driver": "xla"}
+    assert inst["comm:ppermute"]["args"] == {"messages": 2, "bytes": 4096}
+
+
+def test_perf_input_run_produces_valid_chrome_trace(trace):
+    """Acceptance: a tests/inputs/*.perf run under DBCSR_TPU_TRACE
+    yields a Perfetto-loadable trace and a metrics snapshot with
+    per-driver flops, comm bytes, and >= 1 recompile counter."""
+    from dbcsr_tpu.perf.driver import parse_perf_file, run_perf
+
+    metrics.reset()
+    cfg = parse_perf_file(os.path.join(
+        os.path.dirname(__file__), "inputs", "test_square_sparse.perf"))
+    cfg.nrep = 1
+    # force the XLA stack driver: the tuned CPU table routes these
+    # blocks to the native host driver, which has no XLA jit cache to
+    # count — the recompile-counter assertion needs a jitted driver
+    set_config(mm_driver="xla")
+    try:
+        run_perf(cfg, verbose=False, n_devices=1)
+    finally:
+        set_config(mm_driver="auto")
+    # run_perf flushes the tracer without needing disable/atexit
+    doc = json.load(open(trace + ".chrome.json"))
+    assert any(e["name"] == "multiply" for e in doc["traceEvents"])
+    assert all("ph" in e and "ts" in e for e in doc["traceEvents"])
+    snap = metrics.snapshot()
+    assert snap["flops_by_driver"], "no per-driver flops in snapshot"
+    assert "comm" in snap  # comm bytes dict (empty on single-chip)
+    assert sum(d["compiles"] for d in snap["jit"].values()) >= 1
+
+
+# --------------------------------------------------------------- metrics
+
+def test_metrics_counter_gauge_histogram():
+    metrics.reset()
+    metrics.counter("t_total", "help").inc(driver="xla")
+    metrics.counter("t_total").inc(3, driver="xla")
+    metrics.gauge("t_gauge").set(1.5, kind="x")
+    h = metrics.histogram("t_hist", buckets=(1.0, 10.0))
+    h.observe(0.5)
+    h.observe(5.0)
+    h.observe(50.0)
+    assert metrics.counter("t_total").value(driver="xla") == 4
+    assert metrics.gauge("t_gauge").value(kind="x") == 1.5
+    text = metrics.prometheus_text()
+    assert 't_total{driver="xla"} 4' in text
+    assert 't_gauge{kind="x"} 1.5' in text
+    assert 't_hist_bucket{le="1.0"} 1' in text
+    assert 't_hist_bucket{le="+Inf"} 3' in text
+    assert "t_hist_sum 55.5" in text and "t_hist_count 3" in text
+    # TYPE lines for scrapers
+    assert "# TYPE t_total counter" in text
+    assert "# TYPE t_hist histogram" in text
+
+
+def test_metrics_snapshot_layers_core_stats():
+    metrics.reset()
+    stats.record_stack(23, 23, 23, 100, driver="xla_group")
+    stats.record_stack(5, 5, 5, 10, driver="pallas")
+    stats.record_comm("psum", 4, 12345)
+    snap = metrics.snapshot()
+    assert snap["flops_by_driver"]["xla_group"] == 2 * 23**3 * 100
+    assert snap["flops_by_driver"]["pallas"] == 2 * 5**3 * 10
+    assert snap["by_mnk"]["23x23x23"]["entries"] == 100
+    assert snap["comm"]["psum"] == {"messages": 4, "bytes": 12345}
+    assert "memory" in snap and "totals" in snap
+    text = metrics.prometheus_text()
+    assert 'dbcsr_tpu_flops_total{driver="xla_group"}' in text
+    assert 'dbcsr_tpu_comm_bytes_total{kind="psum"} 12345' in text
+
+
+def test_recompile_counter_increments_on_fresh_mnk_bin():
+    """A fresh (m,n,k) bin = a new XLA specialization = one compile;
+    re-running the same shapes = cache hits only (stack-plan cache
+    misses in acc/smm become visible, ISSUE tentpole)."""
+    metrics.reset()
+    set_config(mm_driver="xla")
+    try:
+        _small_multiply(seed=1)
+        snap1 = metrics.jit_stats()["acc.smm._process_stack_xla"]
+        assert snap1["compiles"] >= 1
+        c0 = snap1["compiles"]
+        # same patterns again -> no new specialization, only hits
+        _small_multiply(seed=1)
+        snap2 = metrics.jit_stats()["acc.smm._process_stack_xla"]
+        assert snap2["compiles"] == c0
+        assert snap2["cache_hits"] >= 1
+        # a genuinely fresh block shape -> a new compile (occupancy low
+        # enough that the dense-mode occupancy gate cannot divert it)
+        rng = np.random.default_rng(2)
+        rbs = [7] * 4
+        a = dt.make_random_matrix("A", rbs, rbs, occupation=0.5, rng=rng)
+        b = dt.make_random_matrix("B", rbs, rbs, occupation=0.5, rng=rng)
+        c = dt.create("C", rbs, rbs)
+        dt.multiply("N", "N", 1.0, a, b, 0.0, c)
+        snap3 = metrics.jit_stats()["acc.smm._process_stack_xla"]
+        assert snap3["compiles"] > c0
+    finally:
+        set_config(mm_driver="auto")
+
+
+def test_plan_cache_counter():
+    metrics.reset()
+    _small_multiply(seed=3)
+    assert metrics.counter("dbcsr_tpu_plan_cache_total").values, (
+        "plan cache outcomes not counted")
+
+
+# ---------------------------------------------------------------- flight
+
+def test_flight_ring_is_bounded():
+    flight.clear()
+    cap = flight.ring_capacity()
+    for i in range(cap + 8):
+        flight.begin(op="multiply", name=f"M{i}", mnk=(4, 4, 4))
+        flight.commit()
+    recs = flight.records()
+    assert len(recs) == cap
+    # oldest dropped, newest kept, order preserved
+    assert recs[-1]["name"] == f"M{cap + 7}"
+    assert recs[0]["name"] == "M8"
+    flight.clear()
+
+
+def test_flight_records_real_multiply():
+    flight.clear()
+    _small_multiply(seed=4, filter_eps=1e-9)
+    recs = flight.records()
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["mnk"] == (24, 24, 24)
+    assert r["algorithm"] == "stack"
+    assert r["drivers"], "no driver decisions recorded"
+    for d in r["drivers"].values():
+        assert d["stacks"] >= 1 and d["why"]
+    assert r["filter_eps"] == 1e-9 and "kept_blocks" in r
+    assert r["dur_ms"] > 0 and "multiply_stacks" in r["phases_ms"]
+    assert r["memory"]["host_peak"] > 0
+    flight.clear()
+
+
+def test_flight_error_dump_path(tmp_path, monkeypatch):
+    """An engine error commits the in-flight record with the error
+    attached, and dump() writes the JSON artifact."""
+    from dbcsr_tpu.mm import multiply as mm_mod
+
+    flight.clear()
+
+    def boom(*a, **k):
+        raise RuntimeError("injected stack failure")
+
+    monkeypatch.setattr(mm_mod, "_run_stacks", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        _small_multiply(seed=5)
+    recs = flight.records()
+    assert recs and "injected stack failure" in recs[-1]["error"]
+    out_path = str(tmp_path / "flight.json")
+    lines = []
+    flight.dump(out=lines.append, path=out_path)
+    assert any("ERROR" in ln for ln in lines)
+    dumped = json.loads(open(out_path).read())
+    assert dumped[-1]["error"].endswith("injected stack failure")
+    flight.clear()
+
+
+def test_flight_nested_multiplies_each_get_a_record():
+    """TAS group loops nest multiply() calls; every one commits its own
+    record (reentrancy contract)."""
+    from dbcsr_tpu.tas.mm import tas_multiply
+
+    flight.clear()
+    rng = np.random.default_rng(6)
+    rbs = [4] * 12
+    kbs = [4] * 3
+    a = dt.make_random_matrix("A", rbs, kbs, occupation=0.6, rng=rng)
+    b = dt.make_random_matrix("B", kbs, kbs, occupation=0.8, rng=rng)
+    c = dt.create("C", rbs, kbs)
+    tas_multiply("N", "N", 1.0, a, b, 0.0, c, nsplit=3)
+    assert len(flight.records()) == 3  # one per group
+    flight.clear()
+
+
+# ---------------------------------------------------- trace_summary tool
+
+def test_trace_summary_smoke(trace, capsys):
+    set_config(mm_driver="xla")
+    try:
+        metrics.reset()
+        _small_multiply(seed=7)
+    finally:
+        set_config(mm_driver="auto")
+    obs.disable_trace()
+    rc = trace_summary.main([trace])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "multiply_stacks" in out and "PHASE" in out
+    assert "RECOMPILE OFFENDERS" in out
+    assert "acc.smm._process_stack_xla" in out
+    # machine-readable mode
+    rc = trace_summary.main([trace, "--json"])
+    assert rc == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["phases"]["multiply"]["calls"] == 1
+    assert s["jit_compiles"].get("acc.smm._process_stack_xla", 0) >= 1
+    assert s["bad_lines"] == 0

@@ -75,6 +75,51 @@ def test_predict_falls_back_to_tunnel_donors(table):
     assert got is not None and got["env"] == "tunnel"
 
 
+def test_predict_exact_shape_beats_permuted_donor(table):
+    """ADVICE r5 (medium): permuted shapes share the m*n*k product, so
+    the donor distance ties at 0 — the exact (m, n, k) row must win the
+    tie, not whichever row table iteration order visits first.  Uses
+    the committed (5,13,23)/(23,13,5) pair: both tunnel-tagged, sorted
+    by (m,n,k), with DIFFERENT tuned r0 (8 vs 16)."""
+    donor = {"m": 5, "n": 13, "k": 23, "dtype": "float64",
+             "stack_size": 30000, "driver": "xla_group", "grouping": None,
+             "r0": 8, "env": "tunnel", "gflops": 1.25}
+    exact = {"m": 23, "n": 13, "k": 5, "dtype": "float64",
+             "stack_size": 30000, "driver": "xla_group", "grouping": None,
+             "r0": 16, "env": "tunnel", "gflops": 1.38}
+    _write(table, [donor, exact])  # donor first = the losing iteration order
+    got = params_mod.predict(23, 13, 5, np.float64, stack_size=30000)
+    assert (got["m"], got["n"], got["k"]) == (23, 13, 5)
+    assert got["r0"] == 16
+    # exact evidence, not a donor prediction: the tag gates
+    # exactness-only features (bf16 crosspack / pack acceptance)
+    assert "predicted_from" not in got
+    # and the permuted shape still predicts from its own exact row
+    got2 = params_mod.predict(5, 13, 23, np.float64, stack_size=30000)
+    assert (got2["m"], got2["n"], got2["k"]) == (5, 13, 23)
+    assert got2["r0"] == 8 and "predicted_from" not in got2
+
+
+def test_predict_untagged_exact_row_muted_by_onchip_donor(table):
+    """ADVICE r5 (low): ONE policy for legacy untagged rows — the early
+    return must not trust them when _prefer_onchip would quarantine
+    them in the donor pool.  An untagged exact row loses to a nearby
+    onchip donor; with no onchip evidence it still wins at distance 0."""
+    untagged = {"m": 23, "n": 23, "k": 23, "dtype": "float64",
+                "stack_size": 30000, "driver": "pallas", "grouping": 4,
+                "gflops": 0.1}  # no "env": pre-provenance table
+    onchip = dict(ROW_ONCHIP, m=32, n=32, k=32, gflops=8.03)
+    _write(table, [untagged, onchip])
+    got = params_mod.predict(23, 23, 23, np.float64, stack_size=30000)
+    assert got["env"] == "onchip"
+    assert got["predicted_from"] == (32, 32, 32)
+    # no onchip rows anywhere: the untagged exact row is the best
+    # available evidence and wins through the pool
+    _write(table, [untagged])
+    got = params_mod.predict(23, 23, 23, np.float64, stack_size=30000)
+    assert got["driver"] == "pallas" and "predicted_from" not in got
+
+
 def test_tuner_stamps_real_platform_env():
     from dbcsr_tpu.acc.tune import _measure_env
     from dbcsr_tpu.core.config import set_config

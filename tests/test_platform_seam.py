@@ -143,6 +143,28 @@ def test_host_driver_unavailable_on_fake_tpu(fake_tpu):
     assert not _host_smm_available(np.float64)
 
 
+def test_host_driver_requires_real_cpu_backend(monkeypatch):
+    """ADVICE r5: platform_override='cpu' on a REAL TPU must not make
+    the host driver eligible — it changes where compute RUNS (a
+    device->host->device round trip per stack through the tunnel), and
+    execution-level choices always follow the real platform."""
+    import jax
+
+    from dbcsr_tpu.acc.smm import _host_smm_available
+
+    class _FakeTpuDev:
+        platform = "tpu"
+
+    assert _host_smm_available(np.float64)  # real cpu backend: eligible
+    set_config(platform_override="cpu")
+    try:
+        monkeypatch.setattr(jax, "devices", lambda *a: [_FakeTpuDev()])
+        assert not _host_smm_available(np.float64)
+    finally:
+        monkeypatch.undo()
+        set_config(platform_override="")
+
+
 def _fill_pair(occ=0.5, nblk=20, bs=8):
     rng = np.random.default_rng(7)
     rbs = [bs] * nblk

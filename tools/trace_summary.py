@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Summarize a dbcsr_tpu trace JSONL (obs.tracer output).
+
+Reads the event stream a traced run left behind
+(``DBCSR_TPU_TRACE=<path>`` / `obs.enable_trace`) and prints:
+
+* **per-phase totals** — every span name with call count, total /
+  mean / max milliseconds, sorted by total (the table a bench capture
+  can embed next to its GFLOP/s line);
+* **top recompile offenders** — jitted hot functions ranked by how
+  many distinct XLA specializations they triggered during the run
+  (``jit_compile`` instants, emitted by `obs.metrics.record_jit`);
+* **stack and comm rollups** — stack entries per driver and bytes per
+  collective kind from the ``stack`` / ``comm:*`` instants.
+
+Usage:
+    python tools/trace_summary.py trace.jsonl [--json] [--top N]
+
+``--json`` emits one machine-readable JSON object instead of tables.
+No dbcsr_tpu import required: the JSONL schema is the contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def summarize(path: str) -> dict:
+    """Aggregate one trace JSONL into the summary dict."""
+    phases: dict = {}
+    compiles: dict = {}
+    stacks: dict = {}
+    comm: dict = {}
+    events = 0
+    bad_lines = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                bad_lines += 1  # torn tail line (killed mid-append)
+                continue
+            events += 1
+            ev = rec.get("ev")
+            if ev == "span":
+                p = phases.setdefault(
+                    rec["name"], {"calls": 0, "total_ms": 0.0, "max_ms": 0.0})
+                dur_ms = rec.get("dur_us", 0.0) / 1e3
+                p["calls"] += 1
+                p["total_ms"] += dur_ms
+                p["max_ms"] = max(p["max_ms"], dur_ms)
+            elif ev == "instant":
+                name = rec.get("name", "")
+                args = rec.get("args") or {}
+                if name == "jit_compile":
+                    fn = args.get("fn", "?")
+                    compiles[fn] = compiles.get(fn, 0) + 1
+                elif name == "stack":
+                    d = stacks.setdefault(
+                        args.get("driver", "?"), {"stacks": 0, "entries": 0})
+                    d["stacks"] += 1
+                    d["entries"] += args.get("entries", 0)
+                elif name.startswith("comm:"):
+                    kind = name[len("comm:"):]
+                    c = comm.setdefault(kind, {"messages": 0, "bytes": 0})
+                    c["messages"] += args.get("messages", 0)
+                    c["bytes"] += args.get("bytes", 0)
+    for p in phases.values():
+        p["total_ms"] = round(p["total_ms"], 3)
+        p["max_ms"] = round(p["max_ms"], 3)
+        p["mean_ms"] = round(p["total_ms"] / max(p["calls"], 1), 3)
+    return {
+        "path": path,
+        "events": events,
+        "bad_lines": bad_lines,
+        "phases": phases,
+        "jit_compiles": compiles,
+        "stacks_by_driver": stacks,
+        "comm": comm,
+    }
+
+
+def print_summary(s: dict, out=print, top: int = 20) -> None:
+    out(f" trace: {s['path']}  ({s['events']} events"
+        + (f", {s['bad_lines']} unparseable lines" if s["bad_lines"] else "")
+        + ")")
+    out(" " + "-" * 72)
+    out(f" {'PHASE':<32} {'CALLS':>7} {'TOTAL ms':>11} {'MEAN ms':>9} "
+        f"{'MAX ms':>9}")
+    rows = sorted(s["phases"].items(), key=lambda kv: -kv[1]["total_ms"])
+    for name, p in rows[:top]:
+        out(f" {name:<32} {p['calls']:>7} {p['total_ms']:>11.3f} "
+            f"{p['mean_ms']:>9.3f} {p['max_ms']:>9.3f}")
+    if s["jit_compiles"]:
+        out(" " + "-" * 72)
+        out(f" {'RECOMPILE OFFENDERS':<48} {'COMPILES':>9}")
+        for fn, n in sorted(s["jit_compiles"].items(),
+                            key=lambda kv: -kv[1])[:top]:
+            out(f" {fn:<48} {n:>9}")
+    if s["stacks_by_driver"]:
+        out(" " + "-" * 72)
+        out(f" {'STACK DRIVER':<24} {'STACKS':>9} {'ENTRIES':>12}")
+        for d, v in sorted(s["stacks_by_driver"].items()):
+            out(f" {d:<24} {v['stacks']:>9} {v['entries']:>12}")
+    if s["comm"]:
+        out(" " + "-" * 72)
+        out(f" {'COLLECTIVE':<24} {'MESSAGES':>9} {'MB':>12}")
+        for k, v in sorted(s["comm"].items()):
+            out(f" {k:<24} {v['messages']:>9} {v['bytes'] / 1e6:>12.2f}")
+    out(" " + "-" * 72)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize a dbcsr_tpu obs trace JSONL")
+    ap.add_argument("path", help="trace JSONL written by obs.tracer")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object instead of tables")
+    ap.add_argument("--top", type=int, default=20,
+                    help="rows per table (default 20)")
+    args = ap.parse_args(argv)
+    try:
+        s = summarize(args.path)
+    except OSError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(s))
+    else:
+        print_summary(s, top=args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
