@@ -297,6 +297,14 @@ def main():
         str(__import__("numpy").dtype(dtype_of(dtype_enum))),
     )
     ratio = round(res["gflops_best"] / CPU_BASELINE_GFLOPS, 3)
+    # cost-model-normalized efficiency block (run_perf's roofline
+    # attribution, obs/costmodel.py): modeled GFLOP/s, HBM bytes per
+    # multiply, arithmetic intensity and fraction-of-roofline — what
+    # tools/perf_gate.py compares so gating tracks efficiency, not
+    # just raw wall clock on whatever device answered
+    modeled = res.get("modeled") or {}
+    from dbcsr_tpu import obs as _obs
+    from dbcsr_tpu.obs import costmodel as _costmodel
     out = {
         "metric": f"dbcsr_performance_multiply GFLOP/s (10k^2 BCSR, 23x23 blocks, occ=0.1, {dname})",
         "value": round(res["gflops_best"], 3),
@@ -333,6 +341,22 @@ def main():
         # runs, inflating GFLOP/s ~80x (the round-1 "101 GFLOP/s" and
         # early round-2 "103.7/147.9" numbers were that illusion)
         "sync": "forced-fetch",
+        # comparability stamps: perf_gate.py refuses to compare
+        # captures whose device_kind differs (apples-to-oranges guard)
+        "device_kind": _costmodel.device_kind(),
+        "jax_version": jax.__version__,
+        "obs_schema": _obs.OBS_SCHEMA_VERSION,
+        "modeled": {
+            "gflops_modeled": round(modeled.get("achieved_gflops", 0.0), 3),
+            "bytes_moved": int(modeled.get("bytes_moved", 0)),
+            "arithmetic_intensity": round(
+                modeled.get("arithmetic_intensity", 0.0), 4),
+            "roofline_fraction": round(
+                modeled.get("roofline_fraction", 0.0), 6),
+            "peak_gflops": modeled.get("peak_gflops"),
+            "attainable_gflops": round(
+                modeled.get("attainable_gflops", 0.0), 3),
+        } if modeled else None,
     }
     print(json.dumps(out))
 

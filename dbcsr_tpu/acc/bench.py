@@ -86,14 +86,19 @@ def bench_smm(nrep=5, stack_size=30000, m=23, n=23, k=23, dtype_enum=3,
     best = min(times)
     flops = 2.0 * m * n * k * stack_size
     # HBM traffic model: gather A+B per entry, C blocks r/w once each
-    bytes_moved = np.dtype(dtype).itemsize * (
-        stack_size * (m * k + k * n) + 2 * nc * m * n
+    # (the shared obs/costmodel convention, so kernel GB/s lines and
+    # the engine's roofline rollups are directly comparable)
+    from dbcsr_tpu.obs import costmodel
+
+    bytes_moved = costmodel.stack_bytes(
+        m, n, k, stack_size, nseg=nc, itemsize=np.dtype(dtype).itemsize
     )
     result = {
         "kernel": f"{m}x{n}x{k}",
         "dtype": np.dtype(dtype).name,
         "stack_size": stack_size,
         "device": str(jax.devices()[0]),
+        "device_kind": str(jax.devices()[0].device_kind),
         "gflops": flops / best / 1e9,
         "gbs": bytes_moved / best / 1e9,
         "ms": best * 1e3,

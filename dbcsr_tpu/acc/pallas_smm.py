@@ -760,6 +760,23 @@ def lane_scatter_index(lane_c):
     return np.concatenate(arrs) if arrs else np.empty(0, np.int32)
 
 
+def launch_entries(launches, r_grp: int) -> int:
+    """Device-work entry count of prepared launches, INCLUDING the
+    grouping and bucket padding slots: what the kernel actually
+    gathers and multiplies, as opposed to the stack's true entry
+    count.  The difference is the pad overhead the obs layer charges
+    to the pallas driver (`dbcsr_tpu_device_entries_total`), so a
+    shape whose run lengths group badly shows up as attribution, not
+    as mysteriously low achieved GFLOP/s."""
+    return sum(len(lc[2]) for lc in launches) * r_grp
+
+
+def crosspack_launch_entries(cross_launches) -> int:
+    """Device-work entry count of prepared crosspack launches (each
+    gathered A column is one packed entry slot, padding included)."""
+    return sum(int(lc["ai"].size) for lc in cross_launches)
+
+
 def prepare_launches(ai2, bi2, ci2, r_grp: int, a_pad_row: int, b_pad_row: int):
     """Chop a grouped stack into SMEM-sized launches.
 

@@ -34,6 +34,7 @@ import numpy as np
 
 from dbcsr_tpu.core.config import get_config
 from dbcsr_tpu.core.kinds import real_dtype_of
+from dbcsr_tpu.obs import costmodel as _costmodel
 from dbcsr_tpu.obs import flight as _flight
 from dbcsr_tpu.obs import metrics as _metrics
 from dbcsr_tpu.utils.rounding import bucket_size
@@ -681,12 +682,16 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
     return plan
 
 
-def _record_stack_jit(plan: StackPlan, c_data, a_data, b_data) -> None:
+def _record_stack_jit(plan: StackPlan, c_data, a_data, b_data):
     """Mirror the XLA jit cache for the stack kernels (the reference's
     per-(m,n,k) NVRTC kernel cache, `libsmm_acc.cpp:89-224`): each
     launch reports the shape/dtype signature that keys the real cache,
     so `obs.metrics` exposes compile-vs-hit counters per kernel — a
-    fresh (m,n,k,dtype,bucket) bin shows up as one compile."""
+    fresh (m,n,k,dtype,bucket) bin shows up as one compile.
+
+    Returns ``(compiled, fn_name, key)`` — compiled is True on the
+    first sighting of this specialization, which is when the XLA-cost
+    cross-check (`obs.costmodel.capture_xla_cost`, opt-in) fires."""
     drv = plan.driver
     dt = str(jnp.dtype(c_data.dtype))
     if drv in ("xla", "xla_flat"):
@@ -694,22 +699,58 @@ def _record_stack_jit(plan: StackPlan, c_data, a_data, b_data) -> None:
                plan.xla_idx[0].shape)
         fn = ("_process_stack_xla_flat" if drv == "xla_flat"
               else "_process_stack_xla")
+        dev_entries = int(plan.xla_idx[0].size)
     elif drv == "xla_group":
         key = (c_data.shape, a_data.shape, b_data.shape, dt,
                plan.group_idx[0].shape)
         fn = "_process_stack_xla_group"
+        dev_entries = int(plan.group_idx[0].size)
     elif drv == "pallas":
+        from dbcsr_tpu.acc import pallas_smm
+
         key = (c_data.shape, a_data.shape, b_data.shape, dt, plan.r_grp,
                plan.kmerge, tuple(lc[0].shape for lc in plan.launches))
         fn = "_pallas_process"
+        dev_entries = pallas_smm.launch_entries(plan.launches, plan.r_grp)
     elif drv == "pallas_cross":
+        from dbcsr_tpu.acc import pallas_smm
+
         key = (c_data.shape, a_data.shape, b_data.shape, dt, plan.pack,
                plan.cross_vmem,
                tuple(lc["ai"].shape for lc in plan.cross_launches))
         fn = "_pallas_crosspack"
+        dev_entries = pallas_smm.crosspack_launch_entries(
+            plan.cross_launches)
     else:  # host driver: no device compilation to account
-        return
-    _metrics.record_jit(f"acc.smm.{fn}", key)
+        return False, None, None
+    # device-work entries (incl. chunk/group/bucket padding) vs the
+    # true entries in core.stats.by_mnk: the pad-overhead attribution
+    # the roofline needs when achieved GFLOP/s (true flops) undershoots
+    # the device's busy rate
+    _metrics.counter(
+        "dbcsr_tpu_device_entries_total",
+        "stack entries actually launched per driver, padding included",
+    ).inc(dev_entries, driver=drv)
+    return _metrics.record_jit(f"acc.smm.{fn}", key), f"acc.smm.{fn}", key
+
+
+def _capture_stack_xla_cost(fn_name, key, jit_fn, args, c_data, a_data,
+                            b_data, entries: int) -> None:
+    """Opt-in XLA cost_analysis capture for a fresh stack-kernel
+    specialization, with the analytic model of the DEVICE work (padded
+    entries — XLA counts the masked pad rows too) stored alongside for
+    the drift check."""
+    from dbcsr_tpu.obs import costmodel
+
+    m, k = a_data.shape[1], a_data.shape[2]
+    n = b_data.shape[2]
+    model = {
+        "flops": costmodel.stack_flops(m, n, k, entries),
+        "bytes": costmodel.stack_bytes(
+            m, n, k, entries, nseg=c_data.shape[0],
+            itemsize=jnp.dtype(c_data.dtype).itemsize),
+    }
+    costmodel.capture_xla_cost(fn_name, key, jit_fn, args, model=model)
 
 
 def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
@@ -722,7 +763,9 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
     fetching hundreds of MB of device zeros."""
     if plan is None:
         return c_data
-    _record_stack_jit(plan, c_data, a_data, b_data)
+    compiled, jit_fn_name, jit_key = _record_stack_jit(
+        plan, c_data, a_data, b_data)
+    want_xla_cost = compiled and _costmodel.xla_capture_enabled()
     if plan.driver == "host":
         from dbcsr_tpu import native
 
@@ -769,6 +812,12 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
             )
         ga, gb, gc = plan.group_idx
         alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)
+        if want_xla_cost:
+            _capture_stack_xla_cost(
+                jit_fn_name, jit_key, _process_stack_xla_group,
+                (c_data, a_data, b_data, ga, gb, gc, alpha_dev),
+                c_data, a_data, b_data, int(ga.size),
+            )
         return _process_stack_xla_group(
             c_data, a_data, b_data, ga, gb, gc, alpha_dev
         )
@@ -914,9 +963,15 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
         return c_data
     alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)
     ai, bi, ci = plan.xla_idx
-    if plan.driver == "xla_flat":
-        return _process_stack_xla_flat(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
-    return _process_stack_xla(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
+    fn = (_process_stack_xla_flat if plan.driver == "xla_flat"
+          else _process_stack_xla)
+    if want_xla_cost:
+        _capture_stack_xla_cost(
+            jit_fn_name, jit_key, fn,
+            (c_data, a_data, b_data, ai, bi, ci, alpha_dev),
+            c_data, a_data, b_data, int(ai.size),
+        )
+    return fn(c_data, a_data, b_data, ai, bi, ci, alpha_dev)
 
 
 def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,

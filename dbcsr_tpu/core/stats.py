@@ -29,25 +29,88 @@ class _CommStat:
     nbytes: int = 0
 
 
+@dataclasses.dataclass
+class _DriverAgg:
+    """Per-driver attribution rollup: flops + modeled HBM bytes
+    (`obs.costmodel` convention) + host-side dispatch seconds, with a
+    per-dtype flop split so the roofline denominator can use the
+    dominant dtype's peak."""
+    flops: int = 0
+    nbytes: int = 0
+    seconds: float = 0.0
+    stacks: int = 0
+    by_dtype: dict = dataclasses.field(default_factory=dict)
+
+
 _by_mnk: dict = collections.defaultdict(_MnkStat)
 _comm: dict = collections.defaultdict(_CommStat)
+_driver_agg: dict = collections.defaultdict(_DriverAgg)
 _totals = {"multiplies": 0, "flops": 0, "marketing_flops": 0}
 
 
-def record_stack(m: int, n: int, k: int, nentries: int, *,
-                 driver: str) -> None:
-    """Per-(m,n,k) stack accounting with a DRIVER breakdown — the
-    reference's BLAS/SMM/ACC split (`dbcsr_mm_sched.F:390-546`) maps to
-    {xla, xla_flat, xla_group, pallas, dense, mesh} here."""
+def _agg_driver(driver: str, flops: int, nbytes: int, seconds: float,
+                dtype: str, stacks: int) -> None:
+    """The one place the per-driver rollup is updated (callers have
+    already passed the keep_stats gate)."""
+    agg = _driver_agg[driver]
+    agg.flops += flops
+    agg.nbytes += nbytes
+    agg.seconds += seconds
+    agg.stacks += stacks
+    if dtype:
+        agg.by_dtype[dtype] = agg.by_dtype.get(dtype, 0) + flops
+
+
+def record_driver(driver: str, flops: int, *, nbytes: int = 0,
+                  seconds: float = 0.0, dtype: str = "",
+                  stacks: int = 1) -> None:
+    """Attribute one executed region (a stack launch, a dense matmul,
+    a mesh plan execution) to its driver: flops, modeled bytes moved,
+    and host-observed seconds.  Seconds are DISPATCH-side wall time —
+    on async backends the device may still be draining, so per-driver
+    achieved GFLOP/s is an attribution signal, not a benchmark; the
+    forced-fetch bench numbers remain the ground truth."""
     from dbcsr_tpu.core.config import get_config
 
     if not get_config().keep_stats:
         return
+    _agg_driver(driver, flops, nbytes, seconds, dtype, stacks)
+
+
+def driver_rollup() -> dict:
+    """Plain-dict view of the per-driver attribution aggregates."""
+    return {
+        d: {
+            "flops": a.flops,
+            "bytes": a.nbytes,
+            "seconds": a.seconds,
+            "stacks": a.stacks,
+            "by_dtype": dict(a.by_dtype),
+        }
+        for d, a in _driver_agg.items()
+    }
+
+
+def record_stack(m: int, n: int, k: int, nentries: int, *,
+                 driver: str, seconds: float | None = None,
+                 nbytes: int | None = None, dtype: str = "") -> None:
+    """Per-(m,n,k) stack accounting with a DRIVER breakdown — the
+    reference's BLAS/SMM/ACC split (`dbcsr_mm_sched.F:390-546`) maps to
+    {xla, xla_flat, xla_group, pallas, dense, mesh} here.  ``seconds``
+    / ``nbytes`` / ``dtype`` additionally feed the per-driver roofline
+    rollup (`record_driver`); callers without a cost model pass none
+    and still appear in the flop breakdown."""
+    from dbcsr_tpu.core.config import get_config
+
+    if not get_config().keep_stats:
+        return
+    flops = 2 * m * n * k * nentries
     st = _by_mnk[(m, n, k)]
     st.nstacks += 1
     st.nentries += nentries
-    st.flops += 2 * m * n * k * nentries
-    st.by_driver[driver] = st.by_driver.get(driver, 0) + 2 * m * n * k * nentries
+    st.flops += flops
+    st.by_driver[driver] = st.by_driver.get(driver, 0) + flops
+    _agg_driver(driver, flops, nbytes or 0, seconds or 0.0, dtype, 1)
     t = _trace._tracer
     if t is not None:
         t.instant("stack", {"mnk": f"{m}x{n}x{k}", "entries": nentries,
@@ -151,6 +214,7 @@ def reset() -> None:
     global _hwm_at_reset
     _by_mnk.clear()
     _comm.clear()
+    _driver_agg.clear()
     for k in _totals:
         _totals[k] = 0
     for k in _memory:

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Optional
 
 import jax
@@ -44,6 +45,7 @@ from dbcsr_tpu.core.matrix import (
     _bin_entries,
 )
 from dbcsr_tpu.core.timings import timed
+from dbcsr_tpu.obs import costmodel as _costmodel
 from dbcsr_tpu.obs import flight as _flight
 from dbcsr_tpu.obs import metrics as _metrics
 from dbcsr_tpu.obs import tracer as _trace
@@ -642,6 +644,7 @@ def _dense_multiply_general(a, b, c, alpha, beta) -> int:
     if profile:
         from dbcsr_tpu.utils.sync import fetch_fence as _ff
 
+    t_start = time.perf_counter()
     _metrics.record_jit(
         "mm.multiply._dense_general_dot",
         (a.nfullrows, b.nfullcols, a.nfullcols, str(np.dtype(c.dtype)),
@@ -677,6 +680,13 @@ def _dense_multiply_general(a, b, c, alpha, beta) -> int:
     # marketing flops = the dense work performed; the RETURN value is the
     # true flops of the sparse product (comparable across algorithms,
     # ref marketing-vs-true `dbcsr_mm.F:664-667`)
+    dcost = _costmodel.dense_cost(
+        c.nfullrows, c.nfullcols, a.nfullcols,
+        itemsize=np.dtype(c.dtype).itemsize)
+    stats.record_driver(
+        "dense", dcost["flops"], nbytes=dcost["bytes"],
+        seconds=time.perf_counter() - t_start,
+        dtype=str(np.dtype(c.dtype)))
     stats.record_multiply(2 * c.nfullrows * c.nfullcols * a.nfullcols)
     return _true_product_flops(a, b)
 
@@ -795,9 +805,11 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
     if profile:
         from dbcsr_tpu.utils.sync import fetch_fence as _ff
 
-    _metrics.record_jit(
-        "mm.multiply._dense_product_to_blocks",
-        (nbr, nbc, nbk, bm, bn, bk, str(np.dtype(c.dtype)), _carve_choice()),
+    t_start = time.perf_counter()
+    dense_jit_key = (nbr, nbc, nbk, bm, bn, bk, str(np.dtype(c.dtype)),
+                     _carve_choice())
+    dense_compiled = _metrics.record_jit(
+        "mm.multiply._dense_product_to_blocks", dense_jit_key,
     )
     with timed("dense_canvas_ab"):
         ad = _dense_canvas_cached(a, lambda: _build(a, nbr, nbk, bm, bk))
@@ -837,6 +849,18 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
             )
             _ff(out)
     else:
+        if dense_compiled and _costmodel.xla_capture_enabled():
+            dcost = _costmodel.dense_cost(
+                nbr * bm, nbc * bn, nbk * bk,
+                itemsize=np.dtype(c.dtype).itemsize)
+            _costmodel.capture_xla_cost(
+                "mm.multiply._dense_product_to_blocks", dense_jit_key,
+                _dense_product_to_blocks,
+                (ad, bd, c_blocks, c_keys_dev, alpha_dev, beta_dev,
+                 nbr, nbc, bm, bn),
+                kwargs={"carve": _carve_choice()},
+                model={"flops": dcost["flops"], "bytes": dcost["bytes"]},
+            )
         out = _dense_product_to_blocks(
             ad, bd, c_blocks, c_keys_dev,
             alpha_dev, beta_dev, nbr, nbc, bm, bn,
@@ -851,7 +875,14 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
         c.set_structure_from_device(new_keys, [_Bin((bm, bn), out, len(new_keys))])
         if profile:
             _ff(c.bins[0].data)
-    stats.record_stack(bm, bn, bk, nbr * nbc * nbk, driver="dense")
+    stats.record_stack(
+        bm, bn, bk, nbr * nbc * nbk, driver="dense",
+        seconds=time.perf_counter() - t_start,
+        nbytes=_costmodel.dense_cost(
+            nbr * bm, nbc * bn, nbk * bk,
+            itemsize=np.dtype(c.dtype).itemsize)["bytes"],
+        dtype=str(np.dtype(c.dtype)),
+    )
     stats.record_multiply(2 * nbr * bm * nbc * bn * nbk * bk)
     return _true_product_flops(a, b)
 
@@ -902,6 +933,7 @@ def _dense_multiply_chunked(a, b, c, alpha, beta) -> int:
     canvas under `_DENSE_MAX_CANVAS` elements while the product stays
     on the dense MXU route (the reference's dense mode has no size cap,
     `dbcsr_mm.F:593-617`; this is its big-matrix realization)."""
+    t_start = time.perf_counter()
     bm = int(c.row_blk_sizes[0])
     bn = int(c.col_blk_sizes[0])
     bk = int(a.col_blk_sizes[0])
@@ -984,7 +1016,18 @@ def _dense_multiply_chunked(a, b, c, alpha, beta) -> int:
             [out, jnp.zeros((cap - len(new_keys), bm, bn), out.dtype)]
         )
     c.set_structure_from_device(new_keys, [_Bin((bm, bn), out, len(new_keys))])
-    stats.record_stack(bm, bn, bk, nbr * nbc * nbk, driver="dense")
+    # strip traffic model: A strips land once, every B strip is
+    # re-scattered per m-strip, C is written once
+    itemsize = np.dtype(c.dtype).itemsize
+    strip_bytes = itemsize * (
+        nbr * bm * nbk * bk + nms * nbk * bk * nbc * bn
+        + 2 * nbr * bm * nbc * bn
+    )
+    stats.record_stack(
+        bm, bn, bk, nbr * nbc * nbk, driver="dense",
+        seconds=time.perf_counter() - t_start, nbytes=strip_bytes,
+        dtype=str(np.dtype(c.dtype)),
+    )
     stats.record_multiply(2 * nbr * bm * nbc * bn * nbk * bk)
     return _true_product_flops(a, b)
 
@@ -1300,12 +1343,25 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
     # off the device (first touch per bin only: later spans accumulate
     # onto real contributions)
     zero_bins = set(range(len(c.bins))) if c_zero else set()
+    itemsize = np.dtype(c.dtype).itemsize
+    dt_name = str(np.dtype(c.dtype))
     for cbin, abin, bbin, m, n, k, cnt, plan in spans_meta:
+        t0 = time.perf_counter()
         c.bins[cbin].data = execute_stack(
             c.bins[cbin].data, a.bins[abin].data, b.bins[bbin].data, plan,
             alpha, c_zero=cbin in zero_bins,
         )
+        dt_s = time.perf_counter() - t0
         zero_bins.discard(cbin)
-        stats.record_stack(m, n, k, cnt, driver=plan.driver)
+        # seconds/bytes feed the per-driver roofline rollup; seconds
+        # are dispatch-side (the device may still be draining — see
+        # stats.record_driver)
+        stats.record_stack(
+            m, n, k, cnt, driver=plan.driver, seconds=dt_s,
+            nbytes=_costmodel.stack_bytes(
+                m, n, k, cnt, nseg=c.bins[cbin].data.shape[0],
+                itemsize=itemsize),
+            dtype=dt_name,
+        )
         flops += 2 * m * n * k * cnt
     return flops

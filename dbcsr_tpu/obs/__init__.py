@@ -25,14 +25,22 @@ hot-path cost is one attribute check per event site.
 
 from dbcsr_tpu.obs import tracer
 from dbcsr_tpu.obs import flight
+from dbcsr_tpu.obs import costmodel
 from dbcsr_tpu.obs import metrics
 
 from dbcsr_tpu.obs.tracer import (  # noqa: F401
     add as trace_add,
     annotate,
     instant,
+    shard_path,
     write_chrome_trace,
 )
+
+# version stamp for machine-readable obs artifacts (bench capture JSON,
+# trace shards, perf-gate reports): bump when the schema of any of
+# them changes incompatibly.  v2 = trace sharding + roofline/costmodel
+# fields (PR 2); v1 = the original obs subsystem (PR 1).
+OBS_SCHEMA_VERSION = 2
 
 
 def enable_trace(path: str | None = None) -> "tracer.Tracer":
@@ -54,7 +62,8 @@ def get_tracer() -> "tracer.Tracer | None":
 
 
 __all__ = [
-    "tracer", "flight", "metrics",
+    "tracer", "flight", "metrics", "costmodel",
     "enable_trace", "disable_trace", "trace_enabled", "get_tracer",
-    "annotate", "trace_add", "instant", "write_chrome_trace",
+    "annotate", "trace_add", "instant", "shard_path",
+    "write_chrome_trace", "OBS_SCHEMA_VERSION",
 ]

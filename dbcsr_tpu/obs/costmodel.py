@@ -1,0 +1,309 @@
+"""Analytic FLOP/byte cost model, roofline peaks, and XLA cross-check.
+
+The attribution layer the reference builds into its STATISTICS block
+(`dbcsr_mm_sched.F:390-546` true-vs-marketing flops) and that CP2K uses
+to say *how far from peak* a run is — rebuilt as three pieces:
+
+* **Analytic model** — `stack_flops`/`stack_bytes` model one parameter
+  stack (gather A+B per entry, C read+written once per segment — the
+  same HBM-traffic convention as `acc/bench.py`), `dense_cost` one
+  dense-canvas matmul.  `core.stats` aggregates these per driver, so
+  `obs.metrics.snapshot()` can report achieved GFLOP/s, arithmetic
+  intensity and roofline fraction per stack driver.
+* **Roofline peak table** — per-`device_kind` peak compute (per dtype)
+  and memory/interconnect bandwidth.  The built-ins are order-of-
+  magnitude engineering estimates, not vendor numbers; override with
+  ``DBCSR_TPU_ROOFLINE`` (a JSON dict merged over the table) or the
+  scalar ``DBCSR_TPU_PEAK_GFLOPS`` / ``DBCSR_TPU_PEAK_GBS`` /
+  ``DBCSR_TPU_ICI_GBS`` env knobs.  `roofline()` computes the
+  attainable rate ``min(peak, intensity * bw)`` and the achieved
+  fraction of it.
+* **XLA cross-check** — with ``DBCSR_TPU_XLA_COST=1`` (or
+  `enable_xla_capture()`), the first launch of each jitted stack-kernel
+  specialization additionally captures XLA's own
+  ``lowered.compile().cost_analysis()`` / ``memory_analysis()`` numbers
+  (one extra AOT compile per specialization — opt-in for exactly that
+  reason), stored next to the analytic model's prediction so drift
+  between the two is a queryable artifact (`xla_costs()`, and
+  `metrics.snapshot()["xla_cost"]`).
+
+Module-level imports are stdlib-only: `core.stats` imports this module
+on the multiply hot path, and must stay importable without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+# ---------------------------------------------------------------- model
+
+def stack_flops(m: int, n: int, k: int, entries: int) -> int:
+    """True flops of one parameter stack: 2*m*n*k per entry (the
+    reference's 'true flops', `dbcsr_mm.F:664-667`)."""
+    return 2 * m * n * k * entries
+
+
+def stack_bytes(m: int, n: int, k: int, entries: int, *,
+                nseg: int | None = None, itemsize: int = 8) -> int:
+    """Modeled HBM traffic of one stack: gather one A (m,k) and one B
+    (k,n) block per entry, read+write each C segment once.  A lower
+    bound — TPU tile padding and revisited gathers only add to it; the
+    same convention as the `acc/bench.py` GB/s line, so kernel
+    micro-bench and engine rollups are comparable."""
+    if nseg is None:
+        nseg = entries
+    return itemsize * (entries * (m * k + k * n) + 2 * nseg * m * n)
+
+
+def dense_cost(m: int, n: int, k: int, *, itemsize: int = 8) -> dict:
+    """FLOPs/bytes of one dense (m,k)x(k,n) canvas matmul: read A and
+    B once, write (and read, for beta-merge) C once."""
+    flops = 2 * m * n * k
+    nbytes = itemsize * (m * k + k * n + 2 * m * n)
+    return {"flops": flops, "bytes": nbytes,
+            "intensity": flops / nbytes if nbytes else 0.0}
+
+
+def intensity(flops: float, nbytes: float) -> float:
+    """Arithmetic intensity in flops/byte."""
+    return float(flops) / float(nbytes) if nbytes else 0.0
+
+
+# ------------------------------------------------------- roofline table
+
+# Per-device_kind peaks.  Matching is by lowercase substring of
+# jax's `device.device_kind` ("TPU v5 lite", "TPU v4", "cpu", ...).
+# "gflops" is peak compute per chip per dtype; f64/c128 entries model
+# the EMULATED split-f32/bf16 passes on TPU (no native f64 unit).
+# "gbs" is HBM bandwidth, "ici_gbs" per-device interconnect bandwidth
+# (the Cannon ring rides ICI).  All are engineering estimates meant to
+# anchor a fraction-of-peak signal, not vendor benchmarks — override
+# via DBCSR_TPU_ROOFLINE / DBCSR_TPU_PEAK_* for calibrated numbers.
+_PEAKS: dict = {
+    "tpu v6": {"gflops": {"bfloat16": 918000.0, "float32": 229000.0,
+                          "float64": 7000.0},
+               "gbs": 1640.0, "ici_gbs": 448.0},
+    "tpu v5p": {"gflops": {"bfloat16": 459000.0, "float32": 115000.0,
+                           "float64": 5000.0},
+                "gbs": 2765.0, "ici_gbs": 600.0},
+    "tpu v5 lite": {"gflops": {"bfloat16": 197000.0, "float32": 49000.0,
+                               "float64": 3000.0},
+                    "gbs": 819.0, "ici_gbs": 200.0},
+    "tpu v4": {"gflops": {"bfloat16": 275000.0, "float32": 69000.0,
+                          "float64": 4000.0},
+               "gbs": 1228.0, "ici_gbs": 300.0},
+    # the CI container: one CPU core through XLA-CPU (BASELINE.md's
+    # committed north-star engine number is ~3 GFLOP/s f64)
+    "cpu": {"gflops": {"bfloat16": 100.0, "float32": 100.0,
+                       "float64": 50.0},
+            "gbs": 20.0, "ici_gbs": 20.0},
+}
+_DEFAULT_PEAK = {"gflops": {"float64": 100.0, "float32": 200.0,
+                            "bfloat16": 200.0},
+                 "gbs": 100.0, "ici_gbs": 100.0}
+
+_env_table = None  # parsed DBCSR_TPU_ROOFLINE, cached
+
+
+def _env_overrides() -> dict:
+    global _env_table
+    if _env_table is None:
+        raw = os.environ.get("DBCSR_TPU_ROOFLINE", "")
+        try:
+            _env_table = json.loads(raw) if raw else {}
+        except ValueError:
+            _env_table = {}
+    return _env_table
+
+
+def device_kind() -> str:
+    """Best-effort `device_kind` of the default device.  Never forces
+    backend initialization (same guard as `obs.tracer._process_index`):
+    before any jax work has run it reports "unknown"."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return "unknown"
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is None or not getattr(xb, "_backends", None):
+        return "unknown"
+    try:
+        return str(jax.devices()[0].device_kind)
+    except Exception:
+        return "unknown"
+
+
+def peaks_for(kind: str | None = None) -> dict:
+    """Peak entry for a device kind: longest-matching table row, with
+    env overrides folded in.  Unknown kinds get the conservative
+    generic entry."""
+    kind = (kind or device_kind()).lower()
+    table = dict(_PEAKS)
+    for key, row in _env_overrides().items():
+        base = dict(table.get(key.lower(), _DEFAULT_PEAK))
+        gf = dict(base.get("gflops", {}))
+        gf.update(row.get("gflops", {}))
+        base.update(row)
+        base["gflops"] = gf
+        table[key.lower()] = base
+    best = None
+    for key, row in table.items():
+        if key in kind and (best is None or len(key) > len(best[0])):
+            best = (key, row)
+    entry = dict(best[1]) if best else dict(_DEFAULT_PEAK)
+    env_gf = os.environ.get("DBCSR_TPU_PEAK_GFLOPS")
+    if env_gf:
+        entry["gflops"] = {d: float(env_gf) for d in
+                           set(entry["gflops"]) | {"float64", "float32"}}
+    env_bw = os.environ.get("DBCSR_TPU_PEAK_GBS")
+    if env_bw:
+        entry["gbs"] = float(env_bw)
+    env_ici = os.environ.get("DBCSR_TPU_ICI_GBS")
+    if env_ici:
+        entry["ici_gbs"] = float(env_ici)
+    return entry
+
+
+def peak_gflops(kind: str | None = None, dtype: str = "float64") -> float:
+    """Peak compute for a dtype on a device kind.  Complex dtypes map
+    to their real component peak / 4 (a complex MAC is 4 real MACs;
+    the engine counts 2*m*n*k 'entry flops' regardless of dtype)."""
+    entry = peaks_for(kind)
+    gf = entry["gflops"]
+    dtype = str(dtype)
+    if dtype in gf:
+        return float(gf[dtype])
+    if dtype == "complex64":
+        return float(gf.get("float32", _DEFAULT_PEAK["gflops"]["float32"])) / 4
+    if dtype == "complex128":
+        return float(gf.get("float64", _DEFAULT_PEAK["gflops"]["float64"])) / 4
+    if dtype == "float16":
+        return float(gf.get("bfloat16", gf.get("float32", 100.0)))
+    return float(gf.get("float32", _DEFAULT_PEAK["gflops"]["float32"]))
+
+
+def roofline(flops: float, nbytes: float, seconds: float,
+             kind: str | None = None, dtype: str = "float64") -> dict:
+    """Roofline attribution of one measured region: achieved GFLOP/s,
+    arithmetic intensity, the attainable rate at that intensity
+    (``min(peak_compute, intensity * peak_bandwidth)``), and the
+    achieved fraction of it."""
+    kind = kind or device_kind()
+    entry = peaks_for(kind)
+    peak = peak_gflops(kind, dtype)
+    inten = intensity(flops, nbytes)
+    attainable = min(peak, inten * entry["gbs"]) if nbytes else peak
+    achieved = flops / seconds / 1e9 if seconds > 0 else 0.0
+    return {
+        "device_kind": kind,
+        "dtype": str(dtype),
+        "achieved_gflops": achieved,
+        "arithmetic_intensity": inten,
+        "peak_gflops": peak,
+        "peak_gbs": entry["gbs"],
+        "attainable_gflops": attainable,
+        "roofline_fraction": achieved / attainable if attainable else 0.0,
+        "bytes_moved": int(nbytes),
+        "flops": int(flops),
+        "seconds": seconds,
+    }
+
+
+def cannon_tick_model(m: int, n: int, k: int, kl: int, s: int,
+                      itemsize: int, dtype: str,
+                      kind: str | None = None) -> dict:
+    """Per-device, per-tick comm/compute balance of the dense Cannon:
+    each metronome tick contracts a local (m/s, k/(kl*s)) x
+    (k/(kl*s), n/s) panel while ring-shifting both operand shards over
+    ICI.  ``overlap_ratio`` = modeled comm time / compute time — below
+    1.0 the collective hides fully behind the local dot (the comm-
+    thread overlap the reference gets from USE_COMM_THREAD)."""
+    kind = kind or device_kind()
+    m_loc, n_loc, k_loc = m / s, n / s, k / (kl * s)
+    flops = 2.0 * m_loc * n_loc * k_loc
+    comm_bytes = (m_loc * k_loc + k_loc * n_loc) * itemsize
+    peak = peak_gflops(kind, dtype) * 1e9
+    ici = peaks_for(kind)["ici_gbs"] * 1e9
+    t_comp = flops / peak if peak else 0.0
+    t_comm = comm_bytes / ici if ici else 0.0
+    return {
+        "tick_flops": int(flops),
+        "tick_comm_bytes": int(comm_bytes),
+        "t_compute_s": t_comp,
+        "t_comm_s": t_comm,
+        "overlap_ratio": (t_comm / t_comp) if t_comp > 0 else 0.0,
+    }
+
+
+# ------------------------------------------------------- XLA cross-check
+
+_xla_costs: dict = {}  # fn -> {key_str: {model + xla numbers}}
+_capture = None  # resolved lazily from env; enable_xla_capture overrides
+
+
+def xla_capture_enabled() -> bool:
+    global _capture
+    if _capture is None:
+        _capture = os.environ.get("DBCSR_TPU_XLA_COST", "").lower() in (
+            "1", "true", "yes")
+    return _capture
+
+
+def enable_xla_capture(on: bool = True) -> None:
+    """Programmatic toggle for the per-specialization XLA cost capture
+    (the env knob is ``DBCSR_TPU_XLA_COST=1``)."""
+    global _capture
+    _capture = bool(on)
+
+
+def capture_xla_cost(fn_name: str, key, jit_fn, args, *,
+                     kwargs: dict | None = None,
+                     model: dict | None = None) -> dict | None:
+    """Capture XLA's own cost/memory analysis for one fresh jit
+    specialization, storing it next to the analytic ``model`` numbers.
+
+    Costs one extra AOT ``lower().compile()`` of the same computation
+    (the dispatch-path cache is separate), so call sites gate on
+    `xla_capture_enabled()` AND on `metrics.record_jit` returning True
+    — once per specialization, never on the steady-state path.
+    Best-effort: any backend/API failure records nothing."""
+    try:
+        compiled = jit_fn.lower(*args, **(kwargs or {})).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        rec = {
+            "xla_flops": float(ca.get("flops", 0.0)),
+            "xla_bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        }
+        try:
+            ma = compiled.memory_analysis()
+            rec["xla_argument_bytes"] = int(
+                getattr(ma, "argument_size_in_bytes", 0))
+            rec["xla_output_bytes"] = int(
+                getattr(ma, "output_size_in_bytes", 0))
+            rec["xla_temp_bytes"] = int(
+                getattr(ma, "temp_size_in_bytes", 0))
+        except Exception:
+            pass
+        if model:
+            rec["model"] = dict(model)
+            if model.get("flops") and rec["xla_flops"]:
+                rec["flops_ratio"] = rec["xla_flops"] / model["flops"]
+        _xla_costs.setdefault(fn_name, {})[str(key)] = rec
+        return rec
+    except Exception:
+        return None
+
+
+def xla_costs() -> dict:
+    """{fn: {specialization_key: {model vs XLA numbers}}} for every
+    capture since the last `reset()`."""
+    return {fn: dict(d) for fn, d in _xla_costs.items()}
+
+
+def reset() -> None:
+    _xla_costs.clear()

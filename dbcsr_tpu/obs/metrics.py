@@ -163,12 +163,58 @@ def jit_stats() -> dict:
     return out
 
 
-def reset() -> None:
+def reset(include_stats: bool = True) -> None:
+    """Clear the metric registries and the jit-recompile mirror.
+
+    ``include_stats`` (default True) also resets the `core.stats`
+    registries this module snapshots (per-(m,n,k) flops, comm traffic,
+    driver rollups, memory meters) and the `costmodel` XLA-cost
+    captures — so ``reset(); snapshot()`` reports a truly fresh state.
+    Pass ``include_stats=False`` to clear only the obs-owned metrics
+    while keeping the engine's cumulative statistics (e.g. to re-window
+    counters mid-run without losing the STATISTICS block)."""
     with _lock:
         _counters.clear()
         _gauges.clear()
         _histograms.clear()
         _jit_seen.clear()
+    if include_stats:
+        from dbcsr_tpu.core import stats
+        from dbcsr_tpu.obs import costmodel
+
+        stats.reset()
+        costmodel.reset()
+
+
+def _roofline_rollup() -> dict:
+    """Per-driver roofline attribution from `core.stats.driver_rollup`
+    + the `costmodel` peak table, refreshing the ``dbcsr_tpu_*`` gauges
+    as a side effect so scrapes and snapshots agree.  Every driver
+    that executed since the last reset gets an entry; seconds are
+    dispatch-side wall time (see `stats.record_driver`)."""
+    from dbcsr_tpu.core import stats
+    from dbcsr_tpu.obs import costmodel
+
+    kind = costmodel.device_kind()
+    out: dict = {}
+    for driver, agg in sorted(stats.driver_rollup().items()):
+        dtype = max(agg["by_dtype"], key=agg["by_dtype"].get) \
+            if agg["by_dtype"] else "float64"
+        rl = costmodel.roofline(agg["flops"], agg["bytes"],
+                                agg["seconds"], kind=kind, dtype=dtype)
+        rl["stacks"] = agg["stacks"]
+        out[driver] = rl
+        gauge("dbcsr_tpu_achieved_gflops",
+              "flops / dispatch seconds per stack driver").set(
+            rl["achieved_gflops"], driver=driver)
+        gauge("dbcsr_tpu_roofline_fraction",
+              "achieved rate / attainable roofline rate per driver "
+              "(min(peak compute, intensity*bandwidth) denominator)"
+              ).set(rl["roofline_fraction"], driver=driver)
+        gauge("dbcsr_tpu_arithmetic_intensity",
+              "modeled flops per HBM byte per driver").set(
+            rl["arithmetic_intensity"], driver=driver)
+    return out
 
 
 def _stats_snapshot() -> dict:
@@ -202,8 +248,11 @@ def _stats_snapshot() -> dict:
 
 def snapshot() -> dict:
     """One machine-readable dict of everything observable right now:
-    the core.stats layers + this registry's own metrics + the
-    jit-recompile mirror."""
+    the core.stats layers + the roofline attribution rollup + this
+    registry's own metrics + the jit-recompile mirror (+ captured XLA
+    cost analyses when `costmodel` capture is on)."""
+    from dbcsr_tpu.obs import costmodel
+
     def expand(metrics):
         return {
             name: {json.dumps(dict(k)): v for k, v in m.values.items()}
@@ -211,6 +260,13 @@ def snapshot() -> dict:
         }
 
     snap = _stats_snapshot()
+    # refresh the roofline gauges BEFORE expanding the gauge registry
+    # so the snapshot's "gauges" section carries them too
+    snap["roofline"] = _roofline_rollup()
+    snap["device_kind"] = costmodel.device_kind()
+    xc = costmodel.xla_costs()
+    if xc:
+        snap["xla_cost"] = xc
     snap["counters"] = expand(_counters)
     snap["gauges"] = expand(_gauges)
     snap["histograms"] = {
@@ -242,6 +298,7 @@ def prometheus_text() -> str:
     ``dbcsr_tpu_*`` families."""
     from dbcsr_tpu.core import stats
 
+    _roofline_rollup()  # refresh the roofline gauges before rendering
     lines: list = []
 
     def emit(name, kind, help, values):

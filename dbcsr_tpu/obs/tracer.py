@@ -18,6 +18,20 @@ Two export formats from one event stream:
   profiles captured by `jax.profiler` (the `timed()` regions carry the
   same names as their `TraceAnnotation` ranges).
 
+**Multihost sharding**: the configured path is a BASE path — each
+process writes its own shard ``<base>.p{process_index}.jsonl`` (for
+``DBCSR_TPU_TRACE=trace.jsonl``: ``trace.p0.jsonl``, ``trace.p1.jsonl``,
+...), so N processes pointed at one env value never interleave writes
+into one file.  When the process index cannot be known yet (env
+activation runs before the backend exists, and `jax.process_index()`
+must never be forced — see `_process_index`), the shard opens under a
+collision-proof provisional name and is atomically renamed to its
+final ``p{index}`` name as soon as the index resolves — at
+`init_multihost`'s barrier (which calls `rebind`), at the next
+`flush()`, or at close (index 0 then).  `tools/trace_merge.py` merges
+shards into one Perfetto-loadable trace with one track per process,
+aligned on the ``clock_align`` instant `init_multihost` emits.
+
 Activation: ``DBCSR_TPU_TRACE=<path>`` at import, or
 `dbcsr_tpu.obs.enable_trace(path)`.  When inactive, the only cost at
 every call site is one module-attribute ``is None`` check — the
@@ -33,6 +47,7 @@ from __future__ import annotations
 import atexit
 import json
 import os
+import re
 import threading
 import time
 
@@ -49,14 +64,23 @@ def _json_default(o):
     return str(o)
 
 
+def shard_path(base: str, index) -> str:
+    """Shard file for a base trace path: ``t.jsonl`` + 0 ->
+    ``t.p0.jsonl`` (the extension, when present, stays last so shell
+    globs like ``t.p*.jsonl`` work)."""
+    root, ext = os.path.splitext(base)
+    return f"{root}.p{index}{ext}"
+
+
 class Tracer:
-    """One trace session: an open JSONL stream + the in-memory event
-    list the Chrome export is built from."""
+    """One trace session: an open JSONL shard stream + the in-memory
+    event list the Chrome export is built from.  ``path`` is the BASE
+    path; the stream actually writes the per-process shard (see the
+    module docstring)."""
 
     def __init__(self, path: str, chrome_path: str | None = None,
                  max_events: int = _MAX_EVENTS):
-        self.path = path
-        self.chrome_path = chrome_path or (path + ".chrome.json")
+        self.base_path = path
         self.max_events = max_events
         self.events: list = []
         self.dropped = 0
@@ -65,15 +89,33 @@ class Tracer:
         self._span_stack: list = []
         # pid resolves lazily: at enable time (often import time, via
         # DBCSR_TPU_TRACE) the backend may not be up yet, and resolving
-        # it must never force backend init — re-checked at flush()
+        # it must never force backend init — re-checked at flush() and
+        # at init_multihost's rebind().  Until then the shard lives
+        # under a collision-proof provisional name (hostname + OS pid:
+        # multihost processes on a SHARED filesystem can collide on pid
+        # alone): two processes sharing the env path must never
+        # co-write one file, and a rename-in-place of a shared "p0"
+        # would hijack the other process's open stream.
         pid = _process_index()
         self._pid_final = pid is not None
         self.process_index = pid or 0
-        self._fh = open(path, "a")
+        if self._pid_final:
+            tag = pid
+        else:
+            import socket
+
+            host = re.sub(r"[^A-Za-z0-9]+", "-",
+                          socket.gethostname())[:24] or "host"
+            tag = f"tmp{host}-{os.getpid()}"
+        self.path = shard_path(path, tag)
+        self.chrome_path = chrome_path or (self.path + ".chrome.json")
+        self._chrome_path_forced = chrome_path is not None
+        self._fh = open(self.path, "a")
         self._emit({
             "ev": "meta",
             "t0_unix": time.time(),
             "pid": self.process_index,
+            "base_path": os.path.basename(path),
             "clock": "perf_counter_us_since_enable",
         })
 
@@ -152,21 +194,61 @@ class Tracer:
         else:
             self.dropped += 1
 
+    def _finalize_pid(self, pid: int | None = None,
+                      force: bool = False) -> None:
+        """Move a provisionally-named shard to its final
+        ``p{process_index}`` name once the index is knowable.  ``pid``
+        overrides discovery (init_multihost passes the joined world's
+        index); ``force`` settles on index 0 when nothing ever
+        resolved (single-process close)."""
+        if self._pid_final:
+            return
+        if pid is None:
+            pid = _process_index()
+        if pid is None:
+            if not force:
+                return
+            pid = 0
+        self._pid_final = True
+        self.process_index = int(pid)
+        new_path = shard_path(self.base_path, int(pid))
+        if new_path != self.path:
+            self._fh.close()
+            try:
+                if os.path.exists(new_path):
+                    # a shard already lives at the final name (an
+                    # earlier run's, or another process's): APPEND this
+                    # session's events instead of clobbering it —
+                    # rename must never destroy trace data
+                    with open(self.path) as src, open(new_path, "a") as dst:
+                        dst.write(src.read())
+                    os.remove(self.path)
+                else:
+                    os.replace(self.path, new_path)
+            except OSError:  # cross-device/locked: keep the provisional
+                new_path = self.path
+            self._fh = open(new_path, "a")
+            self.path = new_path
+            if not self._chrome_path_forced:
+                self.chrome_path = new_path + ".chrome.json"
+        # retro-stamp the in-memory events so the Chrome export puts
+        # the whole shard on one consistent track; the JSONL lines
+        # already written keep their provisional pid — the meta line
+        # below is the shard's authoritative index for the merger
+        for rec in self.events:
+            rec["pid"] = self.process_index
+        self._emit({"ev": "meta", "pid": self.process_index,
+                    "note": "process index resolved"})
+
     def flush(self) -> None:
         """Flush the JSONL stream and (re)write the Chrome trace."""
-        if not self._pid_final:
-            pid = _process_index()
-            if pid is not None:
-                self._pid_final = True
-                if pid != self.process_index:
-                    self.process_index = pid  # events from here on
-                    self._emit({"ev": "meta", "pid": pid,
-                                "note": "process index resolved late"})
+        self._finalize_pid()
         self._fh.flush()
         write_chrome_trace(self.chrome_path, self.events,
                            dropped=self.dropped)
 
     def close(self) -> None:
+        self._finalize_pid(force=True)
         if self.dropped:
             self._emit({"ev": "meta", "dropped_events": self.dropped})
         self.flush()
@@ -244,7 +326,10 @@ def write_chrome_trace(path: str, events: list, dropped: int = 0) -> None:
 
 def enable(path: str | None = None) -> Tracer:
     """Start tracing to ``path`` (default: $DBCSR_TPU_TRACE).  Replaces
-    any active tracer (the old one is closed)."""
+    any active tracer (the old one is closed).  ``path`` is the shard
+    BASE: the stream lands in ``<path base>.p{process_index}<ext>``
+    (see the module docstring); read the actual file from the returned
+    tracer's ``.path``."""
     global _tracer
     path = path or os.environ.get("DBCSR_TPU_TRACE")
     if not path:
@@ -269,6 +354,16 @@ def disable() -> None:
 
 def active() -> bool:
     return _tracer is not None
+
+
+def rebind(process_index: int | None = None) -> None:
+    """Settle the active shard onto its final ``p{index}`` name (no-op
+    when tracing is off or the index already resolved).  Called by
+    `parallel.multihost.init_multihost` right after the world forms,
+    with the joined world's process index."""
+    t = _tracer
+    if t is not None:
+        t._finalize_pid(pid=process_index)
 
 
 def get() -> Tracer | None:

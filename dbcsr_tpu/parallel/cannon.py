@@ -27,6 +27,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dbcsr_tpu.core import stats
 from dbcsr_tpu.core.timings import timed
+from dbcsr_tpu.obs import costmodel as _costmodel
+from dbcsr_tpu.obs import metrics as _metrics
 from dbcsr_tpu.obs import tracer as _trace
 
 
@@ -131,6 +133,34 @@ def cannon_multiply_dense(mesh: Mesh, a, b, acc_dtype=None):
             # of the kl-1 steps moves every (pr,pc) position's C panel
             stats.record_comm("psum", (kl - 1) * s * s,
                               (kl - 1) * m * n * itemsize)
+        if s > 1:
+            # comm/compute overlap attribution per metronome tick: the
+            # ring ppermute is scheduled concurrently with the local
+            # dot, so the modeled ratio says whether the collective is
+            # fully hideable on this grid/shape (the USE_COMM_THREAD
+            # question, answered from the static comm pattern + the
+            # roofline peaks instead of host threads)
+            tick = _costmodel.cannon_tick_model(
+                m, n, k, kl, s, itemsize, jnp.dtype(a.dtype).name)
+            grid = f"{kl}x{s}x{s}"
+            _metrics.gauge(
+                "dbcsr_tpu_cannon_overlap_ratio",
+                "modeled comm-time / compute-time per Cannon tick "
+                "(<1 = the ring shift hides behind the local dot)",
+            ).set(tick["overlap_ratio"], grid=grid)
+            _metrics.gauge(
+                "dbcsr_tpu_cannon_tick_comm_bytes",
+                "per-device operand bytes ring-shifted per Cannon tick",
+            ).set(tick["tick_comm_bytes"], grid=grid)
+            _metrics.gauge(
+                "dbcsr_tpu_cannon_tick_flops",
+                "per-device flops contracted per Cannon tick",
+            ).set(tick["tick_flops"], grid=grid)
+            _trace.annotate(
+                cannon_overlap_ratio=round(tick["overlap_ratio"], 4),
+                tick_comm_bytes=tick["tick_comm_bytes"],
+                tick_flops=tick["tick_flops"],
+            )
         fn = jax.jit(
             jax.shard_map(
                 functools.partial(

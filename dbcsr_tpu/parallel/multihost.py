@@ -22,11 +22,53 @@ path, `dbcsr_mpiwrap.F:130-150`).
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+from dbcsr_tpu.obs import tracer as _trace
+
+
+def _trace_clock_align() -> None:
+    """World-join trace bookkeeping: settle this process's trace shard
+    onto its final ``p{process_index}`` name, then emit a
+    ``clock_align`` instant from behind a world barrier — every shard
+    records the same physical moment, which is the anchor
+    `tools/trace_merge.py` uses to put N monotonic per-process clocks
+    on one timeline.  No-op (and no barrier) when tracing is off;
+    enable ``DBCSR_TPU_TRACE`` on ALL processes or none."""
+    if not _trace.active():
+        return
+    _trace.rebind(jax.process_index())
+    barrier = "none"
+    try:
+        # the jax.distributed coordination service barrier: backend-
+        # independent (works on the CPU/gloo world too, where a device
+        # collective would need a multiprocess XLA computation)
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is not None:
+            client.wait_at_barrier("dbcsr_tpu_trace_clock_align", 60_000)
+            barrier = "coordination_service"
+    except Exception:
+        try:  # fall back to a device collective where one exists
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                "dbcsr_tpu:trace_clock_align")
+            barrier = "sync_global_devices"
+        except Exception:
+            pass  # best-effort; t_unix still allows coarse alignment
+    _trace.instant("clock_align", {
+        "barrier": barrier,
+        "t_unix": time.time(),
+        "process": int(jax.process_index()),
+        "nproc": int(jax.process_count()),
+    })
 
 
 def init_multihost(
@@ -39,6 +81,10 @@ def init_multihost(
     With no arguments, auto-detects the cluster environment (GKE/Borg
     TPU pods export it); returns False and stays single-process when
     there is nothing to join — the serial-stub behavior.
+
+    When tracing is active, the join also rebinds this process's trace
+    shard to its world index and emits the barrier-aligned
+    ``clock_align`` instant `tools/trace_merge.py` keys on.
     """
     if coordinator_address is not None:
         # explicit cluster spec: a failed join must NOT silently degrade
@@ -49,13 +95,15 @@ def init_multihost(
             num_processes=num_processes,
             process_id=process_id,
         )
+        _trace_clock_align()
         return True
     try:
         jax.distributed.initialize()
-        return True
     except (ValueError, RuntimeError):
         # no cluster environment to auto-detect: serial-stub semantics
         return False
+    _trace_clock_align()
+    return True
 
 
 def shutdown_multihost() -> None:

@@ -34,6 +34,7 @@ are bit-reproducible for a given mesh shape.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 import jax
@@ -944,6 +945,7 @@ def _build_mesh_plan(a, b, matrix_c, mesh, pr, pc, kl, dtype, bm, bk, bn, r0,
 def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
                           limits=(None,) * 6, retain_sparsity=False,
                           filter_eps=None, element_limits=None):
+    t_start = time.perf_counter()
     kl, pr, pc = mesh.shape["kl"], mesh.shape["pr"], mesh.shape["pc"]
     cannon = pr == pc
     # accumulate in C's dtype when C is given (host-path convention)
@@ -1085,7 +1087,16 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
 
         filter_matrix(out, filter_eps)
 
-    stats.record_stack(bm, bn, bk, plan.n_cand, driver="mesh")
+    from dbcsr_tpu.obs import costmodel as _costmodel
+
+    stats.record_stack(
+        bm, bn, bk, plan.n_cand, driver="mesh",
+        seconds=time.perf_counter() - t_start,
+        nbytes=_costmodel.stack_bytes(
+            bm, bn, bk, plan.n_cand, nseg=max(len(plan.c_keys), 1),
+            itemsize=np.dtype(dtype).itemsize),
+        dtype=np.dtype(dtype).name,
+    )
     stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
     stats.sample_memory()
     # collective-traffic accounting (ref count_mpi_statistics,
@@ -1133,6 +1144,7 @@ def _dense_multiply_mesh(alpha, a, b, beta, matrix_c, mesh, name, dtype,
     inside the parallel driver).  GFLOP/s reporting stays honest: the
     true sparse-product flops are returned, the dense work lands in the
     marketing counter (`dbcsr_mm.F:664-667`)."""
+    t_start = time.perf_counter()
     from dbcsr_tpu.core import stats
     from dbcsr_tpu.core.dist import Distribution, ProcessGrid
     from dbcsr_tpu.mm.multiply import (
@@ -1178,8 +1190,15 @@ def _dense_multiply_mesh(alpha, a, b, beta, matrix_c, mesh, name, dtype,
     bm = int(a.row_blk_sizes.max()) if a.nblkrows else 1
     bk = int(a.col_blk_sizes.max()) if a.nblkcols else 1
     bn = int(b.col_blk_sizes.max()) if b.nblkcols else 1
+    from dbcsr_tpu.obs import costmodel as _costmodel
+
     stats.record_stack(bm, bn, bk, a.nblkrows * b.nblkcols * a.nblkcols,
-                       driver="dense")
+                       driver="dense",
+                       seconds=time.perf_counter() - t_start,
+                       nbytes=_costmodel.dense_cost(
+                           out.nfullrows, out.nfullcols, a.nfullcols,
+                           itemsize=np.dtype(dtype).itemsize)["bytes"],
+                       dtype=np.dtype(dtype).name)
     stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
     stats.sample_memory()
     out._last_flops = _true_product_flops(a, b)
@@ -1429,6 +1448,7 @@ def _build_grouped_plan(a, b, matrix_c, mesh, g, s, dtype, bm, bk, bn, r0,
 
 def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
                       filter_eps, nsplit=None):
+    t_start = time.perf_counter()
     g, s = mesh.shape["kl"], mesh.shape["pr"]
     if mesh.shape["pc"] != s:
         raise ValueError(
@@ -1515,7 +1535,16 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
 
         filter_matrix(out, filter_eps)
 
-    stats.record_stack(bm, bn, bk, plan.n_cand, driver="mesh")
+    from dbcsr_tpu.obs import costmodel as _costmodel
+
+    stats.record_stack(
+        bm, bn, bk, plan.n_cand, driver="mesh",
+        seconds=time.perf_counter() - t_start,
+        nbytes=_costmodel.stack_bytes(
+            bm, bn, bk, plan.n_cand, nseg=max(len(plan.c_keys), 1),
+            itemsize=np.dtype(dtype).itemsize),
+        dtype=np.dtype(dtype).name,
+    )
     stats.record_multiply(2 * out.nfullrows * out.nfullcols * a.nfullcols)
     stats.sample_memory()
     ndev = g * s * s

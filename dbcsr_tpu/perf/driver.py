@@ -223,6 +223,12 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
     chksum_b = matrix_checksum(b)
     chksum_c_in = matrix_checksum(c)
 
+    from dbcsr_tpu.core import stats as _stats
+
+    def _rollup_bytes():
+        return sum(v["bytes"] for v in _stats.driver_rollup().values())
+
+    bytes0 = _rollup_bytes()
     times, flops_list = [], []
     for _ in range(cfg.nrep):
         c_run = c.copy()
@@ -283,6 +289,18 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
         # mode; GFLOP/s above is always TRUE sparse-product flops / time)
         "algorithm": getattr(c_run, "_mm_algorithm", "mesh"),
     }
+    # cost-model-normalized attribution of the best repeat: modeled HBM
+    # bytes per multiply (delta of the per-driver rollup over the rep
+    # loop), achieved GFLOP/s on TRUE flops, and the roofline fraction
+    # against this device_kind's peak table (obs/costmodel.py) — the
+    # efficiency numbers bench.py embeds for tools/perf_gate.py
+    from dbcsr_tpu.obs import costmodel as _costmodel
+
+    bytes_per_rep = (_rollup_bytes() - bytes0) / max(cfg.nrep, 1)
+    result["modeled"] = _costmodel.roofline(
+        flops_list[-1], bytes_per_rep, min(times),
+        dtype=np.dtype(dtype).name,
+    )
     from dbcsr_tpu.obs import tracer as _obs_tracer
 
     if _obs_tracer.active():

@@ -25,10 +25,12 @@ import trace_summary  # noqa: E402
 
 @pytest.fixture
 def trace(tmp_path):
-    """An enabled trace session; always disabled afterwards."""
-    path = str(tmp_path / "trace.jsonl")
-    obs.enable_trace(path)
-    yield path
+    """An enabled trace session; always disabled afterwards.  Yields
+    the FINAL shard path (the base path sharded to process index 0 —
+    obs.tracer writes per-process shards since PR 2)."""
+    base = str(tmp_path / "trace.jsonl")
+    obs.enable_trace(base)
+    yield obs.shard_path(base, 0)
     obs.disable_trace()
 
 
@@ -171,6 +173,39 @@ def test_metrics_counter_gauge_histogram():
     assert "# TYPE t_hist histogram" in text
 
 
+def test_histogram_prometheus_exposition_cumulative():
+    """Histogram exposition follows the Prometheus contract: bucket
+    counts are CUMULATIVE over increasing ``le`` bounds, the +Inf
+    bucket equals _count, and _sum/_count close each labeled series."""
+    metrics.reset()
+    h = metrics.histogram("t_lat_seconds", "latencies",
+                          buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v, op="mm")
+    h.observe(0.01, op="tr")
+    text = metrics.prometheus_text()
+    lines = [ln for ln in text.splitlines() if ln.startswith("t_lat_")]
+
+    def bucket(op, le):
+        (hit,) = [ln for ln in lines
+                  if f'le="{le}"' in ln and f'op="{op}"' in ln]
+        return int(hit.rsplit(" ", 1)[1])
+
+    assert [bucket("mm", le) for le in ("0.1", "1.0", "10.0", "+Inf")] \
+        == [1, 3, 4, 5]  # monotone cumulative counts
+    assert [bucket("tr", le) for le in ("0.1", "1.0", "10.0", "+Inf")] \
+        == [1, 1, 1, 1]
+    assert 't_lat_seconds_count{op="mm"} 5' in text
+    (s,) = [ln for ln in lines if ln.startswith('t_lat_seconds_sum{op="mm"}')]
+    assert float(s.rsplit(" ", 1)[1]) == pytest.approx(56.05)
+    # snapshot mirrors the same cumulative structure
+    snap = metrics.snapshot()["histograms"]["t_lat_seconds"]
+    mm = snap['{"op": "mm"}']
+    assert mm["count"] == 5 and mm["buckets"]["+Inf"] == 5
+    assert mm["buckets"]["0.1"] <= mm["buckets"]["1.0"] <= \
+        mm["buckets"]["10.0"] <= mm["buckets"]["+Inf"]
+
+
 def test_metrics_snapshot_layers_core_stats():
     metrics.reset()
     stats.record_stack(23, 23, 23, 100, driver="xla_group")
@@ -296,6 +331,304 @@ def test_flight_nested_multiplies_each_get_a_record():
     tas_multiply("N", "N", 1.0, a, b, 0.0, c, nsplit=3)
     assert len(flight.records()) == 3  # one per group
     flight.clear()
+
+
+# ------------------------------------------------- tracer shards (PR 2)
+
+def test_shard_path_naming():
+    assert obs.shard_path("/x/trace.jsonl", 0) == "/x/trace.p0.jsonl"
+    assert obs.shard_path("/x/trace.jsonl", 3) == "/x/trace.p3.jsonl"
+    assert obs.shard_path("/x/trace", 1) == "/x/trace.p1"
+
+
+def test_provisional_shard_rebinds_to_process_index(tmp_path, monkeypatch):
+    """Two processes pointed at one DBCSR_TPU_TRACE path must never
+    co-write a file: before the process index resolves the shard opens
+    under a collision-proof provisional name, and `rebind` renames it
+    atomically to its final p{index} shard."""
+    from dbcsr_tpu.obs import tracer as tr
+
+    monkeypatch.setattr(tr, "_process_index", lambda: None)
+    base = str(tmp_path / "t.jsonl")
+    t = obs.enable_trace(base)
+    # collision-proof across hosts sharing a filesystem: host + OS pid
+    assert f"-{os.getpid()}." in t.path and ".ptmp" in t.path
+    with timings.timed("early"):
+        pass
+    tr.rebind(2)  # init_multihost passes the joined world's index
+    assert t.path == obs.shard_path(base, 2)
+    assert t.process_index == 2
+    with timings.timed("late"):
+        pass
+    obs.disable_trace()
+    assert sorted(p.name for p in tmp_path.iterdir()) == [
+        "t.p2.jsonl", "t.p2.jsonl.chrome.json"]
+    recs = _read_jsonl(str(tmp_path / "t.p2.jsonl"))
+    names = [r["name"] for r in recs if r["ev"] == "span"]
+    assert names == ["early", "late"]  # both sides of the rename kept
+    # the chrome export puts the WHOLE shard on the final track
+    doc = json.load(open(str(tmp_path / "t.p2.jsonl.chrome.json")))
+    assert {e["pid"] for e in doc["traceEvents"]} == {2}
+
+
+def test_shard_rename_appends_instead_of_clobbering(tmp_path, monkeypatch):
+    """A second session whose rename lands on an existing shard (an
+    earlier run's, or another process's) must APPEND its events, never
+    os.replace over them."""
+    from dbcsr_tpu.obs import tracer as tr
+
+    monkeypatch.setattr(tr, "_process_index", lambda: None)
+    base = str(tmp_path / "t.jsonl")
+    for span in ("first_run", "second_run"):
+        obs.enable_trace(base)
+        with timings.timed(span):
+            pass
+        obs.disable_trace()  # both settle on p0
+    recs = _read_jsonl(obs.shard_path(base, 0))
+    names = [r["name"] for r in recs if r["ev"] == "span"]
+    assert names == ["first_run", "second_run"]
+
+
+def test_single_process_close_settles_on_p0(tmp_path, monkeypatch):
+    """A session whose index never resolves (no jax work at all)
+    settles on p0 at close — deterministic artifact names for the
+    common single-process flow."""
+    from dbcsr_tpu.obs import tracer as tr
+
+    monkeypatch.setattr(tr, "_process_index", lambda: None)
+    base = str(tmp_path / "t.jsonl")
+    obs.enable_trace(base)
+    obs.instant("ping")
+    obs.disable_trace()
+    assert (tmp_path / "t.p0.jsonl").exists()
+
+
+def test_trace_merge_two_shards(tmp_path, monkeypatch):
+    """trace_merge puts per-process shards on one timeline with one
+    track per process, aligned on the clock_align instants."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import trace_merge
+    from dbcsr_tpu.obs import tracer as tr
+
+    monkeypatch.setattr(tr, "_process_index", lambda: None)
+    base = str(tmp_path / "t.jsonl")
+    for pid in (0, 1):
+        t = obs.enable_trace(base)
+        tr.rebind(pid)
+        obs.instant("clock_align", {"t_unix": 1000.0 + pid,
+                                    "process": pid})
+        with timings.timed(f"work_p{pid}"):
+            pass
+        obs.disable_trace()
+    res = trace_merge.merge([obs.shard_path(base, 0),
+                             obs.shard_path(base, 1)])
+    assert res["mode"] == "clock_align"
+    evs = res["doc"]["traceEvents"]
+    assert {e["pid"] for e in evs} == {0, 1}
+    names = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert len(names) == 2
+    # the two clock_align instants coincide on the merged timeline
+    aligns = [e["ts"] for e in evs if e.get("name") == "clock_align"]
+    assert len(aligns) == 2 and abs(aligns[0] - aligns[1]) < 1e-6
+    assert os.path.exists(res["out_path"])
+
+
+def test_trace_merge_skips_stale_provisional_and_disambiguates_pids(
+        tmp_path, monkeypatch):
+    """Base-path expansion ignores crashed runs' unsettled .ptmp*
+    shards, and two shards claiming one pid land on distinct tracks."""
+    import trace_merge
+    from dbcsr_tpu.obs import tracer as tr
+
+    monkeypatch.setattr(tr, "_process_index", lambda: None)
+    base = str(tmp_path / "t.jsonl")
+    obs.enable_trace(base)
+    with timings.timed("good_run"):
+        pass
+    obs.disable_trace()  # settles on p0
+    # a crashed earlier run left an unsettled provisional shard
+    stale = tmp_path / "t.ptmphost-999.jsonl"
+    stale.write_text(json.dumps({"ev": "meta", "pid": 0,
+                                 "t0_unix": 1.0}) + "\n")
+    paths = trace_merge.expand_shards([base])
+    assert [os.path.basename(p) for p in paths] == ["t.p0.jsonl"]
+    # passed EXPLICITLY, the stale shard merges onto its own track
+    res = trace_merge.merge([obs.shard_path(base, 0), str(stale)])
+    assert [s["pid"] for s in res["shards"]] == [0, 1]
+
+
+def test_trace_merge_mixed_alignment(tmp_path, monkeypatch):
+    """A shard that never reached the barrier (crashed pre-join) falls
+    back to wall-clock alignment PER SHARD — the barrier-aligned
+    shards keep coinciding exactly."""
+    import trace_merge
+    from dbcsr_tpu.obs import tracer as tr
+
+    monkeypatch.setattr(tr, "_process_index", lambda: None)
+    base = str(tmp_path / "t.jsonl")
+    for pid in (0, 1, 2):
+        obs.enable_trace(base)
+        tr.rebind(pid)
+        if pid < 2:  # rank 2 "crashed" before init_multihost
+            obs.instant("clock_align", {"t_unix": 2000.0 + 0.001 * pid,
+                                        "process": pid})
+        with timings.timed(f"work_p{pid}"):
+            pass
+        obs.disable_trace()
+    res = trace_merge.merge([obs.shard_path(base, i) for i in (0, 1, 2)])
+    assert res["mode"] == "mixed"
+    evs = res["doc"]["traceEvents"]
+    assert {e["pid"] for e in evs} == {0, 1, 2}
+    aligns = [e["ts"] for e in evs if e.get("name") == "clock_align"]
+    assert len(aligns) == 2 and abs(aligns[0] - aligns[1]) < 1e-6
+    assert all(e["ts"] >= 0 for e in evs if "ts" in e)
+
+
+def test_trace_summary_multi_shard(tmp_path, monkeypatch):
+    """A glob / base path of shards aggregates across processes while
+    the single-file summary shape stays unchanged."""
+    from dbcsr_tpu.obs import tracer as tr
+
+    monkeypatch.setattr(tr, "_process_index", lambda: None)
+    base = str(tmp_path / "t.jsonl")
+    for pid in (0, 1):
+        obs.enable_trace(base)
+        tr.rebind(pid)
+        with timings.timed("shared_phase"):
+            pass
+        obs.disable_trace()
+    s = trace_summary.summarize_many(
+        trace_summary.expand_paths([base]))
+    assert s["phases"]["shared_phase"]["calls"] == 2
+    assert len(s["per_process"]) == 2
+    single = trace_summary.summarize(obs.shard_path(base, 0))
+    assert "per_process" not in single
+    assert single["phases"]["shared_phase"]["calls"] == 1
+
+
+# -------------------------------------------- cost model + roofline (PR 2)
+
+def test_metrics_reset_include_stats_semantics():
+    """reset() clears the core.stats layers it snapshots (the stale-
+    flops footgun); reset(include_stats=False) keeps them."""
+    metrics.reset()
+    stats.record_stack(4, 4, 4, 10, driver="xla")
+    metrics.counter("t_reset_probe").inc()
+    metrics.reset(include_stats=False)
+    snap = metrics.snapshot()
+    assert snap["flops_by_driver"]["xla"] == 2 * 4**3 * 10  # stats kept
+    assert not metrics.counter("t_reset_probe").values  # registry cleared
+    metrics.reset()  # default: stats go too
+    snap = metrics.snapshot()
+    assert snap["flops_by_driver"] == {}
+
+
+def test_roofline_fraction_reported_per_driver():
+    """Acceptance: snapshot() reports roofline_fraction for every
+    driver that executed."""
+    metrics.reset()
+    _small_multiply(seed=11)
+    snap = metrics.snapshot()
+    assert snap["roofline"], "no drivers in the roofline rollup"
+    for driver, fb in snap["flops_by_driver"].items():
+        rl = snap["roofline"][driver]
+        assert "roofline_fraction" in rl and "achieved_gflops" in rl
+        assert rl["flops"] == fb
+        assert rl["achieved_gflops"] > 0  # dispatch seconds were recorded
+        assert 0 <= rl["roofline_fraction"]
+        assert rl["bytes_moved"] > 0 and rl["arithmetic_intensity"] > 0
+    # the same numbers are exported as labeled gauges
+    text = metrics.prometheus_text()
+    assert "dbcsr_tpu_roofline_fraction{" in text
+    assert "dbcsr_tpu_achieved_gflops{" in text
+
+
+def test_costmodel_stack_and_dense_models():
+    from dbcsr_tpu.obs import costmodel
+
+    assert costmodel.stack_flops(23, 23, 23, 100) == 2 * 23**3 * 100
+    b = costmodel.stack_bytes(23, 23, 23, 100, nseg=40, itemsize=8)
+    assert b == 8 * (100 * 2 * 23 * 23 + 2 * 40 * 23 * 23)
+    d = costmodel.dense_cost(64, 32, 16, itemsize=4)
+    assert d["flops"] == 2 * 64 * 32 * 16
+    assert d["bytes"] == 4 * (64 * 16 + 16 * 32 + 2 * 64 * 32)
+
+
+def test_roofline_peak_table_env_override(monkeypatch):
+    from dbcsr_tpu.obs import costmodel
+
+    monkeypatch.setenv("DBCSR_TPU_ROOFLINE",
+                       json.dumps({"weird accel": {
+                           "gflops": {"float64": 1234.0}, "gbs": 10.0}}))
+    monkeypatch.setattr(costmodel, "_env_table", None)  # drop the cache
+    assert costmodel.peak_gflops("Weird Accel v9", "float64") == 1234.0
+    # high intensity -> compute-bound: attainable == peak
+    rl = costmodel.roofline(2e9, 1e6, 1.0, kind="weird accel",
+                            dtype="float64")
+    assert rl["attainable_gflops"] == 1234.0
+    assert rl["achieved_gflops"] == pytest.approx(2.0)
+    assert rl["roofline_fraction"] == pytest.approx(2.0 / 1234.0)
+    # low intensity -> bandwidth-bound: attainable = intensity * gbs
+    rl = costmodel.roofline(1e6, 1e9, 1.0, kind="weird accel",
+                            dtype="float64")
+    assert rl["attainable_gflops"] == pytest.approx(1e-3 * 10.0)
+    monkeypatch.setattr(costmodel, "_env_table", None)
+
+
+def test_cannon_tick_overlap_model():
+    from dbcsr_tpu.obs import costmodel
+
+    tick = costmodel.cannon_tick_model(
+        1024, 1024, 1024, kl=1, s=2, itemsize=8, dtype="float64",
+        kind="cpu")
+    # per device/tick: (512x512)@(512x512) dot, one A + one B shard move
+    assert tick["tick_flops"] == 2 * 512 * 512 * 512
+    assert tick["tick_comm_bytes"] == 2 * 512 * 512 * 8
+    assert tick["overlap_ratio"] == pytest.approx(
+        tick["t_comm_s"] / tick["t_compute_s"])
+
+
+def test_costmodel_agrees_with_xla_cost_analysis():
+    """Satellite acceptance: the analytic model and XLA's own
+    cost_analysis agree on a small stack.  The stack is sized to a jit
+    bucket so model and device work count the same entries; XLA adds
+    the segment-sum/accumulate flops on top of the dot, so the ratio
+    must sit just above 1."""
+    from dbcsr_tpu.acc.smm import process_stack
+    from dbcsr_tpu.obs import costmodel
+    import jax.numpy as jnp
+
+    metrics.reset()
+    costmodel.enable_xla_capture(True)
+    set_config(mm_driver="xla")
+    try:
+        m = n = k = 8
+        s_entries = 512  # == bucket_size(512): no padding
+        rng = np.random.default_rng(13)
+        na, nc = 32, 64
+        a = jnp.asarray(rng.standard_normal((na, m, k)))
+        b = jnp.asarray(rng.standard_normal((na, k, n)))
+        c = jnp.zeros((nc, m, n))
+        ai = rng.integers(0, na, s_entries).astype(np.int32)
+        bi = rng.integers(0, na, s_entries).astype(np.int32)
+        ci = np.sort(rng.integers(0, nc, s_entries)).astype(np.int32)
+        process_stack(c, a, b, ai, bi, ci)
+        xc = costmodel.xla_costs()["acc.smm._process_stack_xla"]
+        (rec,) = xc.values()
+        assert rec["model"]["flops"] == 2 * m * n * k * s_entries
+        assert rec["xla_flops"] > 0
+        # dot flops dominate; segment-sum adds ~1/(2k) on top
+        assert 1.0 <= rec["flops_ratio"] < 1.5, rec
+        assert rec["xla_bytes_accessed"] > 0
+        # the capture also lands in the metrics snapshot
+        assert "acc.smm._process_stack_xla" in \
+            metrics.snapshot()["xla_cost"]
+    finally:
+        costmodel.enable_xla_capture(False)
+        set_config(mm_driver="auto")
 
 
 # ---------------------------------------------------- trace_summary tool

@@ -1,0 +1,236 @@
+"""tools/perf_gate.py — the noise-aware bench regression gate.
+
+Covers the capture-format auto-detection (bench dicts, BENCH_rNN.json
+wrappers, JSONL logs), median-of-k + MAD noise thresholds, per-case
+verdicts and exit codes on the ISSUE's edge cases (empty baseline,
+case missing from one side, all-regressed), the efficiency gating on
+the embedded cost-model block, and the apples-to-oranges refusal.
+Pure host-side JSON processing — no jax involved."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import perf_gate  # noqa: E402
+
+
+def _rec(value, metric="bench GFLOP/s", **extra):
+    return dict({"metric": metric, "value": value, "unit": "GFLOP/s",
+                 "device": "TFRT_CPU_0", "device_fallback": True}, **extra)
+
+
+def _write(path, obj):
+    path.write_text(json.dumps(obj))
+    return str(path)
+
+
+def _verdict_of(report, case=None):
+    rows = report["cases"]
+    if case is not None:
+        rows = [r for r in rows if r["case"] == case]
+    (row,) = rows
+    return row["verdict"]
+
+
+# ------------------------------------------------------------- formats
+
+def test_load_records_formats(tmp_path):
+    # bare bench dict
+    p1 = _write(tmp_path / "bare.json", _rec(3.0))
+    assert perf_gate.load_records(p1)[0]["value"] == 3.0
+    # BENCH_rNN wrapper
+    p2 = _write(tmp_path / "wrap.json", {"n": 4, "parsed": _rec(4.0)})
+    assert perf_gate.load_records(p2)[0]["value"] == 4.0
+    # JSONL with a torn tail line
+    p3 = tmp_path / "cap.jsonl"
+    p3.write_text(json.dumps(_rec(1.0)) + "\n" + json.dumps(_rec(2.0))
+                  + "\n" + '{"torn": ')
+    vals = [r["value"] for r in perf_gate.load_records(str(p3))]
+    assert vals == [1.0, 2.0]
+    # JSON list
+    p4 = _write(tmp_path / "list.json", [_rec(5.0), _rec(6.0)])
+    assert len(perf_gate.load_records(p4)) == 2
+
+
+def test_committed_round_artifacts_gate():
+    """Acceptance: the committed BENCH_r04/r05 pair produces per-case
+    verdicts and correct exit codes both ways (r05 improved on r04)."""
+    base = perf_gate.load_records(os.path.join(REPO, "BENCH_r04.json"))
+    cand = perf_gate.load_records(os.path.join(REPO, "BENCH_r05.json"))
+    up = perf_gate.gate(base, cand)
+    assert _verdict_of(up) == "improved" and up["exit_code"] == 0
+    down = perf_gate.gate(cand, base)
+    assert _verdict_of(down) == "regressed" and down["exit_code"] == 1
+
+
+# ---------------------------------------------------------- edge cases
+
+def test_empty_baseline_passes_with_note(tmp_path):
+    report = perf_gate.gate([], [_rec(3.0)])
+    assert report["exit_code"] == 0
+    assert any("empty baseline" in n for n in report["notes"])
+    assert _verdict_of(report) == "new-case"
+
+
+def test_case_missing_from_candidate_fails_unless_allowed():
+    base = [_rec(3.0, metric="kept"), _rec(2.0, metric="dropped")]
+    cand = [_rec(3.0, metric="kept")]
+    report = perf_gate.gate(base, cand)
+    assert _verdict_of(report, "dropped") == "missing-candidate"
+    assert report["exit_code"] == 1
+    report = perf_gate.gate(base, cand, allow_missing=True)
+    assert report["exit_code"] == 0
+
+
+def test_all_regressed(tmp_path):
+    base = [_rec(10.0, metric="a"), _rec(8.0, metric="b")]
+    cand = [_rec(5.0, metric="a"), _rec(4.0, metric="b")]
+    report = perf_gate.gate(base, cand)
+    assert all(v["verdict"] == "regressed" for v in report["cases"])
+    assert report["regressed"] == 2 and report["exit_code"] == 1
+
+
+# ------------------------------------------------- medians + thresholds
+
+def test_median_of_k_and_noise_threshold():
+    # median 10 with one outlier; candidate median 9.5 is within the
+    # fixed 10% band -> ok
+    base = [_rec(v) for v in (10.0, 10.2, 9.8, 3.0, 10.1)]
+    cand = [_rec(v) for v in (9.5, 9.4, 9.6)]
+    report = perf_gate.gate(base, cand)
+    assert _verdict_of(report) == "ok"
+    # a historically noisy case widens its own gate: MAD of
+    # (10, 5, 15) is 5 -> noise tol 3*5/10 = 150%, so 5.0 still passes
+    noisy_base = [_rec(v) for v in (10.0, 5.0, 15.0)]
+    report = perf_gate.gate(noisy_base, [_rec(5.0)])
+    assert _verdict_of(report) == "ok"
+    (case,) = report["cases"]
+    assert case["threshold"] > 1.0
+    # a tight baseline keeps the default 10% gate
+    tight = [_rec(v) for v in (10.0, 10.01, 9.99)]
+    report = perf_gate.gate(tight, [_rec(5.0)])
+    assert _verdict_of(report) == "regressed"
+
+
+# ------------------------------------------- efficiency + comparability
+
+def _modeled(value, frac, kind="tpu v5 lite"):
+    return _rec(value, device="TPU v5 lite0", device_fallback=False,
+                device_kind=kind,
+                modeled={"roofline_fraction": frac,
+                         "gflops_modeled": value})
+
+
+def test_auto_gates_on_roofline_fraction_when_embedded():
+    # raw GFLOP/s regressed 20%, but the cost-model says efficiency
+    # held (e.g. the workload's modeled flops shrank too): auto mode
+    # follows the embedded roofline fraction
+    report = perf_gate.gate([_modeled(10.0, 0.04)],
+                            [_modeled(8.0, 0.039)])
+    (case,) = report["cases"]
+    assert case["metric"] == "roofline_fraction"
+    assert case["verdict"] == "ok" and report["exit_code"] == 0
+    # efficiency regression trips it even with matching raw value
+    report = perf_gate.gate([_modeled(10.0, 0.04)],
+                            [_modeled(10.0, 0.02)])
+    assert _verdict_of(report) == "regressed"
+    # mixed sides (old baseline without the block) drop to raw value
+    report = perf_gate.gate([_rec(10.0)], [_modeled(10.0, 0.04)])
+    (case,) = report["cases"]
+    assert case["metric"] == "value" and case["verdict"] == "incomparable"
+
+
+def test_device_kind_mismatch_refused_unless_forced():
+    base = [_modeled(10.0, 0.04, kind="tpu v5 lite")]
+    cand = [_modeled(10.0, 0.04, kind="tpu v6 lite")]
+    report = perf_gate.gate(base, cand)
+    assert _verdict_of(report) == "incomparable"
+    assert report["exit_code"] == 2
+    report = perf_gate.gate(base, cand, force=True)
+    assert _verdict_of(report) == "ok" and report["exit_code"] == 0
+
+
+def test_fallback_vs_device_run_refused():
+    base = [_rec(3.0)]  # CPU fallback
+    cand = [_rec(4.0, device="TPU v5 lite0", device_fallback=False)]
+    report = perf_gate.gate(base, cand)
+    assert _verdict_of(report) == "incomparable"
+
+
+# ------------------------------------------------------ CLI smoke test
+
+def test_cli_smoke_on_synthetic_captures(tmp_path):
+    """CI/tooling satellite: run the gate as a subprocess on two
+    synthetic capture files — per-case verdicts, JSON report artifact,
+    and the exit-code contract."""
+    base = tmp_path / "base.jsonl"
+    base.write_text("\n".join(
+        json.dumps(_rec(v, metric="north-star")) for v in (4.0, 4.2, 3.9)))
+    cand_ok = _write(tmp_path / "cand_ok.json",
+                     {"parsed": _rec(4.1, metric="north-star")})
+    cand_bad = _write(tmp_path / "cand_bad.json",
+                      _rec(1.0, metric="north-star"))
+    gate_py = os.path.join(REPO, "tools", "perf_gate.py")
+    report_path = tmp_path / "report.json"
+    r = subprocess.run(
+        [sys.executable, gate_py, str(base), cand_ok, "--json",
+         "--report", str(report_path)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = json.loads(r.stdout)
+    assert out["cases"][0]["verdict"] == "ok"
+    assert json.loads(report_path.read_text())["exit_code"] == 0
+    r = subprocess.run([sys.executable, gate_py, str(base), cand_bad],
+                       capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "regressed" in r.stdout and "FAIL" in r.stdout
+    # the table renderer must survive None medians (new/missing cases)
+    cand_other = _write(tmp_path / "cand_other.json",
+                        _rec(2.0, metric="different-case"))
+    r = subprocess.run([sys.executable, gate_py, str(base), cand_other],
+                       capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "missing-candidate" in r.stdout and "new-case" in r.stdout
+
+
+def test_old_capture_comparable_with_stamped_one():
+    """Pre-stamp rows ("TFRT_CPU_0", no device_kind) and stamped ones
+    (device_kind "cpu") normalize into one CPU bucket — upgrading the
+    stamps must not orphan committed baselines."""
+    base = [_rec(5.6)]  # old-style: device string only
+    cand = [_rec(5.5, device_kind="cpu")]
+    report = perf_gate.gate(base, cand)
+    assert _verdict_of(report) == "ok" and report["exit_code"] == 0
+
+
+def test_old_tpu_capture_comparable_by_kind_prefix():
+    """Pre-stamp TPU rows compare by device-kind PREFIX: a committed
+    'TPU v5 lite0' device string matches a stamped 'TPU v5 lite'
+    candidate (and a bare 'TPU' one), while v5-vs-v6 stays refused."""
+    old = _rec(4.0, device="TPU v5 lite0", device_fallback=False)
+    stamped = _rec(4.1, device="TPU v5 lite0", device_fallback=False,
+                   device_kind="TPU v5 lite")
+    report = perf_gate.gate([old], [stamped])
+    assert _verdict_of(report) == "ok" and report["exit_code"] == 0
+    bare = _rec(4.0, device="TPU_0", device_fallback=False)
+    report = perf_gate.gate([bare], [stamped])
+    assert _verdict_of(report) == "ok"
+    assert not perf_gate.environments_compatible(
+        ["tpu v5 lite|fallback=False", "tpu v6 lite|fallback=False"])
+
+
+def test_forced_gate_metric_missing_from_baseline_is_not_a_pass():
+    """--gate-on roofline_fraction against a baseline that predates the
+    modeled block must NOT exit 0 having compared nothing."""
+    report = perf_gate.gate([_rec(5.0)], [_modeled(5.0, 0.04)],
+                            gate_on="roofline_fraction", force=True)
+    assert _verdict_of(report) == "no-baseline-samples"
+    assert report["exit_code"] == 2
+    # and a candidate losing the metric reads as a missing candidate
+    report = perf_gate.gate([_modeled(5.0, 0.04)], [_rec(5.0)],
+                            gate_on="roofline_fraction", force=True)
+    assert _verdict_of(report) == "missing-candidate"
+    assert report["exit_code"] == 1

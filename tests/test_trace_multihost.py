@@ -1,0 +1,117 @@
+"""Multihost trace sharding, end to end at tier 1: a REAL 2-process
+`jax.distributed` world runs with ``DBCSR_TPU_TRACE`` pointing both
+processes at ONE base path; each rank must write its own
+``trace.p{index}.jsonl`` shard (no interleaved writes), record the
+barrier-aligned ``clock_align`` instant from `init_multihost`, and
+`tools/trace_merge.py` must merge the shards into one Chrome trace
+with a distinct track per process.
+
+Kept deliberately light (1 virtual device per rank, one tiny psum) so
+it stays inside the tier-1 budget; the heavyweight world tests live in
+`test_multihost_2proc.py` (slow)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+import trace_merge  # noqa: E402
+
+_WORKER = r'''
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+port, pid = sys.argv[1], int(sys.argv[2])
+# env activation (DBCSR_TPU_TRACE is in the environment) opened a
+# provisional shard at import; init_multihost must rebind it
+from dbcsr_tpu import obs
+from dbcsr_tpu.core import timings
+from dbcsr_tpu.parallel import multihost
+assert obs.trace_enabled(), "DBCSR_TPU_TRACE did not activate tracing"
+ok = multihost.init_multihost(f"localhost:{{port}}", 2, pid)
+assert ok and multihost.process_count() == 2
+t = obs.get_tracer()
+assert t.path.endswith(f".p{{pid}}.jsonl"), t.path
+with timings.timed("rank_work"):
+    import jax.numpy as jnp
+    assert float(jnp.ones(4).sum()) == 4.0
+obs.disable_trace()
+print(f"WORKER{{pid}} OK shard={{t.path}}")
+multihost.shutdown_multihost()
+'''
+
+
+def _run_world(worker, trace_base, attempt_timeout):
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, DBCSR_TPU_TRACE=trace_base)
+    env.pop("JAX_PLATFORMS", None)  # worker sets the platform itself
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(port), str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=attempt_timeout)[0])
+    except subprocess.TimeoutExpired:
+        outs = None  # port race / hung join: caller may retry
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            try:
+                p.communicate(timeout=10)
+            except Exception:
+                pass
+    return procs, outs
+
+
+def test_two_process_trace_shards_merge(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER.format(repo=_REPO))
+    trace_base = str(tmp_path / "trace.jsonl")
+    procs, outs = _run_world(worker, trace_base, attempt_timeout=120)
+    if outs is None:
+        procs, outs = _run_world(worker, trace_base, attempt_timeout=240)
+    assert outs is not None, "world never formed (twice)"
+    for i, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{o[-3000:]}"
+
+    shard0 = tmp_path / "trace.p0.jsonl"
+    shard1 = tmp_path / "trace.p1.jsonl"
+    assert shard0.exists() and shard1.exists(), sorted(
+        p.name for p in tmp_path.iterdir())
+    # no provisional leftovers: every shard settled on its final name
+    assert not [p.name for p in tmp_path.iterdir() if ".ptmp" in p.name]
+    for pid, shard in enumerate((shard0, shard1)):
+        recs = [json.loads(ln) for ln in shard.read_text().splitlines()]
+        names = [r.get("name") for r in recs]
+        assert "clock_align" in names, names  # the init_multihost barrier
+        assert "rank_work" in names
+        aligns = [r for r in recs if r.get("name") == "clock_align"]
+        assert aligns[0]["args"]["process"] == pid
+        assert aligns[0]["args"]["nproc"] == 2
+
+    res = trace_merge.merge(trace_merge.expand_shards([trace_base]))
+    assert res["mode"] == "clock_align"
+    evs = res["doc"]["traceEvents"]
+    assert {e["pid"] for e in evs} == {0, 1}  # one track per process
+    # both ranks' spans survive the merge on one timeline
+    spans = {(e["pid"], e["name"]) for e in evs if e.get("ph") == "X"}
+    assert (0, "rank_work") in spans and (1, "rank_work") in spans
+    # the aligned clock_align instants coincide (barrier exit skew only)
+    aligns = [e["ts"] for e in evs if e.get("name") == "clock_align"]
+    assert len(aligns) == 2 and abs(aligns[0] - aligns[1]) < 1e-6
+    assert os.path.exists(res["out_path"])

@@ -63,8 +63,27 @@ def probe(timeout_s: int = 120) -> bool:
     return bench._probe_tpu(timeout_s)
 
 
+# kept in sync with dbcsr_tpu.obs.OBS_SCHEMA_VERSION — a literal, NOT
+# an import: importing dbcsr_tpu.obs in THIS process would env-activate
+# a trace session when DBCSR_TPU_TRACE is set (obs/tracer.py), and the
+# loop driver must never open shards meant for its bench subprocesses
+_OBS_SCHEMA_VERSION = 2
+
+
 def _append(path: str, obj: dict) -> None:
     obj = dict(obj, ts=time.strftime("%Y-%m-%dT%H:%M:%S"))
+    # comparability stamps for tools/perf_gate.py: every committed
+    # capture row names the obs schema and jax version it was produced
+    # under (device_kind comes from the subprocess's own result dict —
+    # resolving it HERE would initialize a backend in the loop driver)
+    obj.setdefault("obs_schema", _OBS_SCHEMA_VERSION)
+    if "jax_version" not in obj:
+        try:
+            import jax  # version only; does not init a backend
+
+            obj["jax_version"] = jax.__version__
+        except Exception:
+            pass
     with open(path, "a") as fh:
         fh.write(json.dumps(obj) + "\n")
 

@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Merge per-process dbcsr_tpu trace shards into ONE Chrome trace.
+
+A multihost run under ``DBCSR_TPU_TRACE=trace.jsonl`` leaves one JSONL
+shard per process (``trace.p0.jsonl``, ``trace.p1.jsonl``, ... — see
+`obs/tracer.py`).  Each shard's clock is a process-local monotonic
+counter, so the shards cannot simply be concatenated.  This tool puts
+them on one timeline and emits a single Perfetto-loadable Chrome
+``trace_event`` JSON with **one track (pid) per process**:
+
+* **Alignment** — every shard records the ``clock_align`` instant that
+  `parallel.multihost.init_multihost` emits from behind a world
+  barrier: the same physical moment on every process.  Shard
+  timestamps are shifted so those instants coincide.  Shards without
+  the instant (single-process runs, pre-join crashes) fall back to
+  wall-clock alignment via the meta line's ``t0_unix``.
+* **Track identity** — a shard's process index comes from its LAST
+  ``meta`` line carrying ``pid`` (the authoritative one: provisional
+  shards re-stamp their index once the world forms), falling back to
+  the ``.pN.`` filename tag, then to enumeration order.
+
+Usage:
+    python tools/trace_merge.py trace.p0.jsonl trace.p1.jsonl [-o OUT]
+    python tools/trace_merge.py trace.jsonl            # globs trace.p*.jsonl
+    python tools/trace_merge.py 'trace.p*.jsonl'       # explicit glob
+
+Default OUT is ``<base>.merged.chrome.json`` next to the first shard.
+No dbcsr_tpu import required: the JSONL schema is the contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def expand_shards(args: list) -> list:
+    """Resolve CLI args (files, globs, or a shard BASE path) to a
+    sorted list of shard files."""
+    paths: list = []
+    for arg in args:
+        hits = sorted(glob.glob(arg))
+        if not hits and not re.search(r"\.p\d+\.", os.path.basename(arg)):
+            # a base path like trace.jsonl: expand to its shard family,
+            # excluding unsettled provisional shards (a run that
+            # crashed before its index resolved leaves a .ptmp* file —
+            # pass it explicitly to include it)
+            root, ext = os.path.splitext(arg)
+            hits = [h for h in sorted(glob.glob(f"{root}.p*{ext}"))
+                    if ".ptmp" not in os.path.basename(h)]
+        if not hits and os.path.exists(arg):
+            hits = [arg]
+        paths.extend(hits)
+    # de-dup, keep order, drop chrome exports the glob may have caught
+    seen = set()
+    out = []
+    for p in paths:
+        if p in seen or p.endswith(".chrome.json"):
+            continue
+        seen.add(p)
+        out.append(p)
+    return out
+
+
+def read_shard(path: str) -> dict:
+    """Parse one shard: events + identity + alignment anchors."""
+    events = []
+    bad_lines = 0
+    pid = None
+    t0_unix = None
+    align_ts = None
+    align_unix = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                bad_lines += 1  # torn tail line (killed mid-append)
+                continue
+            ev = rec.get("ev")
+            if ev == "meta":
+                if "pid" in rec:
+                    pid = int(rec["pid"])  # LAST meta pid wins
+                if t0_unix is None and "t0_unix" in rec:
+                    t0_unix = float(rec["t0_unix"])
+                continue
+            if ev == "instant" and rec.get("name") == "clock_align":
+                align_ts = float(rec.get("ts_us", 0.0))
+                align_unix = float((rec.get("args") or {}).get("t_unix", 0))
+            events.append(rec)
+    if pid is None:
+        m = re.search(r"\.p(\d+)\.", os.path.basename(path))
+        pid = int(m.group(1)) if m else None
+    return {
+        "path": path,
+        "pid": pid,
+        "t0_unix": t0_unix,
+        "align_ts_us": align_ts,
+        "align_unix": align_unix,
+        "events": events,
+        "bad_lines": bad_lines,
+    }
+
+
+def compute_offsets(shards: list) -> str:
+    """Set each shard's ``offset_us`` (added to every local timestamp)
+    so all shards share one timeline.  Alignment is PER SHARD: shards
+    carrying the barrier's ``clock_align`` instant coincide exactly on
+    it (anchored to the barrier's wall-clock time, so they also sit
+    correctly next to wall-clock-only shards); shards without one (a
+    process that crashed before the world formed, single-process runs)
+    fall back to their ``t0_unix`` enable time.  Returns the mode:
+    ``clock_align`` (all barrier-aligned), ``mixed``, or ``t0_unix``."""
+    t0s = [s["t0_unix"] for s in shards if s["t0_unix"] is not None]
+    aligned = [s for s in shards if s["align_ts_us"] is not None]
+    # one common barrier wall-time for the whole aligned group: their
+    # clock_align instants must land on ONE point (barrier exit skew is
+    # what the barrier removes; per-shard align_unix would reintroduce
+    # it).  t_ref anchors the merged origin at the EARLIEST wall-clock
+    # anchor — offsets stay seconds-scale, not epoch-scale, so double
+    # rounding cannot smear the coincidence.
+    unixes = [s["align_unix"] for s in aligned if s["align_unix"]]
+    t_bar = max(unixes) if unixes else (min(t0s) if t0s else 0.0)
+    t_ref = min(t0s + ([t_bar] if aligned else [])) if (t0s or aligned) \
+        else 0.0
+    for s in aligned:
+        s["offset_us"] = (t_bar - t_ref) * 1e6 - s["align_ts_us"]
+    for s in shards:
+        if s["align_ts_us"] is None:
+            s["offset_us"] = ((s["t0_unix"] or t_ref) - t_ref) * 1e6
+    # keep the merged timeline non-negative (Perfetto dislikes ts < 0)
+    starts = [
+        s["offset_us"] + min((e.get("ts_us", 0.0) for e in s["events"]),
+                             default=0.0)
+        for s in shards
+    ]
+    if starts and min(starts) < 0:
+        shift = -min(starts)
+        for s in shards:
+            s["offset_us"] += shift
+    if len(aligned) == len(shards):
+        return "clock_align"
+    return "mixed" if aligned else "t0_unix"
+
+
+def chrome_events(shards: list) -> list:
+    """Native shard records -> Chrome ``trace_event`` dicts, one pid
+    per shard, timestamps on the merged timeline."""
+    out = []
+    for s in shards:
+        pid = s["pid"]
+        off = s["offset_us"]
+        out.append({"ph": "M", "name": "process_name", "pid": pid,
+                    "args": {"name": f"process {pid} "
+                                     f"({os.path.basename(s['path'])})"}})
+        out.append({"ph": "M", "name": "process_sort_index", "pid": pid,
+                    "args": {"sort_index": pid}})
+        for rec in s["events"]:
+            ev = rec.get("ev")
+            if ev == "span":
+                ce = {
+                    "name": rec["name"],
+                    "cat": "dbcsr_tpu",
+                    "ph": "X",
+                    "ts": rec["ts_us"] + off,
+                    "dur": rec["dur_us"],
+                    "pid": pid,
+                    "tid": rec.get("tid", 0),
+                }
+                if rec.get("attrs"):
+                    ce["args"] = rec["attrs"]
+                out.append(ce)
+            elif ev == "instant":
+                ce = {
+                    "name": rec["name"],
+                    "cat": "dbcsr_tpu",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": rec["ts_us"] + off,
+                    "pid": pid,
+                    "tid": rec.get("tid", 0),
+                }
+                if rec.get("args"):
+                    ce["args"] = rec["args"]
+                out.append(ce)
+    return out
+
+
+def merge(paths: list, out_path: str | None = None) -> dict:
+    """Merge shard files into one Chrome trace document; returns
+    {"doc", "out_path", "shards", "mode"}."""
+    shards = [read_shard(p) for p in paths]
+    # fill missing identities by enumeration AND disambiguate clashes:
+    # two shards claiming one pid (e.g. a stale provisional shard whose
+    # meta says 0 next to a real p0) must not interleave on one track —
+    # first claimant keeps the pid, later ones move to the next free
+    used: set = set()
+    nxt = 0
+    for s in shards:
+        if s["pid"] is not None and s["pid"] not in used:
+            used.add(s["pid"])
+            continue
+        while nxt in used:
+            nxt += 1
+        s["pid"] = nxt
+        used.add(nxt)
+    mode = compute_offsets(shards)
+    doc = {
+        "traceEvents": chrome_events(shards),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "dbcsr_tpu tools/trace_merge.py",
+            "alignment": mode,
+            "shards": [
+                {"path": os.path.basename(s["path"]), "pid": s["pid"],
+                 "events": len(s["events"]),
+                 "offset_us": round(s["offset_us"], 1),
+                 "bad_lines": s["bad_lines"]}
+                for s in shards
+            ],
+        },
+    }
+    if out_path is None:
+        base = re.sub(r"\.p\d+(\.[^.]+)$", r"\1", paths[0])
+        root, _ = os.path.splitext(base)
+        out_path = root + ".merged.chrome.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f, default=str)
+    return {"doc": doc, "out_path": out_path, "shards": shards,
+            "mode": mode}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Merge per-process dbcsr_tpu trace shards into one "
+                    "Chrome trace (one track per process)")
+    ap.add_argument("paths", nargs="+",
+                    help="shard files, globs, or the shard base path")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output Chrome JSON (default: "
+                         "<base>.merged.chrome.json)")
+    args = ap.parse_args(argv)
+    paths = expand_shards(args.paths)
+    if not paths:
+        print(f"error: no shard files match {args.paths}", file=sys.stderr)
+        return 1
+    res = merge(paths, args.out)
+    for s in res["shards"]:
+        print(f" shard {os.path.basename(s['path'])}: pid={s['pid']} "
+              f"{len(s['events'])} events offset={s['offset_us']:.1f} us"
+              + (f" ({s['bad_lines']} unparseable lines)"
+                 if s["bad_lines"] else ""))
+    print(f" alignment: {res['mode']}")
+    print(f" merged {len(paths)} shard(s) -> {res['out_path']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,14 @@ Reads the event stream a traced run left behind
 
 Usage:
     python tools/trace_summary.py trace.jsonl [--json] [--top N]
+    python tools/trace_summary.py trace.p0.jsonl trace.p1.jsonl ...
+    python tools/trace_summary.py 'trace.p*.jsonl'
+
+Multiple files (or a glob, or a shard BASE path like ``trace.jsonl``
+whose per-process shards ``trace.p*.jsonl`` exist — see `obs/tracer.py`)
+aggregate across processes, with a per-process event/span breakdown on
+top of the combined tables.  A single existing file keeps the original
+single-file summary shape byte-for-byte.
 
 ``--json`` emits one machine-readable JSON object instead of tables.
 No dbcsr_tpu import required: the JSONL schema is the contract.
@@ -23,8 +31,33 @@ No dbcsr_tpu import required: the JSONL schema is the contract.
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
+import os
+import re
 import sys
+
+
+def expand_paths(args: list) -> list:
+    """Resolve CLI args (files, globs, or a shard base path) to a list
+    of trace files.  A single arg naming an existing file stays a
+    single-file summary; otherwise globs and the ``<base>.p*<ext>``
+    shard family are expanded."""
+    paths: list = []
+    for arg in args:
+        if os.path.exists(arg):
+            paths.append(arg)
+            continue
+        hits = sorted(_glob.glob(arg))
+        if not hits and not re.search(r"\.p\d+\.", os.path.basename(arg)):
+            # shard-family expansion skips unsettled .ptmp* shards
+            # (crashed-before-rebind leftovers; pass them explicitly)
+            root, ext = os.path.splitext(arg)
+            hits = [h for h in sorted(_glob.glob(f"{root}.p*{ext}"))
+                    if ".ptmp" not in os.path.basename(h)]
+        paths.extend(h for h in hits if not h.endswith(".chrome.json"))
+    seen: set = set()
+    return [p for p in paths if not (p in seen or seen.add(p))]
 
 
 def summarize(path: str) -> dict:
@@ -85,10 +118,69 @@ def summarize(path: str) -> dict:
     }
 
 
+def summarize_many(paths: list) -> dict:
+    """Aggregate several shard files (one per process) into one summary
+    with the same table shapes as `summarize`, plus a ``per_process``
+    breakdown.  One path delegates to `summarize` unchanged (the
+    single-file contract stays byte-compatible)."""
+    if len(paths) == 1:
+        return summarize(paths[0])
+    agg = {
+        "paths": list(paths),
+        "path": paths[0],
+        "events": 0,
+        "bad_lines": 0,
+        "phases": {},
+        "jit_compiles": {},
+        "stacks_by_driver": {},
+        "comm": {},
+        "per_process": {},
+    }
+    for path in paths:
+        s = summarize(path)
+        agg["events"] += s["events"]
+        agg["bad_lines"] += s["bad_lines"]
+        for name, p in s["phases"].items():
+            ap = agg["phases"].setdefault(
+                name, {"calls": 0, "total_ms": 0.0, "max_ms": 0.0})
+            ap["calls"] += p["calls"]
+            ap["total_ms"] = round(ap["total_ms"] + p["total_ms"], 3)
+            ap["max_ms"] = max(ap["max_ms"], p["max_ms"])
+        for fn, n in s["jit_compiles"].items():
+            agg["jit_compiles"][fn] = agg["jit_compiles"].get(fn, 0) + n
+        for d, v in s["stacks_by_driver"].items():
+            ad = agg["stacks_by_driver"].setdefault(
+                d, {"stacks": 0, "entries": 0})
+            ad["stacks"] += v["stacks"]
+            ad["entries"] += v["entries"]
+        for k, v in s["comm"].items():
+            ac = agg["comm"].setdefault(k, {"messages": 0, "bytes": 0})
+            ac["messages"] += v["messages"]
+            ac["bytes"] += v["bytes"]
+        agg["per_process"][os.path.basename(path)] = {
+            "events": s["events"],
+            "spans": sum(p["calls"] for p in s["phases"].values()),
+            "span_ms": round(sum(p["total_ms"]
+                                 for p in s["phases"].values()), 3),
+        }
+    for p in agg["phases"].values():
+        p["mean_ms"] = round(p["total_ms"] / max(p["calls"], 1), 3)
+    return agg
+
+
 def print_summary(s: dict, out=print, top: int = 20) -> None:
-    out(f" trace: {s['path']}  ({s['events']} events"
+    label = (f"{len(s['paths'])} shards ({', '.join(s['paths'])})"
+             if "paths" in s else s["path"])
+    out(f" trace: {label}  ({s['events']} events"
         + (f", {s['bad_lines']} unparseable lines" if s["bad_lines"] else "")
         + ")")
+    if s.get("per_process"):
+        out(" " + "-" * 72)
+        out(f" {'PROCESS SHARD':<32} {'EVENTS':>9} {'SPANS':>9} "
+            f"{'SPAN ms':>11}")
+        for name, v in sorted(s["per_process"].items()):
+            out(f" {name:<32} {v['events']:>9} {v['spans']:>9} "
+                f"{v['span_ms']:>11.3f}")
     out(" " + "-" * 72)
     out(f" {'PHASE':<32} {'CALLS':>7} {'TOTAL ms':>11} {'MEAN ms':>9} "
         f"{'MAX ms':>9}")
@@ -117,15 +209,21 @@ def print_summary(s: dict, out=print, top: int = 20) -> None:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="Summarize a dbcsr_tpu obs trace JSONL")
-    ap.add_argument("path", help="trace JSONL written by obs.tracer")
+        description="Summarize a dbcsr_tpu obs trace JSONL "
+                    "(or several per-process shards)")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="trace JSONL file(s), glob, or shard base path")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON object instead of tables")
     ap.add_argument("--top", type=int, default=20,
                     help="rows per table (default 20)")
     args = ap.parse_args(argv)
+    paths = expand_paths(args.paths)
+    if not paths:
+        print(f"error: no trace files match {args.paths}", file=sys.stderr)
+        return 1
     try:
-        s = summarize(args.path)
+        s = summarize_many(paths)
     except OSError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
