@@ -24,13 +24,46 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 CPU_BASELINE_GFLOPS = 2.98  # north-star config, this host, XLA-CPU f64
 
+_resilience_mods = {}
 
-def _probe_tpu(timeout_s: int) -> bool:
+
+def _load_resilience(name: str):
+    """Load a `dbcsr_tpu.resilience` module (stdlib-only by contract)
+    STANDALONE, by file path — importing the package would pull in the
+    full engine + `dbcsr_tpu.obs`, whose import env-activates a trace
+    session; the capture-loop driver reuses these helpers and must
+    never open trace shards meant for its bench subprocesses."""
+    mod = _resilience_mods.get(name)
+    if mod is None:
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "dbcsr_tpu", "resilience", f"{name}.py")
+        spec = importlib.util.spec_from_file_location(
+            f"_dbcsr_tpu_resilience_{name}", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _resilience_mods[name] = mod
+    return mod
+
+
+def _probe_tpu(timeout_s: int, watchdog=None) -> bool:
     """Try backend init in a subprocess: a hung tunnel cannot be caught
     with try/except in-process, so probe out-of-process with a hard
-    timeout before committing this process to JAX_PLATFORMS=axon."""
+    timeout before committing this process to JAX_PLATFORMS=axon.
+
+    The probe rides the resilience watchdog: the attempt is
+    deadline-guarded, the outcome classified (OK / SLOW / TRANSIENT /
+    WEDGED) and — when ``watchdog`` is passed (or
+    ``DBCSR_TPU_WATCHDOG_STATE`` names a JSONL path) — persisted, so a
+    restarted capture loop resumes its wedge-streak backoff instead of
+    hammering a dead tunnel on a fixed cadence.  ``probe`` fault specs
+    (``DBCSR_TPU_FAULTS=probe:fail,times=N``) simulate failure streaks
+    without hardware."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         return False
+    faults = _load_resilience("faults")
+    wd_mod = _load_resilience("watchdog")
     # real round-trip, not just backend init: the axon tunnel has been
     # observed in states where devices() answers but any array
     # create+fetch hangs forever (see PERF_NOTES.md) — such a session
@@ -40,16 +73,32 @@ def _probe_tpu(timeout_s: int) -> bool:
         "assert jax.devices()[0].platform != 'cpu'; "
         "x = jnp.arange(8.0); assert float(np.asarray(x)[3]) == 3.0"
     )
-    try:
+
+    def _attempt(deadline_s):
+        # injected probe-failure streaks fire INSIDE the guard so the
+        # watchdog books them as real wedges (streak, backoff,
+        # persistence — the machinery the fault kind exists to drive)
+        if faults.active() and faults.fail_probe("probe"):
+            raise wd_mod.DeadlineExceeded("injected probe failure streak")
         r = subprocess.run(
             [sys.executable, "-c", code],
-            timeout=timeout_s,
+            timeout=deadline_s,
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
         )
-        return r.returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+        if r.returncode != 0:
+            raise RuntimeError(f"probe subprocess rc={r.returncode}")
+        return True
+
+    if watchdog is None:
+        watchdog = wd_mod.Watchdog(
+            "tpu_probe", deadline_s=timeout_s,
+            state_path=os.environ.get("DBCSR_TPU_WATCHDOG_STATE"),
+        )
+    else:
+        watchdog.deadline_s = float(timeout_s)
+    res = watchdog.guard(_attempt)
+    return res.ok
 
 
 def _pick_carve_from_evidence() -> str:

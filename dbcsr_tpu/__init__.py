@@ -44,6 +44,7 @@ from dbcsr_tpu.core.dist import (
 from dbcsr_tpu.core.matrix import BlockIterator, BlockSparseMatrix, create
 from dbcsr_tpu.mm.multiply import multiply
 from dbcsr_tpu import obs
+from dbcsr_tpu import resilience
 from dbcsr_tpu.ops.operations import (
     FUNC_ARTANH,
     FUNC_ASIN,
@@ -190,6 +191,7 @@ __all__ = [
     "multiply",
     "new_transposed",
     "obs",
+    "resilience",
     "print_block_sum",
     "print_config",
     "print_matrix",

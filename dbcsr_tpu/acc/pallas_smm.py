@@ -39,6 +39,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dbcsr_tpu.utils.compat import enable_x64 as _enable_x64
+
 _SUPPORTED = (np.dtype(np.float32), np.dtype(jnp.bfloat16))
 
 
@@ -281,7 +283,7 @@ def process_stack_pallas(
         # Mosaic fails to legalize scalar-prefetch index maps traced under
         # jax_enable_x64 (i64 SMEM index loads); the kernel only touches
         # f32/bf16 data and i32 indices, so trace with x64 off.
-        with jax.enable_x64(False):
+        with _enable_x64(False):
             c_data = _pallas_process(
                 c_data, a_data, b_data,
                 jnp.asarray(a_c), jnp.asarray(b_c), jnp.asarray(c_c),
@@ -724,7 +726,7 @@ def process_stack_crosspack(
     alpha_arr = jnp.asarray([[alpha]], dtype=jnp.float32)
     launch_fn = _pallas_crosspack_vmem if vmem_resident else _pallas_crosspack
     for lc in launches:
-        with jax.enable_x64(False):
+        with _enable_x64(False):
             outs = launch_fn(
                 c_data, a_data_t, b_data,
                 jnp.asarray(lc["ai"]), jnp.asarray(lc["bi"]),

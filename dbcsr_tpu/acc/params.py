@@ -156,6 +156,24 @@ def lookup(m: int, n: int, k: int, dtype,
     )
 
 
+_onchip_flag: Dict[tuple, bool] = {}  # (path, generation) -> any-onchip
+
+
+def _table_has_onchip() -> bool:
+    """Whether the resolved table holds ANY onchip-tagged row, memoized
+    per (path, generation) — predict() consults this on the dispatch
+    hot path before its own memo cache."""
+    key = (params_path(), _table_gen)
+    with _lock:
+        flag = _onchip_flag.get(key)
+    if flag is None:
+        flag = any(e.get("env") == "onchip" for e in _load().values())
+        with _lock:
+            _onchip_flag.clear()  # one generation kept, like _shape_index
+            _onchip_flag[key] = flag
+    return flag
+
+
 # a donor entry only predicts for shapes within this flop-count ratio;
 # farther shapes get no opinion (the default dispatch heuristics apply)
 _PREDICT_MAX_FLOP_RATIO = 16.0
@@ -180,14 +198,22 @@ def predict(m: int, n: int, k: int, dtype,
     import numpy as np
 
     exact = lookup(m, n, k, dtype, stack_size)
-    if exact is not None and exact.get("env") == "onchip":
-        return exact
-    # exact row exists but is not proven on-chip (tunnel-latency-bound,
-    # cpu-measured, or a legacy untagged row — ONE policy for missing
-    # env, matching _prefer_onchip's quarantine; ADVICE r5): fall
-    # through to the donor pool, where an onchip donor (any shape in
-    # range) mutes it; with no onchip donor the exact row wins through
-    # the exact-shape tie-break term below
+    if exact is not None:
+        if exact.get("env") == "onchip":
+            return exact
+        # exact row exists but is not proven on-chip (tunnel-latency-
+        # bound, cpu-measured, or a legacy untagged row — ONE policy
+        # for missing env, matching _prefer_onchip's quarantine; ADVICE
+        # r5): trust it outright only when the table holds no onchip
+        # evidence AT ALL (then the donor-pool walk below would
+        # re-select it through the exact-shape tie-break anyway);
+        # otherwise fall through to the pool, where any onchip donor in
+        # range mutes it
+        try:
+            if not _table_has_onchip():
+                return exact
+        except Exception:
+            return exact
     # keyed by the resolved params file so env-redirected tables (tests,
     # DBCSR_TPU_PARAMS_DIR) never serve stale predictions.  Exact S in
     # the key: the engine buckets stack lengths already, so distinct S

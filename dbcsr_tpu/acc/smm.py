@@ -25,7 +25,9 @@ Key differences from the CUDA design, by intent:
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -37,6 +39,10 @@ from dbcsr_tpu.core.kinds import real_dtype_of
 from dbcsr_tpu.obs import costmodel as _costmodel
 from dbcsr_tpu.obs import flight as _flight
 from dbcsr_tpu.obs import metrics as _metrics
+from dbcsr_tpu.obs import tracer as _trace
+from dbcsr_tpu.resilience import breaker as _breaker
+from dbcsr_tpu.resilience import faults as _faults
+from dbcsr_tpu.utils.compat import enable_x64 as _enable_x64
 from dbcsr_tpu.utils.rounding import bucket_size
 
 
@@ -319,7 +325,8 @@ class StackPlan:
     __slots__ = ("driver", "nseg", "xla_idx", "launches", "r_grp",
                  "a_pad_row", "b_pad_row", "append_a_pad", "append_b_pad",
                  "val_idx", "group_idx", "kmerge", "pack", "cross_launches",
-                 "cross_vmem", "cross_src", "host_idx")
+                 "cross_vmem", "cross_src", "host_idx", "src_idx",
+                 "src_pads")
 
     def __init__(self):
         self.driver = "xla"
@@ -341,6 +348,10 @@ class StackPlan:
                                  # the compile-failure demotion rebuild
         self.host_idx = None     # host: numpy (ai, bi, ci) for the
                                  # native C++ stack driver
+        self.src_idx = None      # host (ai, bi, ci) retained for the
+                                 # breaker failover rebuild (any driver)
+        self.src_pads = (None, None)  # the (a_pad_row, b_pad_row)
+                                 # prepare_stack was originally given
 
     def nbytes(self) -> int:
         """Approximate device bytes pinned by this plan (cache budget)."""
@@ -362,6 +373,8 @@ class StackPlan:
             total += sum(int(x.nbytes) for x in self.cross_src)
         if self.host_idx is not None:  # host bytes
             total += sum(int(x.nbytes) for x in self.host_idx)
+        if self.src_idx is not None:  # host bytes (failover payload)
+            total += sum(int(x.nbytes) for x in self.src_idx)
         return total
 
 
@@ -382,8 +395,48 @@ def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
                   a_pad_row=None, b_pad_row=None) -> Optional[StackPlan]:
     """Host side of stack processing: driver selection (tuned table +
     prediction), grouping/chunking/padding, and upload of the int32
-    index arrays.  Returns None for an empty stack."""
-    cfg = get_config()
+    index arrays.  Returns None for an empty stack.
+
+    The returned plan retains a host copy of the index arrays
+    (``src_idx``) so `execute_stack`'s breaker failover can rebuild it
+    for a different driver without the engine re-deriving the stack.
+    A planning failure (injected, or a real host-side grouping bug)
+    re-plans once on the safe XLA path instead of killing the
+    multiply."""
+    try:
+        if _faults.active():
+            _faults.maybe_inject("prepare_stack")
+        plan = _prepare_stack_impl(c_data, a_data, b_data, a_idx, b_idx,
+                                   c_idx, a_pad_row=a_pad_row,
+                                   b_pad_row=b_pad_row)
+    except Exception as exc:  # noqa: BLE001 — classified + recorded
+        shape_key = _stack_shape_key(c_data, a_data, b_data)
+        _record_driver_failure("prepare", _classify_failure(exc), exc,
+                               shape_key)
+        plan = _prepare_stack_impl(c_data, a_data, b_data, a_idx, b_idx,
+                                   c_idx, a_pad_row=a_pad_row,
+                                   b_pad_row=b_pad_row,
+                                   cfg=_forced_cfg("xla"))
+        _record_fallback("prepare", plan.driver if plan else "none",
+                         shape_key)
+    if plan is not None and plan.src_idx is None:
+        plan.src_idx = (
+            np.ascontiguousarray(a_idx, np.int32),
+            np.ascontiguousarray(b_idx, np.int32),
+            np.ascontiguousarray(c_idx, np.int32),
+        )
+        plan.src_pads = (a_pad_row, b_pad_row)
+    return plan
+
+
+def _prepare_stack_impl(c_data, a_data, b_data, a_idx, b_idx, c_idx,
+                        a_pad_row=None, b_pad_row=None,
+                        cfg=None) -> Optional[StackPlan]:
+    """Driver selection + plan construction.  ``cfg`` overrides the
+    live config — the failover path passes a copy with ``mm_driver``
+    forced so one rebuild targets one specific chain driver."""
+    if cfg is None:
+        cfg = get_config()
     S = len(a_idx)
     if S == 0:
         return None
@@ -753,14 +806,279 @@ def _capture_stack_xla_cost(fn_name, key, jit_fn, args, c_data, a_data,
     costmodel.capture_xla_cost(fn_name, key, jit_fn, args, model=model)
 
 
+# safety-ordered stack-driver chain (the reference's unsupported-kernel
+# fallback, `libsmm_acc.cpp:227-249`, made dynamic): a failing driver's
+# stack re-executes on the next entry that is available and whose
+# breaker admits it.  "host" is last — correct everywhere a native lib
+# exists, never fast.
+_FAILOVER_CHAIN = ("pallas_cross", "pallas", "xla_group", "xla_flat",
+                   "xla", "host")
+
+
+class CorruptedOutputError(RuntimeError):
+    """A stack driver returned non-finite output blocks (detected by
+    the opt-in post-execution output check)."""
+
+
+def _forced_cfg(driver: str):
+    """A config copy that steers `_prepare_stack_impl` to exactly one
+    chain driver (xla_flat is the xla driver + flat_gather layout)."""
+    cfg = get_config()
+    if driver == "xla_flat":
+        return dataclasses.replace(cfg, mm_driver="xla", flat_gather=True)
+    if driver == "xla":
+        return dataclasses.replace(cfg, mm_driver="xla", flat_gather=False)
+    return dataclasses.replace(cfg, mm_driver=driver)
+
+
+def _classify_failure(exc: BaseException) -> str:
+    """Failure taxonomy feeding the breaker and the
+    ``dbcsr_tpu_driver_failures_total{driver,kind}`` counter."""
+    if isinstance(exc, KernelValidationError):
+        return "validation"
+    if isinstance(exc, CorruptedOutputError):
+        return "nan"
+    msg = f"{type(exc).__name__}: {exc}"
+    if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+        return "oom"
+    return "runtime"
+
+
+# production finite-output checking is an import-time opt-in: a per-
+# launch os.environ lookup would eat the trace-off budget (hot path)
+_CHECK_OUTPUTS_ENV = os.environ.get("DBCSR_TPU_CHECK_OUTPUTS") == "1"
+
+
+def _output_checks_enabled() -> bool:
+    """Post-execution finite-output check: always on under fault
+    injection (the chaos suites rely on NaN corruption being CAUGHT),
+    opt-in for production via DBCSR_TPU_CHECK_OUTPUTS=1 at process
+    start (costs one device reduction + sync per stack launch)."""
+    return _CHECK_OUTPUTS_ENV or _faults.active()
+
+
+def _output_corrupted(out) -> bool:
+    if not jnp.issubdtype(out.dtype, jnp.inexact):
+        return False
+    return not bool(jnp.all(jnp.isfinite(
+        jnp.sum(out, axis=tuple(range(1, out.ndim))))))
+
+
+def _is_deleted(x) -> bool:
+    f = getattr(x, "is_deleted", None)
+    try:
+        return bool(f()) if callable(f) else False
+    except Exception:
+        return False
+
+
+def _chain_candidates(failed: str, c_data, a_data, b_data) -> list:
+    """Every OTHER driver that can run this stack, safer ones first:
+    the chain entries after ``failed``, then — so a failure of the
+    safest available driver still has somewhere to go — the entries
+    before it in DESCENDING safety order (for failed='host' that is
+    xla, xla_flat, xla_group, …).  Breaker admission is checked per
+    attempt."""
+    try:
+        i = _FAILOVER_CHAIN.index(failed)
+        rest = (_FAILOVER_CHAIN[i + 1:]
+                + tuple(reversed(_FAILOVER_CHAIN[:i])))
+    except ValueError:  # unknown driver name: anything qualifies
+        rest = _FAILOVER_CHAIN
+    out = []
+    for drv in rest:
+        if drv == failed:
+            continue
+        if drv == "host":
+            if _host_smm_available(c_data.dtype):
+                out.append(drv)
+        elif drv in ("pallas", "pallas_cross"):
+            if _pallas_supported(_forced_cfg(drv), c_data, a_data, b_data):
+                out.append(drv)
+        else:
+            out.append(drv)
+    return out
+
+
+def _record_driver_failure(driver: str, kind: str, exc, shape_key) -> None:
+    _metrics.counter(
+        "dbcsr_tpu_driver_failures_total",
+        "stack-driver execution failures by driver and failure kind",
+    ).inc(driver=driver, kind=kind)
+    _trace.instant("driver_failure", {
+        "driver": driver, "kind": kind,
+        "shape": "x".join(str(x) for x in shape_key),
+        "error": f"{type(exc).__name__}: {exc}"[:200],
+    })
+    _flight.note_event("driver_failure", driver=driver, kind=kind,
+                       error=f"{type(exc).__name__}: {exc}"[:200])
+
+
+def _record_fallback(from_driver: str, to_driver: str, shape_key) -> None:
+    _metrics.counter(
+        "dbcsr_tpu_driver_fallback_total",
+        "stacks re-executed on a safer driver after a chain failover",
+    ).inc(**{"from": from_driver, "to": to_driver})
+    _trace.instant("driver_failover", {
+        "from": from_driver, "to": to_driver,
+        "shape": "x".join(str(x) for x in shape_key),
+    })
+    _flight.note_event("failover", **{"from": from_driver, "to": to_driver})
+
+
+def _run_candidate(base, a_data, b_data, fb_plan, alpha, c_zero,
+                   checks_on: bool):
+    """Execute one failover candidate (fault hooks apply to fallback
+    drivers too, so injected cascades walk the whole chain).
+
+    ``base`` is ALWAYS copied: the xla-family drivers donate their C
+    argument, so a candidate that dispatches and then fails would
+    otherwise consume the only pristine buffer and poison every later
+    candidate (falsely tripping their breakers).  We are already on
+    the failure path — one C copy per attempt is cheap insurance."""
+    trial = jnp.array(base, copy=True)
+    if _faults.active():
+        _faults.maybe_inject("execute_stack", driver=fb_plan.driver)
+    out = _execute_plan(trial, a_data, b_data, fb_plan, alpha, c_zero)
+    if _faults.active():
+        out = _faults.corrupt("execute_stack", out, driver=fb_plan.driver)
+    if checks_on and _output_corrupted(out):
+        raise CorruptedOutputError(
+            f"driver {fb_plan.driver!r} produced non-finite output blocks")
+    return out
+
+
+def _failover_execute(c_data, a_data, b_data, plan: StackPlan, alpha,
+                      c_zero, exc: Optional[BaseException], base=None):
+    """Re-execute a failed (or quarantined) stack down the driver
+    chain.  ``exc`` is None when the original driver was never
+    attempted (breaker open); ``base`` is the pristine C buffer to
+    restart from (defaults to ``c_data``).  On success the original
+    plan is healed IN PLACE to the surviving driver (the established
+    demotion pattern), so cached plans stop paying the failure."""
+    board = _breaker.get_board()
+    failed = plan.driver
+    shape_key = _stack_shape_key(c_data, a_data, b_data)
+    if base is None:
+        base = c_data
+    checks_on = _output_checks_enabled()
+    if plan.src_idx is None or _is_deleted(base):
+        # no rebuild payload, or the failing launch consumed (donated)
+        # the only copy of C: recovery is impossible from here
+        if exc is not None:
+            raise exc
+        return _execute_plan(base, a_data, b_data, plan, alpha, c_zero)
+    ai, bi, ci = plan.src_idx
+    pad_a, pad_b = plan.src_pads
+    for drv in _chain_candidates(failed, c_data, a_data, b_data):
+        if not board.allow(drv, shape_key):
+            continue
+        try:
+            fb_plan = _prepare_stack_impl(
+                base, a_data, b_data, ai, bi, ci,
+                a_pad_row=pad_a, b_pad_row=pad_b, cfg=_forced_cfg(drv),
+            )
+            if fb_plan is None or fb_plan.driver != drv:
+                continue  # selection refused the force (e.g. host gone)
+            fb_plan.src_idx = plan.src_idx
+            fb_plan.src_pads = plan.src_pads
+            out = _run_candidate(base, a_data, b_data, fb_plan, alpha,
+                                 c_zero, checks_on)
+        except Exception as exc2:  # noqa: BLE001 — classified + recorded
+            kind2 = _classify_failure(exc2)
+            board.record_failure(drv, shape_key, kind=kind2)
+            _record_driver_failure(drv, kind2, exc2, shape_key)
+            continue
+        board.record_success(drv, shape_key)
+        _record_fallback(failed, drv, shape_key)
+        _flight.note_driver(drv, f"failover:{failed}",
+                            mnk=shape_key[:3], entries=len(ai))
+        for slot in StackPlan.__slots__:  # heal the cached plan
+            setattr(plan, slot, getattr(fb_plan, slot))
+        return out
+    # chain exhausted
+    if exc is None:
+        # quarantined entry but nothing safer is available: running the
+        # original driver beats refusing the multiply
+        return _execute_plan(base, a_data, b_data, plan, alpha, c_zero)
+    if _classify_failure(exc) != "validation" and not _is_deleted(base):
+        # last resort: one same-driver retry from the pristine buffer —
+        # transient corruption (the injected-NaN case, a flaky launch)
+        # heals here; proven-deterministic validation failures do not
+        try:
+            out = _run_candidate(base, a_data, b_data, plan, alpha,
+                                 c_zero, checks_on)
+        except Exception:
+            raise exc
+        board.record_success(failed, shape_key)
+        _record_fallback(failed, failed, shape_key)
+        return out
+    raise exc
+
+
 def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
                   c_zero: bool = False):
-    """Device side: run a prepared plan against (possibly new) data.
+    """Device side: run a prepared plan against (possibly new) data,
+    guarded by the resilience layer — injected faults fire here, a
+    raising/corrupting driver is recorded against its per-shape circuit
+    breaker, and the stack re-executes down the failover chain
+    (pallas → xla_group → xla_flat → xla → host) so one bad kernel
+    never poisons the multiply.  With no faults configured and no
+    recorded failures, the added cost is two attribute checks.
 
     ``c_zero``: caller guarantees ``c_data`` is identically zero (the
     engine's beta==0 rebuild, first touch per bin) — the host driver
     then synthesizes its writable buffer as np.zeros instead of
     fetching hundreds of MB of device zeros."""
+    if plan is None:
+        return c_data
+    board = _breaker.get_board()
+    faults_on = _faults.active()
+    checks_on = faults_on or _output_checks_enabled()
+    if not checks_on and not board._breakers:
+        # production fast path: no faults configured, nothing ever
+        # failed — the guard is three attribute checks + this try frame
+        # (the per-shape key construction is deferred to the failure
+        # path; str(dtype) per launch would eat the trace-off budget)
+        try:
+            return _execute_plan(c_data, a_data, b_data, plan, alpha, c_zero)
+        except Exception as exc:  # noqa: BLE001 — classified + recorded
+            shape_key = _stack_shape_key(c_data, a_data, b_data)
+            kind = _classify_failure(exc)
+            board.record_failure(plan.driver, shape_key, kind=kind)
+            _record_driver_failure(plan.driver, kind, exc, shape_key)
+            return _failover_execute(c_data, a_data, b_data, plan, alpha,
+                                     c_zero, exc=exc, base=c_data)
+    shape_key = _stack_shape_key(c_data, a_data, b_data)
+    if not board.allow(plan.driver, shape_key):
+        return _failover_execute(c_data, a_data, b_data, plan, alpha,
+                                 c_zero, exc=None)
+    # the xla drivers donate C: keep a pristine copy while the output
+    # check may condemn a COMPLETED launch (chaos/opt-in mode only)
+    base = jnp.array(c_data, copy=True) if checks_on else c_data
+    try:
+        if faults_on:
+            _faults.maybe_inject("execute_stack", driver=plan.driver)
+        out = _execute_plan(c_data, a_data, b_data, plan, alpha, c_zero)
+        if faults_on:
+            out = _faults.corrupt("execute_stack", out, driver=plan.driver)
+        if checks_on and _output_corrupted(out):
+            raise CorruptedOutputError(
+                f"driver {plan.driver!r} produced non-finite output blocks")
+    except Exception as exc:  # noqa: BLE001 — classified + recorded
+        kind = _classify_failure(exc)
+        board.record_failure(plan.driver, shape_key, kind=kind)
+        _record_driver_failure(plan.driver, kind, exc, shape_key)
+        return _failover_execute(c_data, a_data, b_data, plan, alpha,
+                                 c_zero, exc=exc, base=base)
+    board.record_success(plan.driver, shape_key)
+    return out
+
+
+def _execute_plan(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
+                  c_zero: bool = False):
+    """Run one prepared plan (the driver dispatch proper; failover and
+    fault hooks live in `execute_stack`)."""
     if plan is None:
         return c_data
     compiled, jit_fn_name, jit_key = _record_stack_jit(
@@ -862,7 +1180,7 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
             # good kernel shape — coerce up front
             c_out = jnp.asarray(c_data)
             for lc in plan.cross_launches:
-                with jax.enable_x64(False):
+                with _enable_x64(False):
                     outs = launch_fn(
                         c_out, a_data_t, b_pad,
                         lc["ai"], lc["bi"], lc["cg"], lc["cl"],
@@ -953,7 +1271,7 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
             )
         alpha_arr = jnp.asarray([[alpha]], dtype=jnp.float32)
         interpret = jax.devices()[0].platform != "tpu"
-        with jax.enable_x64(False):
+        with _enable_x64(False):
             for dai, dbi, dci in plan.launches:
                 c_data = _pallas_process(
                     c_data, a_data, b_data, dai, dbi, dci,

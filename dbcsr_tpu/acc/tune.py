@@ -21,6 +21,7 @@ import numpy as np
 
 from dbcsr_tpu.acc import params as params_mod
 from dbcsr_tpu.core.kinds import dtype_of
+from dbcsr_tpu.utils.compat import enable_x64 as _enable_x64
 
 
 def _measure_env() -> str:
@@ -60,7 +61,7 @@ def tune_smm(m: int, n: int, k: int, dtype_enum: int = 1,
 
     # f64 must tune as true f64; scoped so a f32-only host application
     # calling tune_smm() keeps its global x64 setting
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         return _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed,
                              jax, jnp)
 
@@ -229,7 +230,7 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
                     # x64 off during trace: see process_stack_pallas
                     # (Mosaic cannot legalize i64 scalar-prefetch loads)
                     c = jnp.zeros((nc, m, n), dtype)
-                    with jax.enable_x64(False):
+                    with _enable_x64(False):
                         for dai2, dbi2, dci2 in launches:
                             c = pallas_smm._pallas_process(
                                 c, a, b, dai2, dbi2, dci2,
@@ -292,7 +293,7 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
             for vname, vfn in variants:
                 def run_v(P=P, R=R, dev_launches=dev_launches, vfn=vfn):
                     c = jnp.zeros((nc, m, n), dtype)
-                    with jax.enable_x64(False):
+                    with _enable_x64(False):
                         for dai, dbi, dcg, dcl, sidx, lens, nc_out in dev_launches:
                             outs = vfn(
                                 c, a_t, b, dai, dbi, dcg, dcl, alpha32,

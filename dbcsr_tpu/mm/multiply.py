@@ -51,6 +51,7 @@ from dbcsr_tpu.obs import metrics as _metrics
 from dbcsr_tpu.obs import tracer as _trace
 from dbcsr_tpu.ops.operations import compress
 from dbcsr_tpu.ops.transformations import desymmetrize, new_transposed
+from dbcsr_tpu.resilience import faults as _faults
 from dbcsr_tpu.utils.rounding import bucket_size
 
 
@@ -221,7 +222,20 @@ def _multiply_body(a, b, c, alpha, beta, retain_sparsity, filter_eps,
                           allow_chunked=True):
         with timed("multiply_dense"):
             c._mm_algorithm = "dense"
-            return _dense_multiply(a, b, c, alpha, beta)
+            # dense-path failover: the dense MXU route and the stack
+            # path compute the identical product, so a dense failure
+            # (injected or real — compile gap, OOM, corrupted canvas)
+            # degrades to the stack engine instead of killing the
+            # multiply.  Only safe while C is still untouched: the
+            # dense paths restructure C last, and the held-identity
+            # check proves no restructuring happened.
+            held = [b_.data for b_ in c.bins]
+            try:
+                return _dense_multiply(a, b, c, alpha, beta)
+            except Exception as exc:
+                if [id(b_.data) for b_ in c.bins] != [id(d) for d in held]:
+                    raise  # C already restructured: unrecoverable here
+                _note_dense_fallback(exc)
     c._mm_algorithm = "stack"
 
     with timed("multiply_index"):
@@ -408,6 +422,32 @@ def _dense_mode_wanted(a, b, c, filter_eps, retain_sparsity, no_limits,
     if wanted:
         _flight.note("dense_why", "cost-model:emulated-dtype")
     return wanted
+
+
+def _note_dense_fallback(exc: BaseException) -> None:
+    """Record a dense→stack failover, the mm-layer sibling of
+    `acc.smm`'s stack-driver chain — emitted through the same smm
+    helpers so the counter/trace/flight schema stays single-sourced."""
+    from dbcsr_tpu.acc import smm as _smm
+
+    kind = _smm._classify_failure(exc)
+    _smm._record_driver_failure("dense", kind, exc, ())
+    _smm._record_fallback("dense", "stack", ())
+    _flight.note("dense_fallback", f"{type(exc).__name__}: {exc}"[:200])
+
+
+def _dense_guard(x):
+    """Fault hook + opt-in finite check for a dense-path result, BEFORE
+    it is committed into C (so the dense→stack failover sees an
+    untouched C).  One `active()` check when disabled."""
+    if _faults.active():
+        x = _faults.corrupt("dense", x)
+    from dbcsr_tpu.acc import smm as _smm
+
+    if _smm._output_checks_enabled() and _smm._output_corrupted(x):
+        raise _smm.CorruptedOutputError(
+            "dense path produced non-finite output")
+    return x
 
 
 _fill_cache: "OrderedDict" = None  # created lazily; pattern-keyed
@@ -670,6 +710,7 @@ def _dense_multiply_general(a, b, c, alpha, beta) -> int:
         cd = alpha_dev * cd
         if beta != 0 and c.nblks:
             cd = cd + beta_dev * _to_dense_device(c)
+        cd = _dense_guard(cd)
         if profile:
             _ff(cd)
     with timed("dense_carve"):
@@ -783,6 +824,8 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
     """Dense-mode path: scatter blocks to dense, one MXU matmul, carve C
     back into a full block pattern (ref `dbcsr_make_dense` +
     `use_dense_mult`, `dbcsr_mm.F:593-617,770-810`)."""
+    if _faults.active():
+        _faults.maybe_inject("dense")
     for m in (a, b, c):
         if len(np.unique(m.row_blk_sizes)) > 1 or len(np.unique(m.col_blk_sizes)) > 1:
             return _dense_multiply_general(a, b, c, alpha, beta)
@@ -866,6 +909,7 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
             alpha_dev, beta_dev, nbr, nbc, bm, bn,
             carve=_carve_choice(),
         )
+    out = _dense_guard(out)
     with timed("dense_finalize"):
         new_keys = np.arange(nbr * nbc, dtype=np.int64)  # full pattern, row-major
         cap = bucket_size(len(new_keys))
@@ -1008,7 +1052,8 @@ def _dense_multiply_chunked(a, b, c, alpha, beta) -> int:
             nbc=nbc, bm=bm, bn=bn, rows=mrb, carve=_carve_choice(),
         )
         parts.append(out[: (r1 - r0) * nbc])
-    out = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+    out = _dense_guard(
+        jnp.concatenate(parts) if len(parts) > 1 else parts[0])
     new_keys = np.arange(nbr * nbc, dtype=np.int64)
     cap = bucket_size(len(new_keys))
     if cap > len(new_keys):

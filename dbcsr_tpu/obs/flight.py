@@ -84,6 +84,22 @@ def note_driver(driver: str, why: str, mnk=None, entries: int = 0) -> None:
         d.setdefault("mnk", []).append(list(mnk))
 
 
+_MAX_EVENTS_PER_RECORD = 64
+
+
+def note_event(event: str, **fields) -> None:
+    """Append one structured event (fault injected, breaker transition,
+    driver failover) to the innermost open record's bounded ``events``
+    list — the resilience layer's black-box entries.  No-op outside a
+    record."""
+    if not _current:
+        return
+    events = _current[-1].setdefault("events", [])
+    if len(events) >= _MAX_EVENTS_PER_RECORD:
+        return
+    events.append(dict(fields, event=event))
+
+
 def commit(error: str | None = None) -> dict | None:
     """Close the innermost record: stamp duration, per-phase ms and
     memory high-water, then append it to the ring."""
@@ -164,6 +180,9 @@ def dump(out=None, path: str | None = None) -> None:
             for k, v in (r.get("phases_ms") or {}).items()
         )
         err = f"  ERROR={r['error']}" if r.get("error") else ""
+        if r.get("events"):
+            kinds = ",".join(sorted({e["event"] for e in r["events"]}))
+            err += f"  events={len(r['events'])}({kinds})"
         out(f"  #{r['seq']} {r.get('name', '?')} "
             f"{mnk[0]}x{mnk[1]}x{mnk[2]} occ={r.get('occ_c', '-')} "
             f"alg={r.get('algorithm', '?')} drivers=[{drv}] "

@@ -26,19 +26,32 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dbcsr_tpu.core import stats
+from dbcsr_tpu.utils.compat import shard_map as _shard_map
 from dbcsr_tpu.core.timings import timed
 from dbcsr_tpu.obs import costmodel as _costmodel
 from dbcsr_tpu.obs import metrics as _metrics
 from dbcsr_tpu.obs import tracer as _trace
 
 
+def _resolve_mark_varying():
+    """Resolve the device-varying marker ONCE per process: `pcast`
+    (current jax), the deprecated `pvary`, or — on pre-varying-types
+    jax (the pinned 0.4.37), where shard_map tracks replication itself
+    — the identity."""
+    if hasattr(jax.lax, "pcast"):
+        return lambda x, axes: jax.lax.pcast(x, axes, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return lambda x, axes: jax.lax.pvary(x, axes)
+    return lambda x, axes: x
+
+
+_mark_varying = _resolve_mark_varying()
+
+
 def mark_varying(x, axes):
-    """Mark an array device-varying over mesh axes (pcast with a
-    fallback for jax versions that only have the deprecated pvary)."""
-    try:
-        return jax.lax.pcast(x, axes, to="varying")
-    except (AttributeError, TypeError):
-        return jax.lax.pvary(x, axes)
+    """Mark an array device-varying over mesh axes (no-op on jax
+    versions whose shard_map has no varying-axes type system)."""
+    return _mark_varying(x, axes)
 
 
 def _skew_perm(s: int, kind: str):
@@ -162,7 +175,7 @@ def cannon_multiply_dense(mesh: Mesh, a, b, acc_dtype=None):
                 tick_flops=tick["tick_flops"],
             )
         fn = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 functools.partial(
                     _local_cannon, s=s, acc_dtype=acc_dtype or a.dtype
                 ),

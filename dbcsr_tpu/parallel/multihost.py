@@ -22,6 +22,7 @@ path, `dbcsr_mpiwrap.F:130-150`).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
@@ -30,6 +31,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from dbcsr_tpu.obs import tracer as _trace
+from dbcsr_tpu.resilience import faults as _faults
 
 
 def _trace_clock_align() -> None:
@@ -71,10 +73,50 @@ def _trace_clock_align() -> None:
     })
 
 
+def _is_join_timeout(exc: BaseException) -> bool:
+    """Did the coordination service simply never answer?  (vs a config
+    error, which must keep propagating on explicit cluster specs)."""
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    return ("deadline_exceeded" in msg or "timed out" in msg
+            or "timeout" in msg)
+
+
+def _note_degraded_to_serial(exc: BaseException, coordinator, timeout_s) -> None:
+    """Structured degraded-to-serial record: counter + flight-recorder
+    entry + trace instant + a RuntimeWarning — a silently-serial world
+    was round 5's nightmare diagnosis."""
+    import warnings
+
+    from dbcsr_tpu.obs import flight as _flight
+    from dbcsr_tpu.obs import metrics as _metrics
+
+    _metrics.counter(
+        "dbcsr_tpu_multihost_degraded_total",
+        "world joins that failed/timed out and degraded to serial",
+    ).inc(reason="join_timeout" if _is_join_timeout(exc) else "join_error")
+    # a standalone flight record (there is no open multiply here): the
+    # ring then answers "did this process ever actually join a world"
+    _flight.begin(op="multihost_init", name="init_multihost",
+                  coordinator=str(coordinator), timeout_s=timeout_s)
+    _flight.commit(error=f"degraded to serial: {type(exc).__name__}: {exc}")
+    _trace.instant("multihost_degraded_to_serial", {
+        "coordinator": str(coordinator), "timeout_s": timeout_s,
+        "error": f"{type(exc).__name__}: {exc}"[:300],
+    })
+    warnings.warn(
+        f"multihost world join did not complete within {timeout_s}s "
+        f"({type(exc).__name__}: {exc}); DEGRADING TO SERIAL — this "
+        f"process will compute alone",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
 def init_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    timeout_s: Optional[float] = None,
 ) -> bool:
     """Join the multi-host world (ref `mp_world_init`).
 
@@ -82,25 +124,55 @@ def init_multihost(
     TPU pods export it); returns False and stays single-process when
     there is nothing to join — the serial-stub behavior.
 
+    ``timeout_s`` bounds the join (default
+    ``DBCSR_TPU_MULTIHOST_TIMEOUT_S``, 300 s): when the coordination
+    service never answers, the join returns False with a structured
+    degraded-to-serial warning (counter + flight-recorder note) instead
+    of hanging indefinitely.  On an explicit cluster spec, errors that
+    are not timeout-shaped (rank mismatch, double init) still
+    propagate.  Note an unreachable or typo'd coordinator address is
+    indistinguishable from a wedged service — it MANIFESTS as the
+    timeout and therefore degrades too, so callers MUST check the
+    return value (`perf.driver._mp_worker` treats False as rank
+    failure rather than silently computing on a fraction of the data).
+
     When tracing is active, the join also rebinds this process's trace
     shard to its world index and emits the barrier-aligned
     ``clock_align`` instant `tools/trace_merge.py` keys on.
     """
+    if _faults.active():
+        _faults.maybe_inject("multihost_init")
+    if timeout_s is None:
+        try:
+            timeout_s = float(
+                os.environ.get("DBCSR_TPU_MULTIHOST_TIMEOUT_S", "300"))
+        except ValueError:
+            timeout_s = 300.0
     if coordinator_address is not None:
-        # explicit cluster spec: a failed join must NOT silently degrade
-        # to single-process (the multiply would run on a fraction of the
-        # data) — let the error propagate
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                initialization_timeout=int(timeout_s),
+            )
+        except Exception as exc:
+            if not _is_join_timeout(exc):
+                # explicit cluster spec + a NON-timeout failure (config
+                # error): propagate — degrading here would silently run
+                # the multiply on a fraction of the data
+                raise
+            _note_degraded_to_serial(exc, coordinator_address, timeout_s)
+            return False
         _trace_clock_align()
         return True
     try:
-        jax.distributed.initialize()
-    except (ValueError, RuntimeError):
-        # no cluster environment to auto-detect: serial-stub semantics
+        jax.distributed.initialize(initialization_timeout=int(timeout_s))
+    except (ValueError, RuntimeError) as exc:
+        if _is_join_timeout(exc):
+            _note_degraded_to_serial(exc, "<auto-detect>", timeout_s)
+        # else: no cluster environment to auto-detect — the quiet
+        # serial-stub path stays quiet
         return False
     _trace_clock_align()
     return True

@@ -45,6 +45,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dbcsr_tpu.core.matrix import NO_SYMMETRY, BlockSparseMatrix
 from dbcsr_tpu.core.timings import timed
 from dbcsr_tpu.ops.transformations import desymmetrize
+from dbcsr_tpu.resilience import faults as _faults
+from dbcsr_tpu.utils.compat import shard_map as _shard_map
 from dbcsr_tpu.utils.rounding import bucket_size
 
 
@@ -421,7 +423,7 @@ def _run_sparse_mesh(a_panels, b_panels, stacks, c_init, alpha, beta_fac,
         c = (alpha * c + fac * c_in.astype(acc_dtype)).astype(c_in.dtype)
         return c.reshape((1, 1) + c.shape)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -464,6 +466,10 @@ def sparse_multiply_distributed(
     (`dbcsr_mm_cannon.F:1098-1105`), final ||C||>=eps pass unless
     retain_sparsity, which instead locks C's pattern.
     """
+    if _faults.active():
+        # the collective boundary: ring shifts / psum / all_gather run
+        # inside jit, so the injection point is the mesh dispatch edge
+        _faults.maybe_inject("collective")
     with timed("sparse_cannon"):
         return _sparse_multiply_impl(
             alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
@@ -1232,7 +1238,7 @@ def _run_grouped_cannon(a_panels, b_panels, stacks, c_init, alpha, beta,
         c = (alpha * c + beta * c_in.astype(acc_dtype)).astype(c_in.dtype)
         return c.reshape((1, 1, 1) + c.shape)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         body,
         mesh=mesh,
         in_specs=(

@@ -229,8 +229,12 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
         return sum(v["bytes"] for v in _stats.driver_rollup().values())
 
     bytes0 = _rollup_bytes()
-    times, flops_list = [], []
-    for _ in range(cfg.nrep):
+
+    def _run_once():
+        """One timed repeat of the configured multiply — also the body
+        the checksum gate's one-shot safe-driver retry re-executes.
+        Returns (c_run, flops, elapsed_s); timing excludes the C copy
+        and its completion fence (the reference's contract)."""
         c_run = c.copy()
         _force_completion(c_run)
         t0 = time.perf_counter()
@@ -267,7 +271,12 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
                 element_limits=el if has_limits else None,
             )
         _force_completion(c_run)
-        times.append(time.perf_counter() - t0)
+        return c_run, flops, time.perf_counter() - t0
+
+    times, flops_list = [], []
+    for _ in range(cfg.nrep):
+        c_run, flops, dt = _run_once()
+        times.append(dt)
         flops_list.append(flops)
     gflops = [f / t / 1e9 for f, t in zip(flops_list, times)]
     cs = matrix_checksum(c_run)
@@ -324,13 +333,18 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
     if cfg.check:
         try:
             _verify_checksums(cfg, cs, cs_pos, verbose)
-        except PerfChecksumError:
+        except PerfChecksumError as first_err:
             # black-box dump: what was the engine doing for the last N
             # multiplies when the checksum tripped (obs flight recorder)
             from dbcsr_tpu.obs import flight
 
             flight.dump()
-            raise
+            # one-shot safe-driver retry: re-run ONE repeat on the
+            # plain XLA stack path (no pallas, no dense mode) and
+            # classify the failure as deterministic vs transient vs
+            # driver-specific (see _checksum_retry_safe)
+            result = _checksum_retry_safe(cfg, _run_once, cs, first_err,
+                                          result, verbose)
     return result
 
 
@@ -352,6 +366,88 @@ def _verify_checksums(cfg: PerfConfig, cs: float, cs_pos: float, verbose: bool) 
         raise PerfChecksumError("; ".join(errs))
     if verbose:
         print(" checksums OK (within threshold)")
+
+
+# the chain driver every backend can run and every test trusts: the
+# plain XLA stack path (dense mode disabled for the retry too — the
+# corruption may live in the dense carve)
+SAFE_DRIVER = "xla"
+
+
+def _checksum_retry_safe(cfg: PerfConfig, run_once, cs_first: float,
+                         first_err: PerfChecksumError, result: dict,
+                         verbose: bool) -> dict:
+    """One-shot safe-driver retry for a tripped checksum gate.
+
+    Re-runs ONE repeat with ``mm_driver=SAFE_DRIVER`` (and dense mode
+    off) and classifies the original failure:
+
+    * retry passes, original config used a different driver path →
+      ``driver`` — the selected driver deterministically corrupts this
+      workload (the breaker layer has already quarantined it per
+      shape); the safe result is returned.
+    * retry passes, original config was already the safe driver →
+      ``transient`` — same path, different outcome; the safe result is
+      returned.
+    * retry reproduces the SAME wrong checksum → ``deterministic`` —
+      engine-level (or reference-value) error; re-raised.
+    * retry fails with a different checksum → ``unstable`` — re-raised.
+
+    The classification lands in the
+    ``dbcsr_tpu_checksum_retry_total{outcome}`` counter, the returned
+    result dict (``checksum_retry``), and the raised message."""
+    from dbcsr_tpu.core.config import get_config, set_config
+    from dbcsr_tpu.obs import metrics as _metrics
+
+    live = get_config()
+    prev_driver, prev_dense = live.mm_driver, live.mm_dense
+    retried_same_path = prev_driver == SAFE_DRIVER
+    try:
+        set_config(mm_driver=SAFE_DRIVER, mm_dense=False)
+        c_run, _flops, _dt = run_once()
+    except Exception as exc:  # retry itself died: original error stands
+        _metrics.counter(
+            "dbcsr_tpu_checksum_retry_total",
+            "checksum-gate safe-driver retries by outcome",
+        ).inc(outcome="retry_error")
+        raise PerfChecksumError(
+            f"{first_err}; safe-driver retry also failed "
+            f"({type(exc).__name__}: {exc})") from first_err
+    finally:
+        set_config(mm_driver=prev_driver, mm_dense=prev_dense)
+    cs = matrix_checksum(c_run)
+    cs_pos = matrix_checksum(c_run, pos=True)
+    counter = _metrics.counter(
+        "dbcsr_tpu_checksum_retry_total",
+        "checksum-gate safe-driver retries by outcome",
+    )
+    try:
+        _verify_checksums(cfg, cs, cs_pos, verbose=False)
+    except PerfChecksumError:
+        outcome = ("deterministic" if cs == cs_first else "unstable")
+        counter.inc(outcome=outcome)
+        raise PerfChecksumError(
+            f"{first_err}; safe-driver ({SAFE_DRIVER}) retry "
+            f"{'reproduced the same wrong checksum' if cs == cs_first else f'produced yet another checksum {cs:.15e}'}"
+            f" — classified {outcome.upper()}") from first_err
+    outcome = "transient" if retried_same_path else "driver"
+    counter.inc(outcome=outcome)
+    if verbose:
+        print(f" checksum gate: safe-driver retry PASSED — original "
+              f"failure classified {outcome.upper()} "
+              f"(driver path {prev_driver!r} -> {SAFE_DRIVER!r})")
+    result = dict(
+        result,
+        checksum=cs, checksum_pos=cs_pos,
+        checksum_retry={
+            "outcome": outcome,
+            "failed_checksum": cs_first,
+            "safe_driver": SAFE_DRIVER,
+            "original_mm_driver": prev_driver,
+            "error": str(first_err),
+        },
+    )
+    return result
 
 
 def _force_completion(matrix: BlockSparseMatrix) -> float:
@@ -448,7 +544,7 @@ def run_perf_multiproc(cfg_path: str, nproc: int, devices_per_proc: int = 4,
     import socket
     import subprocess
 
-    def _spawn():
+    def _spawn(deadline_s=timeout):
         s = socket.socket()
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
@@ -474,7 +570,7 @@ def run_perf_multiproc(cfg_path: str, nproc: int, devices_per_proc: int = 4,
         outs = []
         try:
             for p in procs:
-                outs.append(p.communicate(timeout=timeout)[0])
+                outs.append(p.communicate(timeout=deadline_s)[0])
         except subprocess.TimeoutExpired:
             outs = None  # port race / hung join: retry with a new port
         finally:
@@ -487,11 +583,28 @@ def run_perf_multiproc(cfg_path: str, nproc: int, devices_per_proc: int = 4,
                     pass
         return procs, outs
 
-    procs, outs = _spawn()
-    if outs is None:
-        procs, outs = _spawn()
-    if outs is None:
-        raise RuntimeError(f"{nproc}-process world never formed (twice)")
+    # the multihost join rides the watchdog executor: a hung world is a
+    # WEDGED outcome (backoff + fresh port before the one retry), a
+    # rank crash is TRANSIENT, and both land in the
+    # dbcsr_tpu_watchdog_outcomes_total{name="mp_world_join"} counter
+    from dbcsr_tpu.resilience import watchdog as _watchdog
+
+    wd = _watchdog.Watchdog("mp_world_join", deadline_s=timeout,
+                            backoff_base_s=1.0, backoff_max_s=15.0)
+
+    def _attempt(deadline_s):
+        procs, outs = _spawn(deadline_s)
+        if outs is None:
+            raise _watchdog.DeadlineExceeded(
+                f"{nproc}-process world join overran {deadline_s:.0f}s")
+        return procs, outs
+
+    res = wd.run(_attempt, retries=1, retry_on=(_watchdog.WEDGED,))
+    if not res.ok:
+        raise RuntimeError(
+            f"{nproc}-process world never formed (twice): "
+            f"outcome={res.outcome} {res.error}")
+    procs, outs = res.value
     results = []
     for i, (p, o) in enumerate(zip(procs, outs)):
         if p.returncode != 0:

@@ -1,0 +1,30 @@
+"""Compatibility shims for the moving jax API surface.
+
+``jax.enable_x64`` (the context manager) was deprecated and then
+removed from the top-level namespace (AttributeError on jax 0.4.37,
+the pinned version) — its home is ``jax.experimental.enable_x64``.
+Every pallas/tuner call site that scopes x64 off for a kernel launch
+goes through this shim, so an API move is one edit here instead of a
+silent engine-wide driver outage (the pre-seed state: every pallas
+launch died with AttributeError before reaching the kernel).
+"""
+
+from __future__ import annotations
+
+try:
+    from jax.experimental import enable_x64  # noqa: F401
+except ImportError:  # pragma: no cover — older jax kept it top-level
+    import jax
+
+    enable_x64 = jax.enable_x64  # type: ignore[attr-defined]
+
+# ``jax.shard_map`` is the promoted (jax >= 0.6) name of
+# ``jax.experimental.shard_map.shard_map``; the pinned 0.4.37 only has
+# the experimental home (top-level access raises the deprecation
+# AttributeError).  Same deal: one shim, every mesh engine call site.
+import jax as _jax
+
+try:
+    shard_map = _jax.shard_map  # the promoted top-level name
+except AttributeError:
+    from jax.experimental.shard_map import shard_map  # noqa: F401

@@ -142,9 +142,14 @@ def test_tuned_xla_flat_entry_drives_dispatch(tmp_path, monkeypatch):
 def test_validate_kernels_catches_corrupted_kernel(monkeypatch):
     """Ref: libsmm_acc validates each JIT'd kernel against a CPU
     checksum and hard-exits on mismatch (`libsmm_acc.cpp:81-85,216`).
-    Injecting a corrupted Pallas result must raise."""
+    Here a corrupted Pallas result must be CAUGHT by first-use
+    validation — and, since the resilience layer, the validation
+    failure opens the (pallas, shape) breaker and the stack re-executes
+    on a safe chain driver: the caller gets a CORRECT product, never
+    the corrupted one (the reference exits; we degrade)."""
     from dbcsr_tpu.acc import pallas_smm, smm
     from dbcsr_tpu.core.config import set_config
+    from dbcsr_tpu.resilience import breaker
 
     rng = np.random.default_rng(13)
     a, b, c, ai, bi, ci = _random_stack(rng, 8, 8, 6, 100, 8, 8, 8, np.float32)
@@ -160,17 +165,27 @@ def test_validate_kernels_catches_corrupted_kernel(monkeypatch):
     smm._validated_kernels.difference_update(
         {k for k in smm._validated_kernels if k[:4] == (8, 8, 8, "float32")}
     )
+    breaker.reset_board()
     # force the base pallas kernel: auto dispatch never selects
     # interpret-mode pallas off-TPU (and on "TPU" it would try
     # crosspack first, whose separate validation key would pollute
     # the assertion below)
     set_config(mm_driver="pallas", validate_kernels=True)
     try:
-        with pytest.raises(smm.KernelValidationError):
-            process_stack(c.astype(np.float32), a, b, ai, bi, ci)
+        got = np.asarray(process_stack(c.astype(np.float32), a, b, ai, bi, ci))
     finally:
         set_config(mm_driver="auto")
+        breaker.reset_board()
+    # the corrupted kernel never validated, the shape is quarantined,
+    # and the failover product matches the oracle
     assert not any(k[:4] == (8, 8, 8, "float32") for k in smm._validated_kernels)
+    from dbcsr_tpu.obs import metrics as obs_metrics
+
+    fails = obs_metrics.snapshot()["counters"].get(
+        "dbcsr_tpu_driver_failures_total", {})
+    assert any('"kind": "validation"' in key for key in fails)
+    want = _oracle(c.astype(np.float32), a, b, ai, bi, ci, 1.0)
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
 
 
 def test_validate_kernels_passes_and_caches():

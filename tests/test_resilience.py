@@ -1,0 +1,612 @@
+"""Resilience subsystem: fault DSL, breaker state machine, driver
+failover end-to-end, watchdog classification/backoff/persistence, and
+the zero-overhead no-op contract.  All tier-1, CPU-only."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from dbcsr_tpu.core.config import get_config, set_config
+from dbcsr_tpu.mm.multiply import multiply
+from dbcsr_tpu.obs import metrics
+from dbcsr_tpu.ops.test_methods import checksum, make_random_matrix
+from dbcsr_tpu.resilience import breaker, faults, watchdog
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Every test starts with no faults, a fresh breaker board, fresh
+    metrics, and the default config."""
+    from dbcsr_tpu.mm import multiply as mm_mod
+
+    cfg0 = {f: getattr(get_config(), f)
+            for f in ("mm_driver", "mm_dense", "use_pallas", "flat_gather",
+                      "validate_kernels")}
+    faults.clear()
+    breaker.reset_board()
+    metrics.reset()
+    mm_mod._plan_cache.clear()  # cached plans carry healed drivers
+    yield
+    faults.clear()
+    breaker.reset_board()
+    metrics.reset()
+    mm_mod._plan_cache.clear()
+    set_config(**cfg0)
+
+
+def _mats(bs=(5,) * 8, dtype=np.float64, occ=0.6, seed=0):
+    rng = np.random.default_rng(seed)
+    bs = list(bs)
+    a = make_random_matrix("A", bs, bs, dtype=dtype, occupation=occ, rng=rng)
+    b = make_random_matrix("B", bs, bs, dtype=dtype, occupation=occ, rng=rng)
+    c = make_random_matrix("C", bs, bs, dtype=dtype, occupation=0.3, rng=rng)
+    return a, b, c
+
+
+def _counter(snap, name):
+    return snap["counters"].get(name, {})
+
+
+# ---------------------------------------------------------------- DSL
+
+
+def test_fault_dsl_full_spec():
+    (spec,) = faults.parse("pallas:raise@stack>=3,prob=0.5,seed=7")
+    assert spec.target == "pallas" and spec.kind == "raise"
+    assert spec.op == ">=" and spec.n == 3
+    assert spec.prob == 0.5 and spec.seed == 7 and spec.times is None
+
+
+def test_fault_dsl_multiple_specs_and_options():
+    specs = faults.parse("dense:nan,times=1; probe:fail,times=35;"
+                         "multihost_init:hang,sleep=5")
+    assert [s.kind for s in specs] == ["nan", "fail", "hang"]
+    assert specs[1].times == 35 and specs[2].sleep == 5.0
+
+
+@pytest.mark.parametrize("bad", ["nosite", "x:unknownkind", "x:raise,zap=1",
+                                 "x:raise@entries>=3"])
+def test_fault_dsl_rejects_bad_specs(bad):
+    with pytest.raises(ValueError):
+        faults.parse(bad)
+
+
+def test_fault_condition_and_times():
+    (spec,) = faults.parse("x:raise@stack>=3,times=2")
+    fired = [spec.should_fire() for _ in range(6)]
+    # calls 1,2 miss the condition; 3,4 fire; times=2 exhausts
+    assert fired == [False, False, True, True, False, False]
+
+
+def test_fault_prob_is_seeded_deterministic():
+    def pattern():
+        (spec,) = faults.parse("x:raise,prob=0.5,seed=7")
+        return [spec.should_fire() for _ in range(32)]
+
+    p1, p2 = pattern(), pattern()
+    assert p1 == p2
+    assert 0 < sum(p1) < 32  # the coin actually flips both ways
+
+
+def test_inject_faults_context_restores():
+    assert not faults.active()
+    with faults.inject_faults("x:raise"):
+        assert faults.active()
+    assert not faults.active()
+
+
+def test_fail_probe_streak():
+    with faults.inject_faults("probe:fail,times=2"):
+        assert faults.fail_probe("probe") is True
+        assert faults.fail_probe("probe") is True
+        assert faults.fail_probe("probe") is False  # streak healed
+
+
+# ------------------------------------------------------------- breaker
+
+
+def _board(clock, threshold=3, cooldown=10.0):
+    return breaker.BreakerBoard(fail_threshold=threshold,
+                                cooldown_s=cooldown, clock=clock)
+
+
+def test_breaker_closed_to_open_threshold():
+    t = [0.0]
+    b = _board(lambda: t[0])
+    key = (23, 23, 23, "float64")
+    assert b.allow("pallas", key)
+    for _ in range(2):
+        b.record_failure("pallas", key)
+        assert b.state("pallas", key) == breaker.CLOSED
+    b.record_failure("pallas", key)
+    assert b.state("pallas", key) == breaker.OPEN
+    assert not b.allow("pallas", key)
+
+
+def test_breaker_cooldown_half_open_trial():
+    t = [0.0]
+    b = _board(lambda: t[0], threshold=1, cooldown=10.0)
+    key = ("k",)
+    b.record_failure("pallas", key)
+    assert not b.allow("pallas", key)
+    t[0] = 9.9
+    assert not b.allow("pallas", key)
+    t[0] = 10.1  # cooldown elapsed: exactly ONE trial admitted
+    assert b.allow("pallas", key)
+    assert b.state("pallas", key) == breaker.HALF_OPEN
+    assert not b.allow("pallas", key)  # second concurrent launch: no
+    b.record_success("pallas", key)
+    assert b.state("pallas", key) == breaker.CLOSED
+    assert b.allow("pallas", key)
+
+
+def test_breaker_half_open_failure_doubles_cooldown():
+    t = [0.0]
+    b = _board(lambda: t[0], threshold=1, cooldown=10.0)
+    key = ("k",)
+    b.record_failure("pallas", key)
+    t[0] = 11
+    assert b.allow("pallas", key)  # trial
+    b.record_failure("pallas", key)  # trial failed
+    assert b.state("pallas", key) == breaker.OPEN
+    t[0] = 11 + 15
+    assert not b.allow("pallas", key)  # cooldown doubled to 20
+    t[0] = 11 + 21
+    assert b.allow("pallas", key)
+    snap = b.snapshot()["pallas|k"]
+    assert snap["trips"] == 2 and snap["cooldown_s"] == 20.0
+
+
+def test_breaker_per_shape_quarantine():
+    t = [0.0]
+    b = _board(lambda: t[0], threshold=1)
+    b.record_failure("pallas", (23, 23, 23, "float64"))
+    assert not b.allow("pallas", (23, 23, 23, "float64"))
+    assert b.allow("pallas", (5, 5, 5, "float64"))  # other shape: fine
+    assert b.allow("xla", (23, 23, 23, "float64"))  # other driver: fine
+
+
+def test_breaker_validation_trips_immediately():
+    t = [0.0]
+    b = _board(lambda: t[0], threshold=5)
+    b.record_failure("pallas", ("k",), kind="validation")
+    assert b.state("pallas", ("k",)) == breaker.OPEN
+
+
+def test_breaker_state_gauge_exported():
+    t = [0.0]
+    b = _board(lambda: t[0], threshold=1)
+    b.record_failure("pallas", (23, 23, 23, "float64"))
+    g = metrics.snapshot()["gauges"]["dbcsr_tpu_breaker_state"]
+    assert g['{"driver": "pallas", "shape": "23x23x23xfloat64"}'] == 2
+
+
+# ----------------------------------------------------- e2e failover
+
+
+def test_e2e_injected_failure_recovers():
+    """Injected raise on the dispatched driver → failover → the product
+    is still produced and numerically correct (the failover lands on a
+    DIFFERENT driver by design, so agreement is to f64 accumulation
+    tolerance; the bitwise contract is pinned against the target
+    driver in the pallas test below)."""
+    a, b, c = _mats()
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    cs_ref = checksum(c)
+    a, b, c = _mats()
+    with faults.inject_faults("execute_stack:raise,times=1"):
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert checksum(c) == pytest.approx(cs_ref, rel=1e-11)
+    snap = metrics.snapshot()
+    assert sum(_counter(snap, "dbcsr_tpu_faults_injected_total").values()) == 1
+    assert sum(_counter(snap, "dbcsr_tpu_driver_failures_total").values()) == 1
+    assert sum(_counter(snap, "dbcsr_tpu_driver_fallback_total").values()) >= 1
+
+
+def test_e2e_pallas_failure_falls_to_xla_group_bitwise():
+    """The ISSUE's canonical walk: a failing pallas kernel (f32 — the
+    Pallas SMM's dtype) re-executes down the chain onto xla_group,
+    bitwise-equal to a clean xla_group run of the same product."""
+    set_config(mm_driver="xla_group")
+    a, b, c = _mats(bs=(4,) * 6, dtype=np.float32)
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    cs_group = checksum(c)
+
+    set_config(mm_driver="pallas")
+    a, b, c = _mats(bs=(4,) * 6, dtype=np.float32)
+    with faults.inject_faults("pallas:raise"):  # pallas ALWAYS fails
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert checksum(c) == cs_group
+    fb = _counter(metrics.snapshot(), "dbcsr_tpu_driver_fallback_total")
+    assert fb.get('{"from": "pallas", "to": "xla_group"}', 0) >= 1
+
+
+def test_e2e_nan_corruption_detected_and_healed():
+    a, b, c = _mats()
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    cs_ref = checksum(c)
+    a, b, c = _mats()
+    with faults.inject_faults("execute_stack:nan,times=1"):
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert checksum(c) == pytest.approx(cs_ref, rel=1e-11)
+    assert np.isfinite(checksum(c))
+    fails = _counter(metrics.snapshot(), "dbcsr_tpu_driver_failures_total")
+    assert any('"kind": "nan"' in k for k in fails)
+
+
+def test_e2e_oom_classified():
+    a, b, c = _mats()
+    with faults.inject_faults("execute_stack:oom,times=1"):
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+    fails = _counter(metrics.snapshot(), "dbcsr_tpu_driver_failures_total")
+    assert any('"kind": "oom"' in k for k in fails)
+
+
+def test_e2e_breaker_quarantines_across_multiplies():
+    """An unbounded per-driver fault trips the breaker; later multiplies
+    route around the quarantined driver WITHOUT re-attempting it."""
+    set_config(mm_driver="xla")
+    with faults.inject_faults("xla:raise") as specs:
+        a, b, c = _mats()
+        multiply("N", "N", 1.0, a, b, 0.0, c)  # fails over each span
+        first_calls = specs[0].calls
+        assert first_calls >= 1
+        board = breaker.get_board()
+        key = (5, 5, 5, "float64")
+        # threshold (3) consecutive failures? one multiply = one span
+        # here; drive the breaker open with two more products
+        for seed in (1, 2):
+            a, b, c = _mats(seed=seed)
+            multiply("N", "N", 1.0, a, b, 0.0, c)
+        assert board.state("xla", key) == breaker.OPEN
+        calls_at_open = specs[0].calls
+        a, b, c = _mats(seed=3)
+        multiply("N", "N", 1.0, a, b, 0.0, c)  # quarantined: no attempt
+        assert specs[0].calls == calls_at_open
+    assert checksum(c) != 0.0
+
+
+def test_e2e_prepare_failure_replans_safely():
+    from dbcsr_tpu.mm import multiply as mm_mod
+
+    a, b, c = _mats()
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    cs_ref = checksum(c)
+    # drop the cached plan so the faulted run actually re-plans
+    mm_mod._plan_cache.clear()
+    a, b, c = _mats()
+    with faults.inject_faults("prepare_stack:raise,times=1"):
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+    # the safe re-plan may land on a different driver than the tuned
+    # pick, so compare within f64 accumulation tolerance
+    assert checksum(c) == pytest.approx(cs_ref, rel=1e-11)
+    fb = _counter(metrics.snapshot(), "dbcsr_tpu_driver_fallback_total")
+    assert any('"from": "prepare"' in k for k in fb)
+
+
+def test_e2e_dense_failure_degrades_to_stack():
+    set_config(mm_dense=True)
+    a, b, c = _mats(occ=0.9)
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert c._mm_algorithm == "dense"
+    cs_dense = checksum(c)
+    a, b, c = _mats(occ=0.9)
+    with faults.inject_faults("dense:raise"):
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert c._mm_algorithm == "stack"
+    assert checksum(c) == pytest.approx(cs_dense, rel=1e-11)
+    fb = _counter(metrics.snapshot(), "dbcsr_tpu_driver_fallback_total")
+    assert fb.get('{"from": "dense", "to": "stack"}', 0) == 1
+
+
+def test_e2e_dense_nan_canvas_detected():
+    set_config(mm_dense=True)
+    a, b, c = _mats(occ=0.9)
+    with faults.inject_faults("dense:nan"):
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert c._mm_algorithm == "stack"
+    assert np.isfinite(checksum(c))
+
+
+def test_flight_recorder_carries_resilience_events():
+    from dbcsr_tpu.obs import flight
+
+    flight.clear()
+    a, b, c = _mats()
+    with faults.inject_faults("execute_stack:raise,times=1"):
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+    recs = flight.records()
+    events = [e for r in recs for e in r.get("events", [])]
+    kinds = {e["event"] for e in events}
+    assert "fault_injected" in kinds
+    assert "driver_failure" in kinds
+    assert "failover" in kinds
+
+
+# ------------------------------------------------------------ watchdog
+
+
+def _fake_wd(**kw):
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    sleeps = []
+    kw.setdefault("deadline_s", 10.0)
+    wd = watchdog.Watchdog("test", clock=clock, sleep=sleeps.append, **kw)
+    return wd, t, sleeps
+
+
+def test_watchdog_classifies_ok_slow_transient_wedged():
+    wd, t, _ = _fake_wd(slow_fraction=0.5)
+
+    def fast(deadline):
+        t[0] += 1.0
+        return "v"
+
+    def slow(deadline):
+        t[0] += 6.0
+        return "v"
+
+    def transient(deadline):
+        raise ValueError("boom")
+
+    def wedged(deadline):
+        raise watchdog.DeadlineExceeded("hung")
+
+    assert wd.guard(fast).outcome == watchdog.OK
+    assert wd.guard(slow).outcome == watchdog.SLOW
+    assert wd.guard(transient).outcome == watchdog.TRANSIENT
+    assert wd.guard(wedged).outcome == watchdog.WEDGED
+    # subprocess.TimeoutExpired is a WEDGE too
+    import subprocess
+
+    def sub_wedged(deadline):
+        raise subprocess.TimeoutExpired("cmd", deadline)
+
+    assert wd.guard(sub_wedged).outcome == watchdog.WEDGED
+
+
+def test_watchdog_streaks_and_backoff():
+    wd, t, _ = _fake_wd(backoff_base_s=60.0, backoff_max_s=3600.0,
+                        jitter=0.0)
+
+    def wedge(deadline):
+        raise watchdog.DeadlineExceeded("hung")
+
+    delays = []
+    for _ in range(6):
+        wd.guard(wedge)
+        delays.append(wd.next_delay())
+    assert wd.wedge_streak == 6
+    # wedges count double-weight: 2^(2k-1)*base capped at max
+    assert delays[0] == 120.0 and delays[1] == 480.0
+    assert delays[-1] == 3600.0  # capped
+
+    def ok(deadline):
+        t[0] += 0.1
+        return 1
+
+    wd.guard(ok)
+    assert wd.streak == 0 and wd.wedge_streak == 0
+    assert wd.next_delay() == 60.0  # back to base cadence
+
+
+def test_watchdog_jitter_bounds():
+    wd, _, _ = _fake_wd(backoff_base_s=100.0, jitter=0.1)
+    for _ in range(50):
+        assert 90.0 <= wd.next_delay() <= 110.0
+
+
+def test_watchdog_run_retries_on_wedge():
+    wd, t, sleeps = _fake_wd(backoff_base_s=5.0, jitter=0.0)
+    attempts = []
+
+    def flaky(deadline):
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise watchdog.DeadlineExceeded("hung")
+        t[0] += 0.1
+        return "done"
+
+    res = wd.run(flaky, retries=5)
+    assert res.outcome == watchdog.OK and res.value == "done"
+    assert res.attempts == 3 and len(sleeps) == 2
+
+
+def test_watchdog_persistence_resume(tmp_path):
+    state = str(tmp_path / "wd.jsonl")
+    wd, _, _ = _fake_wd(state_path=state)
+
+    def wedge(deadline):
+        raise watchdog.DeadlineExceeded("hung")
+
+    for _ in range(3):
+        wd.guard(wedge)
+    assert wd.wedge_streak == 3
+    # a RESTARTED loop resumes the streak instead of the base cadence
+    wd2, _, _ = _fake_wd(state_path=state)
+    assert wd2.wedge_streak == 3 and wd2.streak == 3
+    # torn tail line is tolerated
+    with open(state, "a") as fh:
+        fh.write('{"name": "test", "streak":')
+    wd3, _, _ = _fake_wd(state_path=state)
+    assert wd3.wedge_streak == 3
+    import json
+
+    with open(state) as fh:
+        recs = [json.loads(x) for x in fh if x.strip().endswith("}")]
+    assert all(r["outcome"] == watchdog.WEDGED for r in recs)
+
+
+def test_watchdog_guard_returns_error_string():
+    wd, _, _ = _fake_wd()
+
+    def transient(deadline):
+        raise ValueError("boom")
+
+    res = wd.guard(transient)
+    assert not res.ok and "ValueError: boom" == res.error
+
+
+# ----------------------------------------- perf-driver checksum retry
+
+
+def test_checksum_retry_classifies_driver_fault():
+    """A wrong first checksum whose safe-driver retry passes is
+    classified 'driver' and the safe result is returned."""
+    from dbcsr_tpu.perf import driver as perf_driver
+
+    a, b, c = _mats()
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    cs_good = checksum(c)
+    cs_good_pos = checksum(c, pos=True)
+    cfg = perf_driver.PerfConfig(check=True, check_threshold=1e-8,
+                                 check_refs=(cs_good, cs_good_pos))
+
+    def run_once():
+        a2, b2, c2 = _mats()
+        multiply("N", "N", 1.0, a2, b2, 0.0, c2)
+        return c2, 0, 0.0
+
+    first = perf_driver.PerfChecksumError("simulated corruption")
+    result = perf_driver._checksum_retry_safe(
+        cfg, run_once, cs_first=cs_good * 1.5, first_err=first,
+        result={"checksum": cs_good * 1.5}, verbose=False)
+    assert result["checksum_retry"]["outcome"] == "driver"
+    assert result["checksum"] == pytest.approx(cs_good, rel=1e-11)
+    cnt = _counter(metrics.snapshot(), "dbcsr_tpu_checksum_retry_total")
+    assert cnt.get('{"outcome": "driver"}') == 1
+    # config restored
+    assert get_config().mm_driver == "auto"
+
+
+def test_checksum_retry_deterministic_reraises():
+    from dbcsr_tpu.perf import driver as perf_driver
+
+    # pin the whole test to the safe driver so the retry reproduces the
+    # first run BITWISE — the 'same wrong checksum' classification
+    set_config(mm_driver=perf_driver.SAFE_DRIVER)
+    a, b, c = _mats()
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    cs = checksum(c)
+    cfg = perf_driver.PerfConfig(check=True, check_threshold=1e-8,
+                                 check_refs=(cs * 2, 0.0))  # wrong refs
+
+    def run_once():
+        a2, b2, c2 = _mats()
+        multiply("N", "N", 1.0, a2, b2, 0.0, c2)
+        return c2, 0, 0.0
+
+    first = perf_driver.PerfChecksumError("wrong checksum")
+    with pytest.raises(perf_driver.PerfChecksumError,
+                       match="DETERMINISTIC"):
+        perf_driver._checksum_retry_safe(
+            cfg, run_once, cs_first=cs, first_err=first,
+            result={}, verbose=False)
+
+
+# ------------------------------------------------- multihost degrade
+
+
+def test_init_multihost_timeout_degrades_to_serial(monkeypatch):
+    from dbcsr_tpu.parallel import multihost
+
+    def hang(**kw):
+        raise RuntimeError(
+            "DEADLINE_EXCEEDED: barrier timed out after "
+            f"{kw.get('initialization_timeout')}s")
+
+    monkeypatch.setattr(jax.distributed, "initialize", hang)
+    with pytest.warns(RuntimeWarning, match="DEGRADING TO SERIAL"):
+        ok = multihost.init_multihost("bogus:1", 2, 0, timeout_s=7)
+    assert ok is False
+    cnt = _counter(metrics.snapshot(), "dbcsr_tpu_multihost_degraded_total")
+    assert cnt.get('{"reason": "join_timeout"}') == 1
+    from dbcsr_tpu.obs import flight
+
+    rec = flight.records()[-1]
+    assert rec["op"] == "multihost_init" and "degraded to serial" in rec["error"]
+
+
+def test_init_multihost_config_error_still_raises(monkeypatch):
+    from dbcsr_tpu.parallel import multihost
+
+    def bad(**kw):
+        raise ValueError("num_processes mismatch")
+
+    monkeypatch.setattr(jax.distributed, "initialize", bad)
+    with pytest.raises(ValueError, match="mismatch"):
+        multihost.init_multihost("bogus:1", 2, 0, timeout_s=7)
+
+
+# -------------------------------------------------- no-op overhead
+
+
+def test_noop_path_leaves_no_traces():
+    a, b, c = _mats()
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    snap = metrics.snapshot()
+    assert not _counter(snap, "dbcsr_tpu_faults_injected_total")
+    assert not _counter(snap, "dbcsr_tpu_driver_failures_total")
+    assert not _counter(snap, "dbcsr_tpu_driver_fallback_total")
+    assert breaker.get_board().snapshot() == {}
+
+
+def test_noop_hooks_are_cheap():
+    """The disabled-path contract: hook calls are attribute checks, far
+    inside the ≤10 µs/multiply budget (very loose wall-clock bound so
+    a loaded CI host cannot flake it)."""
+    board = breaker.get_board()
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        faults.active()
+        board.allow("xla", (5, 5, 5, "float64"))
+    dt = (time.perf_counter() - t0) / n
+    assert dt < 25e-6  # measured ~0.5 µs; bound is 50x slack
+
+
+def test_execute_stack_unchanged_without_faults():
+    """With the subsystem idle, execute_stack returns the same result
+    object path as a direct _execute_plan call (bitwise product)."""
+    from dbcsr_tpu.acc import smm
+
+    rng = np.random.default_rng(3)
+    import jax.numpy as jnp
+
+    cdat = jnp.zeros((4, 5, 5))
+    adat = jnp.asarray(rng.random((6, 5, 5)))
+    bdat = jnp.asarray(rng.random((6, 5, 5)))
+    ai = np.arange(6, dtype=np.int32)
+    bi = np.arange(6, dtype=np.int32)[::-1].copy()
+    ci = np.sort(np.arange(6, dtype=np.int32) % 4)
+    plan = smm.prepare_stack(cdat, adat, bdat, ai, bi, ci)
+    assert plan.src_idx is not None  # failover payload retained
+    out1 = smm.execute_stack(cdat, adat, bdat, plan, 1.0)
+    plan2 = smm.prepare_stack(cdat, adat, bdat, ai, bi, ci)
+    out2 = smm._execute_plan(cdat, adat, bdat, plan2, 1.0)
+    assert np.array_equal(np.asarray(out1), np.asarray(out2))
+
+
+# ------------------------------------------------------- chaos (tier-2)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_corpus_short_schedule():
+    """Tier-2 entry point for tools/chaos_suite.py: a short seeded
+    schedule over the corpus; the nightly/local form runs unbounded."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import chaos_suite
+
+    res = chaos_suite.run_chaos(seed=1234, rounds=3)
+    assert res["failures"] == [], res["failures"]

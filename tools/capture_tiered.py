@@ -20,8 +20,12 @@ survive.  Reference analog: tests/dbcsr_performance_multiply.F:452-515
 (per-rank GFLOP/s reporting) and src/acc/libsmm_acc tuning runs.
 
 Usage: python tools/capture_tiered.py [--loop [MINUTES]]
-  --loop: retry on a cadence until tier 1 has succeeded at least once
-          and tier 3 has been attempted on a healthy tunnel.
+  --loop: retry until tier 1 has succeeded at least once and tier 3 has
+          been attempted on a healthy tunnel.  MINUTES is the BASE
+          cadence; consecutive wedged probes back off exponentially
+          (resilience watchdog, up to 2 h) instead of hammering a dead
+          tunnel all night, and the streak is persisted in
+          capture_probe.jsonl so a restarted loop resumes its backoff.
 """
 
 from __future__ import annotations
@@ -35,10 +39,34 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PERF_CAPTURES = os.path.join(REPO, "PERF_CAPTURES.jsonl")
 BENCH_CAPTURES = os.path.join(REPO, "BENCH_CAPTURES.jsonl")
+# structured probe/attempt outcomes, next to capture_loop.log — doubles
+# as the watchdog's persisted backoff state across loop restarts
+PROBE_LOG = os.path.join(REPO, "capture_probe.jsonl")
 
 # single source of truth for the tunnel probe: bench.py owns the
 # round-trip probe refined over rounds (PERF_NOTES.md); reuse it here
 sys.path.insert(0, REPO)
+
+_probe_wd = None
+
+
+def _probe_watchdog(base_cadence_min: float = 20.0):
+    """The loop's shared probe watchdog (resilience layer), loaded via
+    bench's standalone module loader — this driver must never import
+    `dbcsr_tpu` (an env-activated trace session would open shards meant
+    for its bench subprocesses)."""
+    global _probe_wd
+    if _probe_wd is None:
+        import bench
+
+        wd_mod = bench._load_resilience("watchdog")
+        _probe_wd = wd_mod.Watchdog(
+            "tpu_probe", deadline_s=120,
+            backoff_base_s=base_cadence_min * 60,
+            backoff_max_s=2 * 3600,
+            state_path=PROBE_LOG,
+        )
+    return _probe_wd
 
 # (m, n, k, dtype_enum, stack_size) — 23^3 is the north-star block shape
 # (BASELINE.json); 32^3/64^3 probe MXU-friendly shapes; S=100k per
@@ -57,10 +85,30 @@ def log(msg: str) -> None:
     print(f"[capture {time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
 
+def _guarded_run(name: str, cmd: list, timeout_s: float, **popen_kw):
+    """Run one capture subprocess under a deadline-guarded watchdog:
+    the ONE timeout/classification path every tier shares (replacing
+    per-tier try/except TimeoutExpired blocks).  Returns a
+    WatchdogResult whose .value is the CompletedProcess (None on
+    WEDGED/TRANSIENT); every outcome lands as a structured JSONL row in
+    capture_probe.jsonl."""
+    import bench
+
+    wd_mod = bench._load_resilience("watchdog")
+    # resume=False: one-shot guard — persist the outcome row, but don't
+    # re-scan the whole append-only log for streak state it never uses
+    wd = wd_mod.Watchdog(name, deadline_s=timeout_s, state_path=PROBE_LOG,
+                         resume=False)
+    return wd.guard(lambda deadline_s: subprocess.run(
+        cmd, timeout=deadline_s, **popen_kw))
+
+
 def probe(timeout_s: int = 120) -> bool:
     import bench
 
-    return bench._probe_tpu(timeout_s)
+    wd = _probe_watchdog()
+    wd.deadline_s = float(timeout_s)
+    return bench._probe_tpu(timeout_s, watchdog=wd)
 
 
 # kept in sync with dbcsr_tpu.obs.OBS_SCHEMA_VERSION — a literal, NOT
@@ -135,16 +183,19 @@ def run_tier1() -> tuple:
             "dtype_enum={dt}, out=lambda *a: None); "
             "print('CAPTURE ' + json.dumps(r))"
         ).format(REPO=REPO, ss=ss, m=m, n=n, k=k, dt=dt)
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", code], timeout=360,
-                capture_output=True, text=True, cwd=REPO,
-            )
-        except subprocess.TimeoutExpired:
+        res = _guarded_run(
+            f"tier1_{m}x{n}x{k}_dt{dt}", [sys.executable, "-c", code],
+            360, capture_output=True, text=True, cwd=REPO,
+        )
+        if res.outcome == "WEDGED":
             # a timeout IS the wedge signal: stop queuing more work on
             # the tunnel (queued programs are not cancelled)
             log(f"tier1 {m}x{n}x{k} dt={dt}: TIMEOUT (tunnel wedged mid-kernel)")
             return captured, fresh, True
+        if res.value is None:  # spawn-level failure (OSError etc.)
+            log(f"tier1 {m}x{n}x{k} dt={dt}: {res.outcome} {res.error}")
+            continue
+        r = res.value
         line = next((l for l in r.stdout.splitlines()
                      if l.startswith("CAPTURE ")), None)
         if r.returncode == 0 and line:
@@ -179,21 +230,22 @@ def run_bench(extra_env: dict, timeout_s: int, tier,
               stderr_to: str = None) -> bool:
     env = dict(os.environ, **extra_env)
     env.setdefault("DBCSR_TPU_BENCH_PROBE_TIMEOUT", "240")
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py")],
-            timeout=timeout_s, capture_output=True, text=True,
-            cwd=REPO, env=env,
-        )
-    except subprocess.TimeoutExpired:
-        log(f"tier{tier} bench: TIMEOUT after {timeout_s}s")
+    res = _guarded_run(
+        f"tier{tier}_bench", [sys.executable, os.path.join(REPO, "bench.py")],
+        timeout_s, capture_output=True, text=True, cwd=REPO, env=env,
+    )
+    if res.value is None:
+        log(f"tier{tier} bench: {res.outcome} after {res.elapsed_s:.0f}s "
+            f"({res.error})")
         if stderr_to:
             # overwrite any stale log from a prior attempt so a
             # leftover profile can't be mistaken for this run's output
             with open(os.path.join(REPO, stderr_to), "w") as fh:
-                fh.write(f"TIMEOUT after {timeout_s}s at "
-                         f"{time.strftime('%Y-%m-%dT%H:%M:%S')}\n")
+                fh.write(f"{res.outcome} after {res.elapsed_s:.0f}s at "
+                         f"{time.strftime('%Y-%m-%dT%H:%M:%S')}: "
+                         f"{res.error}\n")
         return False
+    r = res.value
     if stderr_to:
         with open(os.path.join(REPO, stderr_to), "w") as fh:
             fh.write(r.stderr or "")
@@ -304,15 +356,16 @@ def run_tier5() -> None:
         if _past_deadline():
             return
         log(f"tier5 {leg} leg (on-chip)")
-        try:
-            r = subprocess.run(
-                [sys.executable, os.path.join(REPO, "tools",
-                                              "onchip_extras.py"), leg],
-                timeout=budget, capture_output=True, text=True, cwd=REPO,
-            )
-        except subprocess.TimeoutExpired:
-            log(f"tier5 {leg}: TIMEOUT after {budget}s")
+        res = _guarded_run(
+            f"tier5_{leg}",
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "onchip_extras.py"), leg],
+            budget, capture_output=True, text=True, cwd=REPO,
+        )
+        if res.value is None:
+            log(f"tier5 {leg}: {res.outcome} after {res.elapsed_s:.0f}s")
             return  # wedge signal: stop queueing extras this window
+        r = res.value
         line = next((l for l in r.stdout.splitlines()
                      if l.startswith("CAPTURE ")), None)
         if r.returncode == 0 and line:
@@ -399,14 +452,14 @@ def run_tier4() -> tuple:
         if tuple(entry) in done:
             continue
         m, n, k, dt, ss = entry
-        try:
-            r = subprocess.run(
-                [sys.executable, "-m", "dbcsr_tpu.acc.tune",
-                 str(m), str(n), str(k), str(dt), str(ss), "3"],
-                timeout=1500, capture_output=True, text=True, cwd=REPO,
-            )
-        except subprocess.TimeoutExpired:
-            log(f"tier4 tune {m}x{n}x{k} dt={dt}: TIMEOUT; re-probing")
+        res = _guarded_run(
+            f"tier4_tune_{m}x{n}x{k}_dt{dt}",
+            [sys.executable, "-m", "dbcsr_tpu.acc.tune",
+             str(m), str(n), str(k), str(dt), str(ss), "3"],
+            1500, capture_output=True, text=True, cwd=REPO,
+        )
+        if res.value is None:
+            log(f"tier4 tune {m}x{n}x{k} dt={dt}: {res.outcome}; re-probing")
             if not probe():
                 log("tunnel wedged mid-sweep; stopping tier 4")
                 return len(done), False
@@ -414,6 +467,7 @@ def run_tier4() -> tuple:
             done.add(tuple(entry))  # budget-exceeded: don't retry forever
             _tier4_mark(done)
             continue
+        r = res.value
         if r.returncode == 0:
             done.add(tuple(entry))
             _tier4_mark(done)
@@ -595,6 +649,10 @@ def main() -> int:
                 pass
     deadline = time.time() + hours * 3600
     _DEADLINE[0] = deadline
+    wd = _probe_watchdog(cadence_min)
+    if wd.wedge_streak:
+        log(f"resuming persisted wedge streak {wd.wedge_streak} "
+            f"(backoff state from {os.path.basename(PROBE_LOG)})")
     while True:
         st = attempt()
         if st["tier3"] and st.get("tier4_walked"):
@@ -605,8 +663,17 @@ def main() -> int:
         if time.time() > deadline:
             log("round deadline reached; exiting")
             return 1
-        log(f"retrying in {cadence_min:g} min (status {st})")
-        time.sleep(cadence_min * 60)
+        # watchdog-paced retry: base cadence while the tunnel answers,
+        # exponential backoff (jittered, capped) across a wedge streak
+        delay_s = min(wd.next_delay(), max(deadline - time.time(), 60.0))
+        _append(PROBE_LOG, {
+            "name": "capture_attempt", "status": st,
+            "probe_streak": wd.streak, "wedge_streak": wd.wedge_streak,
+            "next_delay_s": round(delay_s, 1),
+        })
+        log(f"retrying in {delay_s / 60:.1f} min "
+            f"(status {st}, wedge streak {wd.wedge_streak})")
+        time.sleep(delay_s)
 
 
 if __name__ == "__main__":

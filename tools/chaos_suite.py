@@ -1,0 +1,198 @@
+"""Chaos suite: the multiply corpus under a randomized fault schedule.
+
+Runs a corpus of multiply configurations (mixed blockings, dtypes,
+alpha/beta, symmetric operands, dense-mode shapes) twice each — once
+clean for the reference checksum, once under a randomized, seed-logged
+fault schedule drawn from every injectable site and kind
+(`dbcsr_tpu.resilience.faults`) — and asserts the checksums still
+match: the resilience layer's whole contract is that injected driver
+failures are invisible in the product.
+
+Checksum acceptance is RELATIVE, dtype-aware (f32 1e-5, f64 1e-11 —
+the reference's own gate is threshold-based,
+`dbcsr_performance_multiply.F:656-675`): a failover legitimately lands
+on a different driver whose accumulation order differs in the last
+ulps; bitwise identity across drivers is pinned separately by
+`tests/test_resilience.py` with controlled driver pairs.
+
+The seed is printed on every run (and chosen from the clock when not
+given), so any failing schedule replays exactly:
+
+    python tools/chaos_suite.py                # random seed, 8 rounds
+    python tools/chaos_suite.py --seed 7       # replay schedule 7
+    python tools/chaos_suite.py --rounds 20 --verbose
+
+Exit status: 0 = all checksums matched, 1 = at least one mismatch or
+an unrecovered failure.  Tier-2 entry point: the ``chaos``-marked test
+in `tests/test_resilience.py` runs a short schedule of this corpus
+(`pytest -m chaos`); this script is the unbounded local/nightly form.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU-only by design: chaos runs must be schedulable in CI without
+# hardware (and must never be pointed at a live tunnel).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SITES = ("execute_stack", "prepare_stack", "dense", "xla", "xla_group",
+         "host", "pallas")
+KINDS = ("raise", "oom", "nan")
+
+
+def corpus():
+    """The multiply test corpus: (name, kwargs for one product)."""
+    import numpy as np
+
+    return [
+        ("uniform_f64", dict(bs=[5] * 8, dtype=np.float64, occ=0.5)),
+        ("uniform_f32", dict(bs=[4] * 6, dtype=np.float32, occ=0.6)),
+        ("mixed_blocking", dict(bs=[3, 5, 7, 4, 6, 2], dtype=np.float64,
+                                occ=0.7)),
+        ("near_full", dict(bs=[5] * 6, dtype=np.float64, occ=0.95)),
+        ("complex", dict(bs=[4] * 5, dtype=np.complex128, occ=0.5)),
+        ("beta_accumulate", dict(bs=[5] * 6, dtype=np.float64, occ=0.5,
+                                 alpha=2.0, beta=0.5)),
+    ]
+
+
+def random_schedule(rng: random.Random) -> str:
+    """One randomized fault schedule (1-3 specs) over the sites/kinds.
+
+    Schedules are constrained to RECOVERABLE shapes: at most ONE
+    site-wide ``execute_stack`` spec per schedule, bounded to
+    ``times<=2`` — an unconditional every-launch-of-every-driver
+    failure is unrecoverable by construction (there is no driver left
+    to fall back to; the suite asserts the resilience contract, not
+    magic).  Driver-targeted / prepare / dense specs may be unbounded:
+    the chain re-executes elsewhere, prepare re-plans on the safe
+    path, dense degrades to the stack engine."""
+    specs = []
+    have_sitewide = False
+    for _ in range(rng.randint(1, 3)):
+        site = rng.choice(SITES)
+        kind = rng.choice(KINDS)
+        if site == "execute_stack":
+            if have_sitewide:
+                continue
+            have_sitewide = True
+        opts = [f"seed={rng.randint(0, 2**16)}"]
+        if site == "execute_stack":
+            opts.append(f"times={rng.randint(1, 2)}")
+        elif rng.random() < 0.5:
+            opts.append(f"times={rng.randint(1, 3)}")
+        if rng.random() < 0.3:
+            opts.append(f"prob={rng.choice((0.5, 0.75, 1.0))}")
+        cond = f"@stack>={rng.randint(0, 2)}" if rng.random() < 0.3 else ""
+        specs.append(f"{site}:{kind}{cond}," + ",".join(opts))
+    return ";".join(specs)
+
+
+def _one_product(entry: dict, seed: int):
+    import numpy as np
+
+    from dbcsr_tpu.mm.multiply import multiply
+    from dbcsr_tpu.ops.test_methods import checksum, make_random_matrix
+
+    rng = np.random.default_rng(seed)
+    bs = entry["bs"]
+    dt = entry["dtype"]
+    a = make_random_matrix("A", bs, bs, dtype=dt, occupation=entry["occ"],
+                           rng=rng)
+    b = make_random_matrix("B", bs, bs, dtype=dt, occupation=entry["occ"],
+                           rng=rng)
+    c = make_random_matrix("C", bs, bs, dtype=dt, occupation=0.3, rng=rng)
+    multiply("N", "N", entry.get("alpha", 1.0), a, b,
+             entry.get("beta", 0.0), c)
+    return checksum(c)
+
+
+def run_chaos(seed: int, rounds: int, verbose: bool = False) -> dict:
+    """Run ``rounds`` randomized schedules over the corpus; returns a
+    result dict (also JSONL-printable)."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from dbcsr_tpu.resilience import breaker, faults
+
+    import numpy as np
+
+    rng = random.Random(seed)
+    cases = corpus()
+    refs = {}
+    for name, entry in cases:
+        refs[name] = _one_product(entry, seed=1234)
+
+    def _tol(entry):
+        return (1e-5 if np.dtype(entry["dtype"]) in (np.float32,
+                                                     np.complex64)
+                else 1e-11)
+
+    failures = []
+    schedules = []
+    for rnd in range(rounds):
+        schedule = random_schedule(rng)
+        schedules.append(schedule)
+        for name, entry in cases:
+            breaker.reset_board()
+            try:
+                with faults.inject_faults(schedule):
+                    cs = _one_product(entry, seed=1234)
+            except Exception as exc:  # unrecovered failure
+                failures.append({
+                    "round": rnd, "case": name, "schedule": schedule,
+                    "error": f"{type(exc).__name__}: {exc}",
+                })
+                continue
+            ref = refs[name]
+            rel = abs(cs - ref) / max(abs(ref), 1e-300)
+            if rel > _tol(entry):
+                failures.append({
+                    "round": rnd, "case": name, "schedule": schedule,
+                    "checksum": cs, "ref": ref, "rel_diff": rel,
+                })
+            elif verbose:
+                print(f"  ok r{rnd} {name:>16} rel={rel:.1e} [{schedule}]")
+    return {
+        "seed": seed,
+        "rounds": rounds,
+        "cases": len(cases),
+        "runs": rounds * len(cases),
+        "failures": failures,
+        "schedules": schedules,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=None,
+                    help="schedule seed (default: clock; always logged)")
+    ap.add_argument("--rounds", type=int, default=8,
+                    help="randomized schedules per case (default 8)")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    seed = args.seed if args.seed is not None else int(time.time()) % 2**31
+    print(f"chaos suite: seed={seed} rounds={args.rounds} "
+          f"(replay: python tools/chaos_suite.py --seed {seed})")
+    res = run_chaos(seed, args.rounds, verbose=args.verbose)
+    print(json.dumps({k: v for k, v in res.items() if k != "schedules"}))
+    if res["failures"]:
+        for f in res["failures"]:
+            print(f"FAIL {f}", file=sys.stderr)
+        return 1
+    print(f"chaos suite PASSED: {res['runs']} faulted multiplies, "
+          f"all checksums correct")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
