@@ -101,6 +101,30 @@ def _probe_tpu(timeout_s: int, watchdog=None) -> bool:
     return res.ok
 
 
+def _capture_rows():
+    """Parsed BENCH_CAPTURES.jsonl rows, tolerating a torn tail line
+    (loop killed mid-append) — the one scan loop every evidence picker
+    shares; each picker applies its own filters on top of one shared
+    policy: rows whose run tripped the checksum gate (non-null
+    "checksum_retry") never count as evidence — their gflops were
+    measured on the run that produced wrong results, and picking by
+    them would steer future runs toward the corrupting configuration."""
+    try:
+        fh = open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_CAPTURES.jsonl"))
+    except OSError:
+        return
+    with fh:
+        for line in fh:
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if r.get("checksum_retry"):
+                continue
+            yield r
+
+
 def _pick_carve_from_evidence() -> str:
     """Choose the dense-carve lowering from committed on-chip A/B
     evidence (BENCH_CAPTURES.jsonl): the tier-2.5 reshape leg vs the
@@ -112,40 +136,65 @@ def _pick_carve_from_evidence() -> str:
     if "DBCSR_TPU_DENSE_CARVE" in os.environ:
         return os.environ["DBCSR_TPU_DENSE_CARVE"]
     best = {"gather": None, "reshape": None}
-    try:
-        fh = open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_CAPTURES.jsonl"))
-    except OSError:
-        return "gather"
-    with fh:
-        for line in fh:
-            # per-line tolerance: a torn tail line (loop killed
-            # mid-append) must not discard the valid evidence above it
+    for r in _capture_rows():
+        if r.get("device_fallback") or r.get("algorithm") != "dense":
+            continue
+        env = r.get("env") or {}
+        if env.get("DBCSR_TPU_BENCH_DTYPE", "3") != "3":
+            continue
+        # the record's own "carve" field (what the run actually
+        # used, incl. evidence-auto-picked) wins over the recorded
+        # extra_env — classifying auto-picked reshape runs as
+        # "gather" would self-poison the A/B
+        carve = r.get("carve") or env.get("DBCSR_TPU_DENSE_CARVE",
+                                          "gather")
+        if carve in best:
             try:
-                r = json.loads(line)
-            except ValueError:
+                v = float(r.get("value") or 0)
+            except (TypeError, ValueError):
                 continue
-            if r.get("device_fallback") or r.get("algorithm") != "dense":
-                continue
-            env = r.get("env") or {}
-            if env.get("DBCSR_TPU_BENCH_DTYPE", "3") != "3":
-                continue
-            # the record's own "carve" field (what the run actually
-            # used, incl. evidence-auto-picked) wins over the recorded
-            # extra_env — classifying auto-picked reshape runs as
-            # "gather" would self-poison the A/B
-            carve = r.get("carve") or env.get("DBCSR_TPU_DENSE_CARVE",
-                                              "gather")
-            if carve in best:
-                try:
-                    v = float(r.get("value") or 0)
-                except (TypeError, ValueError):
-                    continue
-                if best[carve] is None or v > best[carve]:
-                    best[carve] = v
+            if best[carve] is None or v > best[carve]:
+                best[carve] = v
     if best["reshape"] and best["gather"] and best["reshape"] > best["gather"]:
         return "reshape"
     return "gather"
+
+
+def _pick_stack_mode_from_evidence(dtype_enum: int, fallback: bool) -> str:
+    """Choose the stack execution mode — fused superstack launches vs
+    the per-span dispatch loop — the same way the carve and CPU-driver
+    picks work: from committed BENCH_CAPTURES rows carrying a
+    "stack_mode" field, best value per mode, winner takes the env knob.
+    Only rows of THIS run's device class count (``fallback`` — the
+    cross-device-evidence regression guard of VERDICT r4 item 2: an
+    on-chip per_span row must never steer a CPU-fallback run, or vice
+    versa), and dense-algorithm rows are ignored (the mode only
+    touches the stack engine).  Without evidence the engine default
+    stands ("auto" = fused — the measured winner at production scale,
+    see PERF_NOTES.md / tools/dispatch_bench.py)."""
+    if "DBCSR_TPU_SUPERSTACK" in os.environ:
+        return os.environ["DBCSR_TPU_SUPERSTACK"]
+    best = {"fused": None, "per_span": None}
+    for r in _capture_rows():
+        mode = r.get("stack_mode")
+        if mode not in best or r.get("algorithm") == "dense":
+            continue
+        if bool(r.get("device_fallback")) != fallback:
+            continue
+        env = r.get("env") or {}
+        if env.get("DBCSR_TPU_BENCH_DTYPE", "3") != str(dtype_enum):
+            continue
+        try:
+            v = float(r.get("value") or 0)
+        except (TypeError, ValueError):
+            continue
+        if best[mode] is None or v > best[mode]:
+            best[mode] = v
+    if best["per_span"] and best["fused"] and best["per_span"] > best["fused"]:
+        return "per_span"
+    if best["fused"]:
+        return "fused"
+    return "auto"
 
 
 def _pick_cpu_driver_from_evidence(dtype_enum: int) -> tuple[str, bool]:
@@ -167,29 +216,19 @@ def _pick_cpu_driver_from_evidence(dtype_enum: int) -> tuple[str, bool]:
     if env:
         return env, True
     best = {}
-    try:
-        fh = open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_CAPTURES.jsonl"))
-    except OSError:
-        return "auto", False
-    with fh:
-        for line in fh:
-            try:
-                r = json.loads(line)
-            except ValueError:
-                continue
-            if not r.get("device_fallback") or "mm_driver" not in r:
-                continue
-            renv = r.get("env") or {}
-            if renv.get("DBCSR_TPU_BENCH_DTYPE", "3") != str(dtype_enum):
-                continue
-            try:
-                v = float(r.get("value") or 0)
-            except (TypeError, ValueError):
-                continue
-            d = r["mm_driver"]
-            if v > best.get(d, 0.0):
-                best[d] = v
+    for r in _capture_rows():
+        if not r.get("device_fallback") or "mm_driver" not in r:
+            continue
+        renv = r.get("env") or {}
+        if renv.get("DBCSR_TPU_BENCH_DTYPE", "3") != str(dtype_enum):
+            continue
+        try:
+            v = float(r.get("value") or 0)
+        except (TypeError, ValueError):
+            continue
+        d = r["mm_driver"]
+        if v > best.get(d, 0.0):
+            best[d] = v
     if best:
         return max(best, key=best.get), True
     return "auto", False
@@ -208,31 +247,21 @@ def _pick_dense_mode_from_evidence(dtype_enum: int):
     if dtype_enum not in (1, 9) or "DBCSR_TPU_MM_DENSE" in os.environ:
         return False
     best = {"dense": None, "stack": None}
-    try:
-        fh = open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_CAPTURES.jsonl"))
-    except OSError:
-        return False
-    with fh:
-        for line in fh:
-            try:
-                r = json.loads(line)
-            except ValueError:
-                continue
-            if r.get("device_fallback"):
-                continue
-            env = r.get("env") or {}
-            if env.get("DBCSR_TPU_BENCH_DTYPE", "3") != str(dtype_enum):
-                continue
-            alg = "dense" if (r.get("algorithm") == "dense"
-                              or env.get("DBCSR_TPU_MM_DENSE") == "1") \
-                else "stack"
-            try:
-                v = float(r.get("value") or 0)
-            except (TypeError, ValueError):
-                continue
-            if best[alg] is None or v > best[alg]:
-                best[alg] = v
+    for r in _capture_rows():
+        if r.get("device_fallback"):
+            continue
+        env = r.get("env") or {}
+        if env.get("DBCSR_TPU_BENCH_DTYPE", "3") != str(dtype_enum):
+            continue
+        alg = "dense" if (r.get("algorithm") == "dense"
+                          or env.get("DBCSR_TPU_MM_DENSE") == "1") \
+            else "stack"
+        try:
+            v = float(r.get("value") or 0)
+        except (TypeError, ValueError):
+            continue
+        if best[alg] is None or v > best[alg]:
+            best[alg] = v
     return bool(best["dense"] and best["stack"]
                 and best["dense"] > best["stack"])
 
@@ -283,6 +312,11 @@ def main():
     dense_forced = _pick_dense_mode_from_evidence(
         int(os.environ.get("DBCSR_TPU_BENCH_DTYPE", "3")))
     fallback = not _probe_tpu(probe_timeout)
+    stack_mode = _pick_stack_mode_from_evidence(
+        int(os.environ.get("DBCSR_TPU_BENCH_DTYPE", "3")), fallback)
+    # must land in the env before any dbcsr_tpu import (the config
+    # singleton reads DBCSR_TPU_* once at module load)
+    os.environ["DBCSR_TPU_SUPERSTACK"] = stack_mode
     if dense_forced and not fallback:
         # the evidence is on-chip evidence: it must not steer a CPU
         # fallback run, where f32 dense has never been measured
@@ -381,9 +415,20 @@ def main():
         # regression-guarded, see _pick_cpu_driver_from_evidence);
         # null on-device where auto dispatch decides per stack
         "mm_driver": mm_driver,
+        # stack execution mode actually in effect (evidence-selected,
+        # see _pick_stack_mode_from_evidence; "auto" resolves to fused
+        # superstack launches) — null when the dense path ran instead
+        "stack_mode": (
+            ("fused" if stack_mode == "auto" else stack_mode)
+            if res.get("algorithm") == "stack" else None),
         # f32/bf16 dense-mode force, evidence-selected from the
         # tier-2.5 A/B (see _pick_dense_mode_from_evidence)
         "mm_dense_forced": dense_forced or None,
+        # non-null when the run tripped the checksum gate and survived
+        # via the safe-driver retry (perf.driver._checksum_retry_safe):
+        # the gflops were measured on the failing run, so _capture_rows
+        # excludes such rows from every evidence pick
+        "checksum_retry": (res.get("checksum_retry") or {}).get("outcome"),
         # timing forces real device completion via a data-dependent
         # 8-byte fetch per rep (driver._force_completion): on the axon
         # tunnel, block_until_ready alone can return before the work

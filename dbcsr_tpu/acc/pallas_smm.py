@@ -762,6 +762,22 @@ def lane_scatter_index(lane_c):
     return np.concatenate(arrs) if arrs else np.empty(0, np.int32)
 
 
+def process_launches(c_data, a_data, b_data, launches, alpha_arr, *,
+                     r_grp: int, kmerge: bool, interpret: bool):
+    """Chain the prepared launches of one base-pallas plan through the
+    kernel entry, accumulating into ``c_data`` (operands already carry
+    their virtual zero pad row).  This is the ONE launch loop shared by
+    `acc.smm._execute_plan` (a top-level dispatch per launch) and the
+    fused superstack program, which traces it INSIDE its own jit so a
+    whole C bin's launches ride a single dispatch."""
+    for dai, dbi, dci in launches:
+        c_data = _pallas_process(
+            c_data, a_data, b_data, dai, dbi, dci, alpha_arr,
+            r_grp=r_grp, interpret=interpret, kmerge=kmerge,
+        )
+    return c_data
+
+
 def launch_entries(launches, r_grp: int) -> int:
     """Device-work entry count of prepared launches, INCLUDING the
     grouping and bucket padding slots: what the kernel actually

@@ -88,8 +88,7 @@ def _chunk_contrib(a_data, b_data, a_idx, b_idx, c_idx, alpha, nseg, out_dtype):
     return jax.ops.segment_sum(prod, c_idx, num_segments=nseg, indices_are_sorted=True)
 
 
-@functools.partial(jax.jit, donate_argnums=0)
-def _process_stack_xla_flat(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
+def _stack_xla_flat_body(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
     """Flat-gather variant: A/B are re-laid-out once per call to
     (N, m*k) so the per-entry gathers move lane-packed rows instead of
     tile-padded (m, k) blocks — the TPU HBM layout pads the last two
@@ -121,8 +120,14 @@ def _process_stack_xla_flat(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
     return c_data
 
 
-@functools.partial(jax.jit, donate_argnums=0)
-def _process_stack_xla_group(c_data, a_data, b_data, ga, gb, gc, alpha):
+# dispatch entry: the raw body stays callable so the fused superstack
+# program can chain it inside ONE jitted program (donation is a
+# top-level dispatch property, so the fused program donates instead)
+_process_stack_xla_flat = functools.partial(jax.jit, donate_argnums=0)(
+    _stack_xla_flat_body)
+
+
+def _stack_xla_group_body(c_data, a_data, b_data, ga, gb, gc, alpha):
     """R-tiled ("k-merged") stack layout: entries sharing a C block are
     tiled into groups of R0; each group's A blocks concatenate along k
     into one (m, R0*k) strip, its B blocks into (R0*k, n), and the
@@ -167,6 +172,10 @@ def _process_stack_xla_group(c_data, a_data, b_data, ga, gb, gc, alpha):
     return c_data
 
 
+_process_stack_xla_group = functools.partial(jax.jit, donate_argnums=0)(
+    _stack_xla_group_body)
+
+
 def build_group_tiles(c_idx, a_idx, b_idx, r0: int, a_pad: int, b_pad: int,
                       c_pad: int, chunk_groups: int):
     """Host side of the grouped layout: split each C segment's entries
@@ -204,8 +213,7 @@ def build_group_tiles(c_idx, a_idx, b_idx, r0: int, a_pad: int, b_pad: int,
     )
 
 
-@functools.partial(jax.jit, donate_argnums=0)
-def _process_stack_xla(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
+def _stack_xla_body(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
     """Process a whole stack in one device program.
 
     The chunk loop lives INSIDE jit as a `lax.scan` over (nchunks, L)
@@ -226,6 +234,19 @@ def _process_stack_xla(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha):
 
     c_data, _ = jax.lax.scan(body, c_data, (a_idx, b_idx, c_idx))
     return c_data
+
+
+_process_stack_xla = functools.partial(jax.jit, donate_argnums=0)(
+    _stack_xla_body)
+
+
+def _append_pad_row(data):
+    """Append the virtual guaranteed-zero row plans index one past the
+    end of a data array (`append_a_pad`/`append_b_pad`) — the ONE
+    definition of the pad convention shared by every per-span driver
+    branch and the fused superstack program (they must agree bitwise)."""
+    return jnp.concatenate(
+        [data, jnp.zeros((1,) + data.shape[1:], data.dtype)])
 
 
 def pad_stack(a_idx, b_idx, c_idx, target_len: int, drop_segment: int):
@@ -389,6 +410,31 @@ def _note_driver(driver: str, why: str, S: int, c_data, a_data, b_data,
         mnk=(a_data.shape[1], b_data.shape[2], a_data.shape[2]),
         entries=S,
     )
+
+
+def _ensure_pallas_validated(c_data, a_data, b_data, plan: StackPlan) -> None:
+    """First-use validation of a base-pallas plan's compiled variant,
+    keyed per (m, n, k, dtype, kmerge, r_grp) — shared by the per-span
+    dispatch and the fused superstack path (which must validate OUTSIDE
+    its fused program, before the first fused launch of the shape).
+    The plan's RESOLVED r_grp is forced so the validator exercises the
+    exact compiled variant being launched (ADVICE r3)."""
+    if plan.val_idx is None or not get_config().validate_kernels:
+        return
+    key = (
+        a_data.shape[1], b_data.shape[2], a_data.shape[2],
+        str(jnp.dtype(c_data.dtype)), plan.kmerge, plan.r_grp,
+    )
+    if key in _validated_kernels:
+        return
+    ai, bi, ci = plan.val_idx
+    _validate_pallas_kernel(
+        c_data, a_data, b_data, ai, bi, ci,
+        None if plan.append_a_pad else plan.a_pad_row,
+        None if plan.append_b_pad else plan.b_pad_row,
+        plan.r_grp, variant="kmerge" if plan.kmerge else None,
+    )
+    _validated_kernels.add(key)
 
 
 def prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
@@ -1032,6 +1078,7 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
     fetching hundreds of MB of device zeros."""
     if plan is None:
         return c_data
+    record_dispatch("per_span")
     board = _breaker.get_board()
     faults_on = _faults.active()
     checks_on = faults_on or _output_checks_enabled()
@@ -1121,13 +1168,9 @@ def _execute_plan(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
         return execute_stack(c_data, a_data, b_data, plan, alpha)
     if plan.driver == "xla_group":
         if plan.append_a_pad:
-            a_data = jnp.concatenate(
-                [a_data, jnp.zeros((1,) + a_data.shape[1:], a_data.dtype)]
-            )
+            a_data = _append_pad_row(a_data)
         if plan.append_b_pad:
-            b_data = jnp.concatenate(
-                [b_data, jnp.zeros((1,) + b_data.shape[1:], b_data.dtype)]
-            )
+            b_data = _append_pad_row(b_data)
         ga, gb, gc = plan.group_idx
         alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)
         if want_xla_cost:
@@ -1159,16 +1202,8 @@ def _execute_plan(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
                         None, variant=cross_variant, pack=plan.pack,
                     )
                     _validated_kernels.add(key)
-            a_pad = a_data
-            b_pad = b_data
-            if plan.append_a_pad:
-                a_pad = jnp.concatenate(
-                    [a_data, jnp.zeros((1,) + a_data.shape[1:], a_data.dtype)]
-                )
-            if plan.append_b_pad:
-                b_pad = jnp.concatenate(
-                    [b_data, jnp.zeros((1,) + b_data.shape[1:], b_data.dtype)]
-                )
+            a_pad = _append_pad_row(a_data) if plan.append_a_pad else a_data
+            b_pad = _append_pad_row(b_data) if plan.append_b_pad else b_data
             a_data_t = jnp.swapaxes(a_pad, 1, 2)
             alpha_arr = jnp.asarray([[alpha]], dtype=jnp.float32)
             interpret = jax.devices()[0].platform != "tpu"
@@ -1238,46 +1273,20 @@ def _execute_plan(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
                 setattr(plan, slot, getattr(new_plan, slot))
             return execute_stack(c_data, a_data, b_data, plan, alpha)
     if plan.driver == "pallas":
-        from dbcsr_tpu.acc.pallas_smm import _pallas_process
+        from dbcsr_tpu.acc import pallas_smm
 
-        cfg = get_config()
-        if cfg.validate_kernels and plan.val_idx is not None:
-            # keyed per compiled kernel VARIANT: kmerge and grouping
-            # select different Pallas lowerings, each of which must pass
-            # its own first-use validation (ADVICE r3)
-            key = (
-                a_data.shape[1], b_data.shape[2], a_data.shape[2],
-                str(jnp.dtype(c_data.dtype)), plan.kmerge, plan.r_grp,
-            )
-            if key not in _validated_kernels:
-                ai, bi, ci = plan.val_idx
-                # force the plan's RESOLVED r_grp so the validator
-                # exercises the exact compiled variant being launched
-                # (not one re-derived from the 512-entry prefix)
-                _validate_pallas_kernel(
-                    c_data, a_data, b_data, ai, bi, ci,
-                    None if plan.append_a_pad else plan.a_pad_row,
-                    None if plan.append_b_pad else plan.b_pad_row,
-                    plan.r_grp, variant="kmerge" if plan.kmerge else None,
-                )
-                _validated_kernels.add(key)
+        _ensure_pallas_validated(c_data, a_data, b_data, plan)
         if plan.append_a_pad:
-            a_data = jnp.concatenate(
-                [a_data, jnp.zeros((1,) + a_data.shape[1:], a_data.dtype)]
-            )
+            a_data = _append_pad_row(a_data)
         if plan.append_b_pad:
-            b_data = jnp.concatenate(
-                [b_data, jnp.zeros((1,) + b_data.shape[1:], b_data.dtype)]
-            )
+            b_data = _append_pad_row(b_data)
         alpha_arr = jnp.asarray([[alpha]], dtype=jnp.float32)
         interpret = jax.devices()[0].platform != "tpu"
         with _enable_x64(False):
-            for dai, dbi, dci in plan.launches:
-                c_data = _pallas_process(
-                    c_data, a_data, b_data, dai, dbi, dci,
-                    alpha_arr, r_grp=plan.r_grp, interpret=interpret,
-                    kmerge=plan.kmerge,
-                )
+            c_data = pallas_smm.process_launches(
+                c_data, a_data, b_data, plan.launches, alpha_arr,
+                r_grp=plan.r_grp, kmerge=plan.kmerge, interpret=interpret,
+            )
         return c_data
     alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)
     ai, bi, ci = plan.xla_idx
@@ -1310,6 +1319,397 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
     plan = prepare_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx,
                          a_pad_row=a_pad_row, b_pad_row=b_pad_row)
     return execute_stack(c_data, a_data, b_data, plan, alpha)
+
+
+# ------------------------------------------------------------------ fused
+# superstack execution: every span (one per (abin, bbin) pair) whose
+# stack targets the SAME C bin is lowered into a single jitted program
+# with a donated C argument.  The per-span path pays, for each of a
+# bin's N spans, one Python→XLA dispatch round-trip plus a full
+# read-modify-write of the bin's device buffer; the fused launch pays
+# both exactly once per bin — the TPU-side realization of the
+# reference's stack batching (amortize launch overhead across thousands
+# of block products, `dbcsr_mm_accdrv.F:279-326`).
+
+_DISPATCHES_NAME = "dbcsr_tpu_dispatches_total"
+_DISPATCHES_HELP = (
+    "engine dispatch round-trips by mode: one per executed span in "
+    "per_span mode, one per fused C-bin (or mesh) launch in fused mode")
+_FUSED_SPANS_NAME = "dbcsr_tpu_fused_spans"
+_FUSED_SPANS_HELP = (
+    "spans (or mesh tick-chunks) carried by each single fused launch")
+_FUSED_SPANS_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+# the breaker/metrics pseudo-driver name of a fused C-bin launch: its
+# failures never condemn the per-span drivers (the failing span is
+# unknown from outside the program), they route the bin back to the
+# per-span path where the real chain takes over
+FUSED_DRIVER = "fused"
+
+
+def record_dispatch(mode: str, fused_spans: Optional[int] = None) -> None:
+    """Count one engine dispatch round-trip, and — for fused launches —
+    how many spans it carried (the amortization histogram)."""
+    _metrics.counter(_DISPATCHES_NAME, _DISPATCHES_HELP).inc(mode=mode)
+    if fused_spans is not None:
+        _metrics.histogram(
+            _FUSED_SPANS_NAME, _FUSED_SPANS_HELP,
+            buckets=_FUSED_SPANS_BUCKETS,
+        ).observe(fused_spans)
+
+
+_XLA_FAMILY = ("xla", "xla_flat", "xla_group")
+
+
+class SuperstackPlan:
+    """A prepared fused C-bin launch: the per-span `StackPlan`s (whose
+    device index arrays are reused as-is) plus the cached jitted
+    program that chains their kernels.  Built by `prepare_superstack`,
+    run by `execute_superstack`; the engine caches it next to the
+    per-span plans in `mm.multiply._plan_cache`."""
+
+    __slots__ = ("family", "sig", "plans", "fn")
+
+    def __init__(self, family, sig, plans, fn):
+        self.family = family      # "xla" | "pallas" | "host"
+        self.sig = sig
+        self.plans = plans
+        self.fn = fn
+        # staleness note: a failover heals per-span plans IN PLACE
+        # (driver changes), which invalidates this fused program — the
+        # guard lives in `mm.multiply._CachedSpans.superstack_for`,
+        # which keys the cached decision by the spans' driver tuple
+
+    def nbytes(self) -> int:
+        """Device bytes pinned beyond the per-span plans: none — the
+        fused program reuses their index arrays."""
+        return 0
+
+
+def prepare_superstack(plans) -> Optional[SuperstackPlan]:
+    """Lower the spans of one C bin (accumulation order preserved) into
+    a fused plan, or return None when they cannot fuse.
+
+    Fusable families — all spans must belong to ONE of:
+    * the pure-XLA drivers (``xla``/``xla_flat``/``xla_group``, freely
+      mixed): chained scan bodies inside one donated-C jit;
+    * ``pallas``: the base kernel's launch loop traced inside one jit
+      (`pallas_smm.process_launches`); first-use validation runs before
+      the first fused dispatch, outside the program;
+    * ``host``: the native C++ driver with ONE C fetch + writeback for
+      the whole bin instead of one per span.
+
+    ``pallas_cross`` spans keep the per-span path (their compile-
+    failure demotion and lane scatters are execute-time host logic), as
+    do mixed-family bins."""
+    if not plans or any(p is None for p in plans):
+        return None
+    drivers = [p.driver for p in plans]
+    if all(d in _XLA_FAMILY for d in drivers):
+        family = "xla"
+    elif all(d == "pallas" for d in drivers):
+        family = "pallas"
+    elif all(d == "host" for d in drivers):
+        family = "host"
+    else:
+        return None
+    if family == "host":
+        return SuperstackPlan("host", None, list(plans), None)
+    interpret = (jax.devices()[0].platform != "tpu"
+                 if family == "pallas" else False)
+    sig = tuple(
+        (
+            p.driver,
+            3 if p.driver in _XLA_FAMILY else 3 * len(p.launches),
+            bool(p.append_a_pad), bool(p.append_b_pad),
+            p.r_grp, bool(p.kmerge),
+        )
+        for p in plans
+    )
+    sig = (family, interpret, sig)
+    return SuperstackPlan(family, sig, list(plans), _fused_fn(sig))
+
+
+from collections import OrderedDict as _OrderedDict  # noqa: E402
+
+# fused callables keyed by STRUCTURE (drivers, launch counts, static
+# kernel params) — jax.jit handles shape/dtype specialization under
+# each; LRU-bounded so pattern churn cannot pin compiled programs
+_fused_fns: "_OrderedDict[tuple, object]" = _OrderedDict()
+_FUSED_FN_MAX = 128
+
+
+def _fused_fn(sig):
+    fn = _fused_fns.get(sig)
+    if fn is not None:
+        _fused_fns.move_to_end(sig)
+        return fn
+    family, interpret, spans_sig = sig
+
+    def fused(c_data, alpha_dev, *flat):
+        from dbcsr_tpu.acc import pallas_smm
+
+        pos = 0
+        for driver, n_idx, ap_a, ap_b, r_grp, kmerge in spans_sig:
+            a_data = flat[pos]
+            b_data = flat[pos + 1]
+            idx = flat[pos + 2: pos + 2 + n_idx]
+            pos += 2 + n_idx
+            if ap_a:
+                a_data = _append_pad_row(a_data)
+            if ap_b:
+                b_data = _append_pad_row(b_data)
+            if driver == "xla_group":
+                c_data = _stack_xla_group_body(
+                    c_data, a_data, b_data, *idx, alpha_dev)
+            elif driver == "pallas":
+                launches = [tuple(idx[3 * j: 3 * j + 3])
+                            for j in range(n_idx // 3)]
+                c_data = pallas_smm.process_launches(
+                    c_data, a_data, b_data, launches, alpha_dev,
+                    r_grp=r_grp, kmerge=kmerge, interpret=interpret,
+                )
+            else:
+                body = (_stack_xla_flat_body if driver == "xla_flat"
+                        else _stack_xla_body)
+                c_data = body(c_data, a_data, b_data, *idx, alpha_dev)
+        return c_data
+
+    fn = jax.jit(fused, donate_argnums=0)
+    _fused_fns[sig] = fn
+    while len(_fused_fns) > _FUSED_FN_MAX:
+        _fused_fns.popitem(last=False)
+    return fn
+
+
+def _superstack_key(c_data, nspans: int) -> tuple:
+    """Breaker/metrics shape key of a fused C-bin launch: the bin's
+    block shape + span count + dtype (per-span (m,n,k) keys stay with
+    the per-span drivers)."""
+    return (c_data.shape[1], c_data.shape[2], nspans,
+            str(jnp.dtype(c_data.dtype)))
+
+
+def _decompose_superstack(c_data, a_datas, b_datas, plans, alpha, c_zero,
+                          why: str = ""):
+    """Run a fused bin's spans through the per-span engine instead —
+    the fused path's failover contract: a fused launch never hard-fails
+    the multiply while per-span execution (with its full driver chain)
+    can still make progress.  ``c_zero`` holds for the FIRST span only
+    (later spans accumulate onto its contribution)."""
+    _trace.instant("superstack_decompose",
+                   {"why": why[:200], "spans": len(plans)})
+    _flight.note_event("superstack_decompose", why=why[:200],
+                       spans=len(plans))
+    out = c_data
+    first = True
+    for plan, a_d, b_d in zip(plans, a_datas, b_datas):
+        out = execute_stack(out, a_d, b_d, plan, alpha,
+                            c_zero=c_zero and first)
+        first = False
+    return out
+
+
+def _record_superstack_jit(splan: SuperstackPlan, c_data, a_datas,
+                           b_datas):
+    """Jit-cache mirror + per-driver device-entry accounting of one
+    fused launch (the fused analog of `_record_stack_jit`).  Returns
+    ``(compiled, key)`` so the XLA-cost capture can fire on fresh
+    specializations, like the per-span path's."""
+    from dbcsr_tpu.acc import pallas_smm
+
+    dt = str(jnp.dtype(c_data.dtype))
+    idx_shapes = []
+    for plan in splan.plans:
+        if plan.driver in ("xla", "xla_flat"):
+            idx_shapes.append(plan.xla_idx[0].shape)
+            dev_entries = int(plan.xla_idx[0].size)
+        elif plan.driver == "xla_group":
+            idx_shapes.append(plan.group_idx[0].shape)
+            dev_entries = int(plan.group_idx[0].size)
+        else:  # pallas
+            idx_shapes.append(tuple(lc[0].shape for lc in plan.launches))
+            dev_entries = pallas_smm.launch_entries(plan.launches,
+                                                    plan.r_grp)
+        _metrics.counter(
+            "dbcsr_tpu_device_entries_total",
+            "stack entries actually launched per driver, padding included",
+        ).inc(dev_entries, driver=plan.driver)
+    key = (splan.sig, c_data.shape, dt,
+           tuple(a.shape for a in a_datas),
+           tuple(b.shape for b in b_datas), tuple(idx_shapes))
+    return _metrics.record_jit("acc.smm._fused_superstack", key), key
+
+
+def _superstack_model(splan: SuperstackPlan, c_data, a_datas,
+                      b_datas) -> dict:
+    """Analytic flops/bytes of one fused launch: per-span DEVICE
+    entries (XLA counts the masked pad work too), bin C traffic charged
+    once (`costmodel.superstack_bytes` — the convention the engine's
+    per-span recording mirrors)."""
+    from dbcsr_tpu.acc import pallas_smm
+
+    spans = []
+    for plan, a_d, b_d in zip(splan.plans, a_datas, b_datas):
+        m, k = a_d.shape[1], a_d.shape[2]
+        n = b_d.shape[2]
+        if plan.driver in ("xla", "xla_flat"):
+            entries = int(plan.xla_idx[0].size)
+        elif plan.driver == "xla_group":
+            entries = int(plan.group_idx[0].size)
+        else:
+            entries = pallas_smm.launch_entries(plan.launches, plan.r_grp)
+        spans.append((m, n, k, entries))
+    return {
+        "flops": sum(_costmodel.stack_flops(m, n, k, e)
+                     for m, n, k, e in spans),
+        "bytes": _costmodel.superstack_bytes(
+            spans, nseg=c_data.shape[0],
+            itemsize=jnp.dtype(c_data.dtype).itemsize),
+    }
+
+
+def _dispatch_superstack(c_data, a_datas, b_datas, splan: SuperstackPlan,
+                         alpha, c_zero: bool):
+    """Issue one fused launch (no failover here — `execute_superstack`
+    owns the guard rails)."""
+    plans = splan.plans
+    if splan.family == "host":
+        from dbcsr_tpu import native
+
+        if c_zero:
+            c_np = np.zeros(c_data.shape, np.dtype(c_data.dtype))
+        else:
+            c_np = np.array(c_data)  # ONE writable host copy per bin
+        for plan, a_d, b_d in zip(plans, a_datas, b_datas):
+            ai, bi, ci = plan.host_idx
+            ok = native.host_smm(
+                c_np, np.asarray(a_d), np.asarray(b_d), ai, bi, ci, alpha
+            )
+            if not ok:
+                raise RuntimeError(
+                    "native host driver unavailable during a fused "
+                    "superstack launch")
+        return jnp.asarray(c_np)
+    compiled, jit_key = _record_superstack_jit(splan, c_data, a_datas,
+                                               b_datas)
+    flat = []
+    for plan, a_d, b_d in zip(plans, a_datas, b_datas):
+        flat.append(a_d)
+        flat.append(b_d)
+        if plan.driver in ("xla", "xla_flat"):
+            flat.extend(plan.xla_idx)
+        elif plan.driver == "xla_group":
+            flat.extend(plan.group_idx)
+        else:
+            for lc in plan.launches:
+                flat.extend(lc)
+    if splan.family == "pallas":
+        alpha_dev = jnp.asarray([[alpha]], dtype=jnp.float32)
+        with _enable_x64(False):
+            return splan.fn(jnp.asarray(c_data), alpha_dev, *flat)
+    alpha_dev = jnp.asarray(alpha, dtype=c_data.dtype)
+    if compiled and _costmodel.xla_capture_enabled():
+        # the fused program IS the compiled unit now: the opt-in
+        # model-vs-XLA drift check captures it whole, with the
+        # per-span analytic model summed (C round-trip charged once)
+        _costmodel.capture_xla_cost(
+            "acc.smm._fused_superstack", jit_key, splan.fn,
+            (c_data, alpha_dev, *flat),
+            model=_superstack_model(splan, c_data, a_datas, b_datas),
+        )
+    return splan.fn(c_data, alpha_dev, *flat)
+
+
+def execute_superstack(c_data, a_datas, b_datas, splan: SuperstackPlan,
+                       alpha=1.0, c_zero: bool = False):
+    """Run all spans of one C bin as a single fused dispatch, guarded
+    by the resilience layer: injected ``execute_superstack`` faults
+    fire here, a failing fused launch is recorded against the bin's
+    ``fused`` breaker and DECOMPOSES to per-span execution (where each
+    span's own driver chain applies) rather than hard-failing, and an
+    open fused breaker routes the bin per-span pre-emptively.
+
+    Returns ``(new_c_buffer, fused)`` — ``fused`` is False when the
+    bin actually ran per-span (breaker routing or failure decompose),
+    so the caller's cost accounting can charge the per-span C
+    round-trips that really happened instead of the fused convention.
+    On a fused launch the program donates the old buffer, so the N−1
+    intermediate copies of the per-span path never materialize."""
+    plans = splan.plans
+    board = _breaker.get_board()
+    faults_on = _faults.active()
+    checks_on = faults_on or _output_checks_enabled()
+    bin_key = _superstack_key(c_data, len(plans))
+    if board._breakers:
+        # a fused program cannot route around a quarantined member
+        # kernel mid-launch, so any span whose own (driver, shape)
+        # breaker is not fully closed sends the bin per-span — where
+        # execute_stack's allow() gate runs the proper trial/failover.
+        # state() is a read-only probe: it must not consume the
+        # half-open trial admission the per-span path will claim; and
+        # it must run BEFORE allow(FUSED) below, whose half-open trial
+        # admission would otherwise be consumed and never resolved
+        # (record_success/failure both skipped on this path), wedging
+        # the fused breaker in half-open for good.
+        for plan, a_d, b_d in zip(plans, a_datas, b_datas):
+            if board.state(plan.driver,
+                           _stack_shape_key(c_data, a_d, b_d)) \
+                    != _breaker.CLOSED:
+                return _decompose_superstack(
+                    c_data, a_datas, b_datas, plans, alpha, c_zero,
+                    why=f"span-breaker:{plan.driver}"), False
+        if not board.allow(FUSED_DRIVER, bin_key):
+            return _decompose_superstack(
+                c_data, a_datas, b_datas, plans, alpha, c_zero,
+                why="breaker-open"), False
+    # first-use pallas validation happens OUTSIDE the fused program;
+    # a validation failure walks the same decompose path below, where
+    # execute_stack applies the hard-open breaker + chain contract.
+    # The pristine copy is taken INSIDE the try: allow() above may have
+    # consumed the fused half-open trial admission, and a copy failure
+    # (device OOM on a big bin) must resolve that trial via
+    # record_failure below — never leave the breaker wedged half-open.
+    # c_data itself is still pristine then (nothing dispatched), so
+    # the decompose path recovers from it.
+    base = c_data
+    try:
+        if checks_on and splan.family != "host":
+            # the host family works on its own numpy copy and never
+            # mutates c_data, so the original is always recoverable
+            # there — don't pay a full-bin device copy for it
+            base = jnp.array(c_data, copy=True)
+        if splan.family == "pallas":
+            for plan, a_d, b_d in zip(plans, a_datas, b_datas):
+                _ensure_pallas_validated(c_data, a_d, b_d, plan)
+        # counted before the launch so a dispatch-then-fail round-trip
+        # (injected faults model exactly that) still shows in the
+        # per-mode comparison; the decompose's per_span dispatches are
+        # counted on top — both round-trips happened
+        record_dispatch("fused", fused_spans=len(plans))
+        if faults_on:
+            _faults.maybe_inject("execute_superstack")
+        out = _dispatch_superstack(c_data, a_datas, b_datas, splan, alpha,
+                                   c_zero)
+        if faults_on:
+            out = _faults.corrupt("execute_superstack", out)
+        if checks_on and _output_corrupted(out):
+            raise CorruptedOutputError(
+                "fused superstack launch produced non-finite output blocks")
+    except Exception as exc:  # noqa: BLE001 — classified + recorded
+        kind = _classify_failure(exc)
+        board.record_failure(FUSED_DRIVER, bin_key, kind=kind)
+        _record_driver_failure(FUSED_DRIVER, kind, exc, bin_key)
+        if _is_deleted(base):
+            # the failing launch consumed (donated) the only copy of
+            # the bin's C buffer: per-span recovery is impossible here
+            raise
+        _record_fallback(FUSED_DRIVER, "per_span", bin_key)
+        return _decompose_superstack(
+            base, a_datas, b_datas, plans, alpha, c_zero,
+            why=f"{type(exc).__name__}: {exc}"), False
+    board.record_success(FUSED_DRIVER, bin_key)
+    return out, True
 
 
 def _on_tpu() -> bool:

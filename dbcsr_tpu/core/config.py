@@ -46,6 +46,12 @@ class Config:
     # gathers move lane-packed rows instead of tile-padded blocks
     # (see acc/smm.py:_process_stack_xla_flat)
     flat_gather: bool = False
+    # fused superstack launches (acc/smm.py:execute_superstack): all
+    # spans sharing a destination C bin lower into ONE donated-C
+    # program — "auto" (fuse whenever a bin's spans can), "fused"
+    # (same, explicit), or "per_span" (the historical one-dispatch-
+    # per-span engine).  Env: DBCSR_TPU_SUPERSTACK.
+    superstack: str = "auto"
     # keep per-(m,n,k) flop statistics (ref STATISTICS block)
     keep_stats: bool = True
     # largest block dim the fused Pallas kernel handles; bigger blocks
@@ -80,6 +86,10 @@ class Config:
         if self.mm_driver not in ("auto", "xla", "xla_group", "pallas",
                                   "pallas_cross", "dense", "host"):
             raise ValueError(f"unknown mm_driver {self.mm_driver!r}")
+        if self.superstack not in ("auto", "fused", "per_span"):
+            raise ValueError(
+                f"superstack must be 'auto'/'fused'/'per_span', "
+                f"got {self.superstack!r}")
         if self.mm_stack_size <= 0:
             raise ValueError("mm_stack_size must be positive")
         if self.max_kernel_dim <= 0:
@@ -108,6 +118,11 @@ def _apply_env(cfg: Config) -> None:
             setattr(cfg, f.name, float(env))
         else:
             setattr(cfg, f.name, env)
+    # fail FAST on a typo'd env knob (DBCSR_TPU_SUPERSTACK=per-span,
+    # DBCSR_TPU_MM_DRIVER=xla_grp, ...): silently running a different
+    # configuration than the operator asked for poisons A/B evidence —
+    # the same contract set_config enforces for programmatic updates
+    cfg.validate()
 
 
 _apply_env(_cfg)

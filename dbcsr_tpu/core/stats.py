@@ -34,11 +34,15 @@ class _DriverAgg:
     """Per-driver attribution rollup: flops + modeled HBM bytes
     (`obs.costmodel` convention) + host-side dispatch seconds, with a
     per-dtype flop split so the roofline denominator can use the
-    dominant dtype's peak."""
+    dominant dtype's peak.  ``sync_stacks`` counts the regions whose
+    seconds were recorded through block_until_ready (DBCSR_TPU_SYNC_
+    TIMING at record time) — a rollup row is labeled synchronized only
+    when EVERY region was."""
     flops: int = 0
     nbytes: int = 0
     seconds: float = 0.0
     stacks: int = 0
+    sync_stacks: int = 0
     by_dtype: dict = dataclasses.field(default_factory=dict)
 
 
@@ -49,7 +53,7 @@ _totals = {"multiplies": 0, "flops": 0, "marketing_flops": 0}
 
 
 def _agg_driver(driver: str, flops: int, nbytes: int, seconds: float,
-                dtype: str, stacks: int) -> None:
+                dtype: str, stacks: int, sync: bool = False) -> None:
     """The one place the per-driver rollup is updated (callers have
     already passed the keep_stats gate)."""
     agg = _driver_agg[driver]
@@ -57,24 +61,44 @@ def _agg_driver(driver: str, flops: int, nbytes: int, seconds: float,
     agg.nbytes += nbytes
     agg.seconds += seconds
     agg.stacks += stacks
+    if sync:
+        agg.sync_stacks += stacks
     if dtype:
         agg.by_dtype[dtype] = agg.by_dtype.get(dtype, 0) + flops
 
 
+def sync_timing_enabled() -> bool:
+    """Opt-in synchronized stack timing (``DBCSR_TPU_SYNC_TIMING=1``):
+    the multiply engine times each stack/superstack launch through
+    ``jax.block_until_ready`` instead of recording dispatch-side
+    seconds, so per-driver achieved GFLOP/s in the roofline rollup
+    reflects device completion rather than async dispatch.  Each
+    record carries its own flag value (``_DriverAgg.sync_stacks``);
+    a rollup row reads ``sync=true`` only when EVERY recorded region
+    was synchronized, so mid-process flips never mislabel mixed
+    aggregates.  Read from the environment per call (once per
+    multiply) so tests and in-process A/Bs can flip it."""
+    import os
+
+    return os.environ.get("DBCSR_TPU_SYNC_TIMING") == "1"
+
+
 def record_driver(driver: str, flops: int, *, nbytes: int = 0,
                   seconds: float = 0.0, dtype: str = "",
-                  stacks: int = 1) -> None:
+                  stacks: int = 1, sync: bool = False) -> None:
     """Attribute one executed region (a stack launch, a dense matmul,
     a mesh plan execution) to its driver: flops, modeled bytes moved,
-    and host-observed seconds.  Seconds are DISPATCH-side wall time —
-    on async backends the device may still be draining, so per-driver
-    achieved GFLOP/s is an attribution signal, not a benchmark; the
-    forced-fetch bench numbers remain the ground truth."""
+    and host-observed seconds.  Seconds are DISPATCH-side wall time
+    unless the caller timed through block_until_ready and says so with
+    ``sync=True`` — on async backends the device may still be
+    draining, so per-driver achieved GFLOP/s is an attribution signal,
+    not a benchmark; the forced-fetch bench numbers remain the ground
+    truth."""
     from dbcsr_tpu.core.config import get_config
 
     if not get_config().keep_stats:
         return
-    _agg_driver(driver, flops, nbytes, seconds, dtype, stacks)
+    _agg_driver(driver, flops, nbytes, seconds, dtype, stacks, sync=sync)
 
 
 def driver_rollup() -> dict:
@@ -85,6 +109,7 @@ def driver_rollup() -> dict:
             "bytes": a.nbytes,
             "seconds": a.seconds,
             "stacks": a.stacks,
+            "sync_stacks": a.sync_stacks,
             "by_dtype": dict(a.by_dtype),
         }
         for d, a in _driver_agg.items()
@@ -93,13 +118,15 @@ def driver_rollup() -> dict:
 
 def record_stack(m: int, n: int, k: int, nentries: int, *,
                  driver: str, seconds: float | None = None,
-                 nbytes: int | None = None, dtype: str = "") -> None:
+                 nbytes: int | None = None, dtype: str = "",
+                 sync: bool = False) -> None:
     """Per-(m,n,k) stack accounting with a DRIVER breakdown — the
     reference's BLAS/SMM/ACC split (`dbcsr_mm_sched.F:390-546`) maps to
     {xla, xla_flat, xla_group, pallas, dense, mesh} here.  ``seconds``
     / ``nbytes`` / ``dtype`` additionally feed the per-driver roofline
     rollup (`record_driver`); callers without a cost model pass none
-    and still appear in the flop breakdown."""
+    and still appear in the flop breakdown.  ``sync`` marks seconds
+    timed through block_until_ready (see `sync_timing_enabled`)."""
     from dbcsr_tpu.core.config import get_config
 
     if not get_config().keep_stats:
@@ -110,7 +137,8 @@ def record_stack(m: int, n: int, k: int, nentries: int, *,
     st.nentries += nentries
     st.flops += flops
     st.by_driver[driver] = st.by_driver.get(driver, 0) + flops
-    _agg_driver(driver, flops, nbytes or 0, seconds or 0.0, dtype, 1)
+    _agg_driver(driver, flops, nbytes or 0, seconds or 0.0, dtype, 1,
+                sync=sync)
     t = _trace._tracer
     if t is not None:
         t.instant("stack", {"mnk": f"{m}x{n}x{k}", "entries": nentries,

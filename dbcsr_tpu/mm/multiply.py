@@ -1295,53 +1295,120 @@ def _rebuild_c(c: BlockSparseMatrix, new_keys: np.ndarray, beta,
 # count AND by the device bytes the plans pin.
 from collections import OrderedDict as _OrderedDict
 
-_plan_cache: "_OrderedDict[tuple, list]" = _OrderedDict()
+_plan_cache: "_OrderedDict[tuple, _CachedSpans]" = _OrderedDict()
+_plan_cache_bytes = 0  # running sum of the entries' at-insert nbytes
 _PLAN_CACHE_MAX = 16
 _PLAN_CACHE_MAX_BYTES = 256 * 1024 * 1024
 
 
-def _plan_cache_insert(key, spans_meta) -> None:
-    _plan_cache[key] = spans_meta
+class _CachedSpans:
+    """One plan-cache entry: the per-span plan tuples plus the lazily
+    built fused superstack plans per C bin (``None`` marks a bin whose
+    spans cannot fuse) and the byte size snapshot the cache's running
+    budget counter uses.  Plans mutate in place after insert (a
+    crosspack demotion frees its payload; a failover heal can swap a
+    cheap host plan for one pinning device index arrays), so every
+    cache HIT refreshes the snapshot through `refresh_nbytes` — O(this
+    entry's spans), vs the old global re-sum per insert."""
 
-    def total_bytes():
-        return sum(
-            p.nbytes() for sm in _plan_cache.values()
-            for (*_, p) in sm if p is not None
-        )
+    __slots__ = ("spans", "super_plans", "nbytes")
 
+    def __init__(self, spans):
+        self.spans = spans
+        self.super_plans: dict = {}
+        self.nbytes = sum(p.nbytes() for (*_, p) in spans if p is not None)
+
+    def refresh_nbytes(self) -> int:
+        """Recompute the snapshot from the live plans; returns the
+        delta for the cache's running byte counter."""
+        new = sum(p.nbytes() for (*_, p) in self.spans if p is not None)
+        delta = new - self.nbytes
+        self.nbytes = new
+        return delta
+
+    def superstack_for(self, cbin, plans, prepare):
+        """The bin's fused plan, (re)built whenever the spans' driver
+        tuple changed since the cached decision — a failover/demotion
+        heals plans IN PLACE, which can invalidate a built program OR
+        make a previously unfusable (None) bin fusable."""
+        drivers = tuple(p.driver for p in plans)
+        hit = self.super_plans.get(cbin)
+        if hit is not None and hit[0] == drivers:
+            return hit[1]
+        splan = prepare(plans)
+        self.super_plans[cbin] = (drivers, splan)
+        return splan
+
+
+def _plan_cache_insert(key, entry: "_CachedSpans") -> None:
+    """Insert + LRU/byte-budget eviction in O(evicted): the running
+    byte counter replaces the old re-sum of every cached plan inside
+    the eviction loop (O(cache·spans) per insert)."""
+    global _plan_cache_bytes
+    if not _plan_cache:
+        _plan_cache_bytes = 0  # tests clear() the OrderedDict directly
+    old = _plan_cache.pop(key, None)
+    if old is not None:
+        _plan_cache_bytes -= old.nbytes
+    _plan_cache[key] = entry
+    _plan_cache_bytes += entry.nbytes
     while len(_plan_cache) > _PLAN_CACHE_MAX or (
-        len(_plan_cache) > 1 and total_bytes() > _PLAN_CACHE_MAX_BYTES
+        len(_plan_cache) > 1 and _plan_cache_bytes > _PLAN_CACHE_MAX_BYTES
     ):
-        _plan_cache.popitem(last=False)
+        _, evicted = _plan_cache.popitem(last=False)
+        _plan_cache_bytes -= evicted.nbytes
+
+
+def _superstack_mode() -> str:
+    """The resolved stack execution mode: config.superstack with
+    "auto" meaning fused (fuse whenever a bin's spans can; single-span
+    bins and unfusable bins run per-span either way).  Values are
+    validated at every entry point (`Config.validate` runs for env
+    application and `set_config` alike), so a typo'd control run fails
+    fast instead of silently executing fused."""
+    from dbcsr_tpu.core.config import get_config
+
+    mode = get_config().superstack
+    return "fused" if mode == "auto" else mode
 
 
 def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
                 c_zero=False) -> int:
-    """Group candidate triples by (m,n,k) shape-bin, sort by C block, run
-    the SMM kernel per group; returns true flops."""
+    """Group candidate triples by (m,n,k) shape-bin, sort by C block,
+    and execute: spans sharing a destination C bin fuse into a single
+    donated-buffer launch (`acc.smm.execute_superstack`) unless
+    config.superstack forces the per-span path; returns true flops."""
     if len(cand_keys) == 0:
         return 0
-    from dbcsr_tpu.acc.smm import execute_stack, prepare_stack
+    from dbcsr_tpu.acc.smm import (
+        execute_stack,
+        execute_superstack,
+        prepare_stack,
+        prepare_superstack,
+    )
 
-    spans_meta = None
+    global _plan_cache_bytes
+    cached = None
     if plan_key is not None and plan_key in _plan_cache:
         _plan_cache.move_to_end(plan_key)
-        spans_meta = _plan_cache[plan_key]
+        cached = _plan_cache[plan_key]
+        # plans heal/demote in place: keep the byte budget honest
+        _plan_cache_bytes += cached.refresh_nbytes()
     _metrics.counter(
         "dbcsr_tpu_plan_cache_total",
         "stack-plan cache outcomes per multiply (uncacheable = "
         "value-dependent filtered products)",
-    ).inc(result=("hit" if spans_meta is not None
+    ).inc(result=("hit" if cached is not None
                   else "miss" if plan_key is not None else "uncacheable"))
-    if spans_meta is not None:
+    if cached is not None:
         _flight.note("plan_cache", "hit")
         # a cache hit skips prepare_stack (where decisions are noted);
         # the flight record still names the drivers actually launched
-        for _cb, _ab, _bb, m, n, k, cnt, plan in spans_meta:
+        for _cb, _ab, _bb, m, n, k, cnt, plan in cached.spans:
             if plan is not None:
                 _flight.note_driver(plan.driver, "plan-cache-hit",
                                     mnk=(m, n, k), entries=cnt)
-    if spans_meta is None:
+    if cached is None:
         c_ent = np.searchsorted(c.keys, cand_keys)
         cb = c.ent_bin[c_ent]
         ab = a.ent_bin[a_ent]
@@ -1379,34 +1446,101 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
                 b_pad_row=b_bin.count if b_bin.count < b_bin.data.shape[0] else None,
             )
             spans_meta.append((cbin, abin, bbin, m, n, k, s1 - s0, plan))
+        cached = _CachedSpans(spans_meta)
         if plan_key is not None:
-            _plan_cache_insert(plan_key, spans_meta)
+            _plan_cache_insert(plan_key, cached)
+    spans_meta = cached.spans
+    mode = _superstack_mode()
+    # opt-in synchronized timing: block on each launch before reading
+    # the clock so the recorded seconds are device-completion time
+    # (the default records dispatch-side seconds — the device may still
+    # be draining; stats.record_driver documents the contract)
+    sync = stats.sync_timing_enabled()
     flops = 0
     # beta == 0 (no window): _rebuild_c left every bin as untouched
     # jnp.zeros — the host driver can then synthesize its writable host
     # buffer as np.zeros instead of fetching ~hundreds of MB of zeros
     # off the device (first touch per bin only: later spans accumulate
-    # onto real contributions)
+    # onto real contributions; a fused launch counts as the whole bin's
+    # first touch)
     zero_bins = set(range(len(c.bins))) if c_zero else set()
     itemsize = np.dtype(c.dtype).itemsize
     dt_name = str(np.dtype(c.dtype))
-    for cbin, abin, bbin, m, n, k, cnt, plan in spans_meta:
-        t0 = time.perf_counter()
-        c.bins[cbin].data = execute_stack(
-            c.bins[cbin].data, a.bins[abin].data, b.bins[bbin].data, plan,
-            alpha, c_zero=cbin in zero_bins,
-        )
-        dt_s = time.perf_counter() - t0
-        zero_bins.discard(cbin)
-        # seconds/bytes feed the per-driver roofline rollup; seconds
-        # are dispatch-side (the device may still be draining — see
-        # stats.record_driver)
-        stats.record_stack(
-            m, n, k, cnt, driver=plan.driver, seconds=dt_s,
-            nbytes=_costmodel.stack_bytes(
-                m, n, k, cnt, nseg=c.bins[cbin].data.shape[0],
-                itemsize=itemsize),
-            dtype=dt_name,
-        )
-        flops += 2 * m * n * k * cnt
+    fused_bins = 0
+    i = 0
+    n_spans = len(spans_meta)
+    while i < n_spans:
+        # spans sharing a C bin are adjacent (the group key sorts by
+        # (cbin, abin, bbin)) — one slice per destination bin
+        j = i
+        cbin = spans_meta[i][0]
+        while j < n_spans and spans_meta[j][0] == cbin:
+            j += 1
+        group = spans_meta[i:j]
+        splan = None
+        if mode != "per_span" and j - i > 1:
+            splan = cached.superstack_for(
+                cbin, [sm[7] for sm in group], prepare_superstack)
+        if splan is not None:
+            a_datas = [a.bins[sm[1]].data for sm in group]
+            b_datas = [b.bins[sm[2]].data for sm in group]
+            t0 = time.perf_counter()
+            out, was_fused = execute_superstack(
+                c.bins[cbin].data, a_datas, b_datas, splan, alpha,
+                c_zero=cbin in zero_bins,
+            )
+            if sync:
+                jax.block_until_ready(out)
+            dt_s = time.perf_counter() - t0
+            c.bins[cbin].data = out
+            zero_bins.discard(cbin)
+            fused_bins += was_fused
+            nseg = out.shape[0]
+            span_flops = [2 * m * n * k * cnt
+                          for (_, _, _, m, n, k, cnt, _) in group]
+            tot_flops = float(sum(span_flops)) or 1.0
+            for gi, (_cb, _ab, _bb, m, n, k, cnt, plan) in enumerate(group):
+                # the launch's seconds split across its spans by flop
+                # share; a FUSED launch reads+writes the bin's C buffer
+                # ONCE, so only the first span is charged that round
+                # trip (costmodel.superstack_bytes convention) — but a
+                # bin the resilience layer decomposed really paid the
+                # per-span round-trips, and records them as such
+                stats.record_stack(
+                    m, n, k, cnt, driver=plan.driver,
+                    seconds=dt_s * (span_flops[gi] / tot_flops),
+                    nbytes=_costmodel.stack_bytes(
+                        m, n, k, cnt,
+                        nseg=(nseg if (gi == 0 or not was_fused) else 0),
+                        itemsize=itemsize),
+                    dtype=dt_name, sync=sync,
+                )
+                flops += span_flops[gi]
+            i = j
+            continue
+        for _cb, abin, bbin, m, n, k, cnt, plan in group:
+            t0 = time.perf_counter()
+            out = execute_stack(
+                c.bins[cbin].data, a.bins[abin].data, b.bins[bbin].data,
+                plan, alpha, c_zero=cbin in zero_bins,
+            )
+            if sync:
+                jax.block_until_ready(out)
+            dt_s = time.perf_counter() - t0
+            c.bins[cbin].data = out
+            zero_bins.discard(cbin)
+            stats.record_stack(
+                m, n, k, cnt, driver=plan.driver, seconds=dt_s,
+                nbytes=_costmodel.stack_bytes(
+                    m, n, k, cnt, nseg=out.shape[0], itemsize=itemsize),
+                dtype=dt_name, sync=sync,
+            )
+            flops += 2 * m * n * k * cnt
+        i = j
+    if fused_bins:
+        _flight.note("fused_bins", fused_bins)
+    if plan_key is not None and plan_key in _plan_cache:
+        # execution can heal plans in place (failover/demotion) — keep
+        # the byte budget honest even for an entry never hit again
+        _plan_cache_bytes += cached.refresh_nbytes()
     return flops

@@ -57,6 +57,23 @@ def stack_bytes(m: int, n: int, k: int, entries: int, *,
     return itemsize * (entries * (m * k + k * n) + 2 * nseg * m * n)
 
 
+def superstack_bytes(span_shapes, *, nseg: int, itemsize: int = 8) -> int:
+    """Modeled HBM traffic of one FUSED C-bin launch: every span still
+    gathers its own A/B blocks, but the bin's C buffer is read+written
+    exactly once for the whole launch — the N−1 C round-trips the
+    per-span path pays are the traffic fusion eliminates, so charging
+    them would overstate bytes and understate the fused roofline
+    fraction.  ``span_shapes`` is an iterable of (m, n, k, entries)
+    sharing one (m, n); equals the sum of per-span `stack_bytes` where
+    only the first span passes ``nseg`` and the rest pass ``nseg=0``
+    (the convention `mm.multiply._run_stacks` records)."""
+    gather = 0
+    m = n = 0
+    for m, n, k, entries in span_shapes:
+        gather += entries * (m * k + k * n)
+    return itemsize * (gather + 2 * nseg * m * n)
+
+
 def dense_cost(m: int, n: int, k: int, *, itemsize: int = 8) -> dict:
     """FLOPs/bytes of one dense (m,k)x(k,n) canvas matmul: read A and
     B once, write (and read, for beta-merge) C once."""

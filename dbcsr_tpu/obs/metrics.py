@@ -203,6 +203,12 @@ def _roofline_rollup() -> dict:
         rl = costmodel.roofline(agg["flops"], agg["bytes"],
                                 agg["seconds"], kind=kind, dtype=dtype)
         rl["stacks"] = agg["stacks"]
+        # sync=true only when EVERY recorded region was timed through
+        # block_until_ready (DBCSR_TPU_SYNC_TIMING at record time) —
+        # a mixed aggregate must not present async dispatch rates as
+        # device-completion rates
+        rl["sync"] = bool(agg["stacks"]) and (
+            agg["sync_stacks"] == agg["stacks"])
         out[driver] = rl
         gauge("dbcsr_tpu_achieved_gflops",
               "flops / dispatch seconds per stack driver").set(

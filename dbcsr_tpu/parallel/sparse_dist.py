@@ -288,6 +288,20 @@ def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=0, nticks=None):
     return c
 
 
+def _record_mesh_dispatch(stacks_dev, r0: int) -> None:
+    """Account one mesh launch through the fused-dispatch metrics
+    (`acc.smm.record_dispatch`): the whole multiply — every tick's
+    `_tick_chunks` sub-chunk — rides a single SPMD program, i.e. the
+    mesh engine is natively on the fused path the single-chip
+    superstack engine reaches per C bin.  ``stacks_dev`` is the
+    (..., nticks, s_cap, width) device stack array."""
+    from dbcsr_tpu.acc.smm import record_dispatch
+
+    nticks, s_cap = stacks_dev.shape[-3], stacks_dev.shape[-2]
+    nchunk, _ = _tick_chunks(s_cap, r0)
+    record_dispatch("fused", fused_spans=nticks * nchunk)
+
+
 def _vcol(k: np.ndarray, kl: int, s: int):
     """k block -> (layer, panel column): the k axis is an image
     distribution of multiplicity kl over the s physical columns
@@ -1063,6 +1077,7 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         s=pr, nticks=plan.nticks, gather=not cannon, cap_c=cap_c,
         acc_name=plan.acc_name, mesh_ref=_HashableMesh(mesh), r0=r0,
     )
+    _record_mesh_dispatch(plan.stacks_dev, r0)
 
     # ---- device-side collect into shape bins (C stays resident) ----
     out = BlockSparseMatrix(
@@ -1513,6 +1528,7 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         s=s, cap_c=q * cap_c, acc_name=plan.acc_name,
         mesh_ref=_HashableMesh(mesh), r0=r0,
     )
+    _record_mesh_dispatch(plan.stacks_dev, r0)
 
     # ---- device-side collect (groups disjoint: no reduction) ----
     out = BlockSparseMatrix(

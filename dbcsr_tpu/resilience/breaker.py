@@ -26,6 +26,14 @@ refreshes the ``dbcsr_tpu_breaker_state{driver,shape}`` gauge
 wiring: record_failure/record_success around each launch, allow() as
 the pre-launch gate.
 
+Fused superstack launches (`acc.smm.execute_superstack`) register
+under the pseudo-driver ``"fused"`` keyed by the C bin's (m, n,
+span-count, dtype): a failing fused launch can't name the guilty span
+from outside its program, so instead of condemning a real driver it
+trips the bin's fused breaker and DECOMPOSES to per-span execution —
+where these per-(driver, shape) breakers and the failover chain apply
+as usual.  An open fused breaker routes the bin per-span up front.
+
 Stdlib-only; the clock is injectable for deterministic tests.
 """
 
