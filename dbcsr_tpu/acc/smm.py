@@ -37,6 +37,7 @@ import numpy as np
 from dbcsr_tpu.core.config import get_config
 from dbcsr_tpu.core.kinds import real_dtype_of
 from dbcsr_tpu.obs import costmodel as _costmodel
+from dbcsr_tpu.obs import events as _events
 from dbcsr_tpu.obs import flight as _flight
 from dbcsr_tpu.obs import metrics as _metrics
 from dbcsr_tpu.obs import tracer as _trace
@@ -951,13 +952,14 @@ def _record_driver_failure(driver: str, kind: str, exc, shape_key) -> None:
         "dbcsr_tpu_driver_failures_total",
         "stack-driver execution failures by driver and failure kind",
     ).inc(driver=driver, kind=kind)
-    _trace.instant("driver_failure", {
-        "driver": driver, "kind": kind,
-        "shape": "x".join(str(x) for x in shape_key),
-        "error": f"{type(exc).__name__}: {exc}"[:200],
-    })
-    _flight.note_event("driver_failure", driver=driver, kind=kind,
-                       error=f"{type(exc).__name__}: {exc}"[:200])
+    err = f"{type(exc).__name__}: {exc}"[:200]
+    _events.publish(
+        "driver_failure",
+        {"driver": driver, "kind": kind,
+         "shape": "x".join(str(x) for x in shape_key), "error": err},
+        flight=("driver_failure",
+                {"driver": driver, "kind": kind, "error": err}),
+    )
 
 
 def _record_fallback(from_driver: str, to_driver: str, shape_key) -> None:
@@ -965,11 +967,12 @@ def _record_fallback(from_driver: str, to_driver: str, shape_key) -> None:
         "dbcsr_tpu_driver_fallback_total",
         "stacks re-executed on a safer driver after a chain failover",
     ).inc(**{"from": from_driver, "to": to_driver})
-    _trace.instant("driver_failover", {
-        "from": from_driver, "to": to_driver,
-        "shape": "x".join(str(x) for x in shape_key),
-    })
-    _flight.note_event("failover", **{"from": from_driver, "to": to_driver})
+    _events.publish(
+        "driver_failover",
+        {"from": from_driver, "to": to_driver,
+         "shape": "x".join(str(x) for x in shape_key)},
+        flight=("failover", {"from": from_driver, "to": to_driver}),
+    )
 
 
 def _run_candidate(base, a_data, b_data, fb_plan, alpha, c_zero,
@@ -1497,10 +1500,11 @@ def _decompose_superstack(c_data, a_datas, b_datas, plans, alpha, c_zero,
     the multiply while per-span execution (with its full driver chain)
     can still make progress.  ``c_zero`` holds for the FIRST span only
     (later spans accumulate onto its contribution)."""
-    _trace.instant("superstack_decompose",
-                   {"why": why[:200], "spans": len(plans)})
-    _flight.note_event("superstack_decompose", why=why[:200],
-                       spans=len(plans))
+    _events.publish(
+        "superstack_decompose", {"why": why[:200], "spans": len(plans)},
+        flight=("superstack_decompose",
+                {"why": why[:200], "spans": len(plans)}),
+    )
     out = c_data
     first = True
     for plan, a_d, b_d in zip(plans, a_datas, b_datas):

@@ -41,7 +41,37 @@ def finalize_lib(print_stats: bool = False, out=print) -> None:
     _initialized = False
 
 
+def _print_obs_summary(out=print) -> None:
+    """Finalize parity for the obs layers: when any of them captured
+    something this process (trace session, event bus, introspection
+    endpoint — `obs.obs_active`), the end-of-run report also emits ONE
+    machine-readable JSON line: the full `metrics.snapshot()` (the
+    per-driver roofline rollup, recompile mirror, every counter) plus
+    the final `health.verdict()` — DBCSR's finalize-time STATISTICS
+    block, extended to cover what the live ops plane was watching.
+    Emitted through the same ``out=`` hook as the legacy tables so
+    capture harnesses that redirect one redirect both."""
+    try:
+        from dbcsr_tpu import obs
+        from dbcsr_tpu.obs import health as _health
+        from dbcsr_tpu.obs import metrics as _metrics
+
+        if not obs.obs_active():
+            return
+        import json
+
+        out(" -" + "OBS SNAPSHOT (machine-readable)".center(68) + "-")
+        out(json.dumps({
+            "obs_schema": obs.OBS_SCHEMA_VERSION,
+            "snapshot": _metrics.snapshot(),
+            "health": _health.verdict(),
+        }, default=str))
+    except Exception:
+        pass  # the legacy report must never fail on the obs extension
+
+
 def print_statistics(out=print) -> None:
     """Ref `dbcsr_print_statistics` (`src/core/dbcsr_lib.F:326`)."""
     stats.print_statistics(out=out)
     timings.report(out=out)
+    _print_obs_summary(out=out)

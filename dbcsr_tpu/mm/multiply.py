@@ -46,6 +46,7 @@ from dbcsr_tpu.core.matrix import (
 )
 from dbcsr_tpu.core.timings import timed
 from dbcsr_tpu.obs import costmodel as _costmodel
+from dbcsr_tpu.obs import events as _events
 from dbcsr_tpu.obs import flight as _flight
 from dbcsr_tpu.obs import metrics as _metrics
 from dbcsr_tpu.obs import tracer as _trace
@@ -184,18 +185,25 @@ def multiply(
         no_limits = all(
             x is None for x in (first_row, last_row, first_col, last_col, first_k, last_k)
         )
-        # flight record + span attributes for this product (obs layer):
-        # shapes/occupancy now, driver decisions and per-phase ms as the
-        # engine makes them, committed on return OR error
+        # flight record + span attributes + correlation id for this
+        # product (obs layer): shapes/occupancy now, driver decisions
+        # and per-phase ms as the engine makes them, committed on
+        # return OR error.  The product_id ties every bus event this
+        # multiply causes (breaker trips, faults, failovers, recompiles)
+        # to this one record across all three stores.
+        product_id = _events.begin_product(
+            name=c.name, mnk=[c.nfullrows, c.nfullcols, a.nfullcols])
         _flight.begin(
             op="multiply", name=c.name,
             mnk=(c.nfullrows, c.nfullcols, a.nfullcols),
             occ_a=round(a.occupation(), 4), occ_b=round(b.occupation(), 4),
             occ_c=round(c.occupation(), 4),
             filter_eps=filter_eps, retain_sparsity=retain_sparsity,
+            product_id=product_id,
         )
         _trace.annotate(
             name=c.name, m=c.nfullrows, n=c.nfullcols, k=a.nfullcols,
+            product_id=product_id,
         )
         try:
             flops = _multiply_body(
@@ -204,12 +212,15 @@ def multiply(
                 beta_window, no_limits,
             )
         except Exception as exc:
-            _flight.commit(error=f"{type(exc).__name__}: {exc}")
+            err = f"{type(exc).__name__}: {exc}"
+            rec = _flight.commit(error=err)
+            _events.end_product(rec=rec, error=err)
             raise
         _flight.note("flops", flops)
         _flight.note("algorithm", getattr(c, "_mm_algorithm", "?"))
         _trace.annotate(algorithm=getattr(c, "_mm_algorithm", "?"))
-        _flight.commit()
+        rec = _flight.commit()
+        _events.end_product(rec=rec)
         return flops
 
 

@@ -16,6 +16,16 @@ NVTX/cachegrind hooks — rebuilt machine-readable:
 * `flight` — bounded ring of the last N multiplies (shapes, driver
   decisions + why, per-phase ms, memory high-water), dumped on error
   by `perf/driver.py` / `bench.py` or on demand via `flight.dump()`.
+* `events` — the unified structured-event bus (PR 5): one bounded
+  ring + optional sharded JSONL sink, every resilience/perf emission
+  published through it with a per-multiply ``product_id`` correlation
+  key shared with the flight record and the multiply span.
+* `health` — per-component OK/DEGRADED/CRITICAL verdicts folded from
+  breaker states, watchdog streaks, failure rates and roofline
+  fractions, plus rolling-window anomaly detectors.
+* `server` — opt-in stdlib HTTP introspection endpoint
+  (``DBCSR_TPU_OBS_PORT``): ``/metrics``, ``/healthz``, ``/flight``,
+  ``/events?product_id=…``; `tools/doctor.py` is the CLI reader.
 
 Existing call sites need no churn: `core.timings.timed()` and
 `core.stats.record_*` feed the tracer automatically, and the multiply
@@ -25,8 +35,11 @@ hot-path cost is one attribute check per event site.
 
 from dbcsr_tpu.obs import tracer
 from dbcsr_tpu.obs import flight
+from dbcsr_tpu.obs import events
 from dbcsr_tpu.obs import costmodel
 from dbcsr_tpu.obs import metrics
+from dbcsr_tpu.obs import health
+from dbcsr_tpu.obs import server
 
 from dbcsr_tpu.obs.tracer import (  # noqa: F401
     add as trace_add,
@@ -38,9 +51,11 @@ from dbcsr_tpu.obs.tracer import (  # noqa: F401
 
 # version stamp for machine-readable obs artifacts (bench capture JSON,
 # trace shards, perf-gate reports): bump when the schema of any of
-# them changes incompatibly.  v2 = trace sharding + roofline/costmodel
-# fields (PR 2); v1 = the original obs subsystem (PR 1).
-OBS_SCHEMA_VERSION = 2
+# them changes incompatibly.  v3 = event bus JSONL + product_id
+# correlation + health verdicts (PR 5); v2 = trace sharding +
+# roofline/costmodel fields (PR 2); v1 = the original obs subsystem
+# (PR 1).
+OBS_SCHEMA_VERSION = 3
 
 
 def enable_trace(path: str | None = None) -> "tracer.Tracer":
@@ -61,9 +76,21 @@ def get_tracer() -> "tracer.Tracer | None":
     return tracer.get()
 
 
+def obs_active() -> bool:
+    """Did any OPT-IN/live obs layer capture something this process?
+    True when a trace session is (or was) active, the event bus holds
+    records or streams to a sink, or the introspection endpoint is
+    serving — the gate `core.lib.finalize_lib` uses to decide whether
+    the end-of-run report should include the machine-readable
+    snapshot + health verdict next to the legacy stats tables."""
+    return (tracer.active() or server.running() or events.sink_active()
+            or (events.enabled() and bool(events.records(limit=1))))
+
+
 __all__ = [
-    "tracer", "flight", "metrics", "costmodel",
+    "tracer", "flight", "metrics", "costmodel", "events", "health",
+    "server",
     "enable_trace", "disable_trace", "trace_enabled", "get_tracer",
     "annotate", "trace_add", "instant", "shard_path",
-    "write_chrome_trace", "OBS_SCHEMA_VERSION",
+    "write_chrome_trace", "OBS_SCHEMA_VERSION", "obs_active",
 ]

@@ -1,0 +1,488 @@
+"""Health model: fold the engine's live signals into per-component
+OK / DEGRADED / CRITICAL verdicts with machine-readable reasons.
+
+The serving-stack counterpart of the reference's end-of-run report:
+where `dbcsr_print_statistics` answers "what did this run do" after
+the fact, `verdict()` answers "is this process healthy NOW" — the JSON
+behind `obs.server`'s ``/healthz`` and the table `tools/doctor.py`
+prints.
+
+**Components**
+
+* ``drivers`` — circuit-breaker board state (`resilience.breaker`):
+  any open/half-open breaker degrades; an open breaker on the safe
+  ``xla`` driver (the chain's backstop) or ≥4 concurrently open
+  breakers is critical.
+* ``watchdog`` — wedge streaks per guarded channel
+  (`dbcsr_tpu_watchdog_wedge_streak`): streak ≥1 degrades, ≥3 critical
+  (the capture loop's backoff has reached hours by then).
+* ``engine`` — proven numeric corruption (checksum retries classified
+  ``deterministic``/``unstable``) is critical; a degraded-to-serial
+  world join or an active fallback/recompile storm degrades.
+* ``perf`` — an active roofline-collapse anomaly degrades; the
+  per-driver roofline fractions ride along for inspection.
+
+**Anomaly detectors** (rolling windows over the last
+``DBCSR_TPU_HEALTH_WINDOW`` = 64 multiplies, fed by
+`events.end_product`; noise convention = `tools/perf_gate.py`'s
+median/MAD):
+
+* ``recompile_storm`` — fresh XLA specializations per multiply over
+  the window exceed 0.5 (steady state is ~0: the jit caches absorb
+  repeats; a storm means shape churn is recompiling every multiply).
+* ``fallback_storm`` — chain failovers per multiply over the window
+  exceed 0.25 (a quarantined driver is being re-routed constantly).
+* ``dispatch_latency_spike`` — a multiply's wall time exceeds
+  ``median * (1 + max(0.5, 3*MAD/median))`` of the window.
+* ``roofline_collapse`` — a driver's per-multiply roofline fraction
+  drops below half the window median (device silently throttled,
+  tunnel latency regime change).
+
+Each detector fires on the RISING edge only (publishing an ``anomaly``
+bus event + ``dbcsr_tpu_anomalies_total{kind}``) and re-arms when the
+signal returns below threshold — no per-multiply alert storms.
+
+Thresholds are env-tunable (``DBCSR_TPU_HEALTH_*``); the clock-free
+design (windows keyed by multiply count, not wall time) keeps verdicts
+deterministic for tests.  Stdlib-only at import; `core.stats` /
+`resilience.breaker` / `obs.costmodel` are reached lazily.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+
+OK = "OK"
+DEGRADED = "DEGRADED"
+CRITICAL = "CRITICAL"
+
+_RANK = {OK: 0, DEGRADED: 1, CRITICAL: 2}
+
+ANOMALY_KINDS = ("recompile_storm", "fallback_storm",
+                 "dispatch_latency_spike", "roofline_collapse")
+
+_lock = threading.Lock()
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _window_n() -> int:
+    return max(8, _env_int("DBCSR_TPU_HEALTH_WINDOW", 64))
+
+
+# minimum samples before any detector may fire (half a window floor)
+_MIN_SAMPLES = 8
+
+# rolling per-multiply samples: dicts {dur_ms, recompiles, fallbacks}
+_samples: collections.deque = collections.deque(maxlen=_window_n())
+# running window sums (updated incrementally on append/evict: the
+# storm detectors must not re-sum 64 samples per multiply — the bus-on
+# budget is micro-seconds)
+_sums = {"recompiles": 0.0, "fallbacks": 0.0}
+# latency threshold cache: (median, threshold_ms), refreshed every
+# _LAT_REFRESH observes (a full median/MAD pass per multiply is the
+# single most expensive part of the naive detector)
+_lat_cache: list = [0.0, None, 0]  # [median_ms, threshold_ms, age]
+_LAT_REFRESH = 8
+# per-driver roofline-fraction history (per-multiply deltas)
+_rl_hist: dict = {}
+# counter totals at the last observe (for per-multiply deltas)
+_last = {"compiles": 0.0, "fallbacks": 0.0}
+# per-driver rollup totals at the last observe
+_last_rollup: dict = {}
+# per-(kind, dtype) peak cache for the roofline observer (peaks_for
+# re-reads the environment per call; health samples every multiply)
+_peak_cache: dict = {}
+# env-tunable thresholds, read once (reset() re-reads; tests that
+# monkeypatch DBCSR_TPU_HEALTH_* must call health.reset())
+_th_cache: dict = {}
+# rising-edge state per anomaly kind (roofline keyed per driver)
+_active: dict = {}
+
+
+def _threshold(name: str, default: float) -> float:
+    v = _th_cache.get(name)
+    if v is None:
+        v = _th_cache[name] = _env_float(name, default)
+    return v
+
+
+def median(xs) -> float:
+    xs = sorted(xs)
+    n = len(xs)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    return float(xs[mid]) if n % 2 else (xs[mid - 1] + xs[mid]) / 2.0
+
+
+def mad(xs) -> float:
+    m = median(xs)
+    return median([abs(x - m) for x in xs])
+
+
+def reset() -> None:
+    """Drop the rolling windows, detector states and cached env
+    thresholds (tests; paired with `metrics.reset`)."""
+    with _lock:
+        _samples.clear()
+        _sums["recompiles"] = 0.0
+        _sums["fallbacks"] = 0.0
+        _lat_cache[0], _lat_cache[1], _lat_cache[2] = 0.0, None, 0
+        _rl_hist.clear()
+        _active.clear()
+        _last["compiles"] = 0.0
+        _last["fallbacks"] = 0.0
+        _last_rollup.clear()
+        _peak_cache.clear()
+        _th_cache.clear()
+
+
+def _counter_total(name: str) -> float:
+    from dbcsr_tpu.obs import metrics
+
+    c = metrics._counters.get(name)
+    return float(sum(c.values.values())) if c is not None else 0.0
+
+
+def _counter_by(name: str) -> dict:
+    from dbcsr_tpu.obs import metrics
+
+    c = metrics._counters.get(name)
+    return dict(c.values) if c is not None else {}
+
+
+def _fire(kind: str, state_key, args: dict) -> None:
+    """Rising-edge anomaly emission: one bus event + one counter inc
+    per entry into the anomalous state."""
+    if _active.get(state_key):
+        return
+    _active[state_key] = True
+    from dbcsr_tpu.obs import events as _events
+    from dbcsr_tpu.obs import metrics
+
+    metrics.counter(
+        "dbcsr_tpu_anomalies_total",
+        "health-model anomaly detections by kind",
+    ).inc(kind=kind)
+    _events.publish("anomaly", dict(args, kind=kind), flight=True)
+
+
+def _clear_state(state_key) -> None:
+    _active.pop(state_key, None)
+
+
+def observe_multiply(dur_ms: float | None = None,
+                     error: str | None = None) -> None:
+    """Feed one finished multiply into the rolling windows and run the
+    anomaly detectors.  Called by `events.end_product` (bus on only);
+    micro-second budget: running window sums, a cached latency
+    threshold refreshed every `_LAT_REFRESH` observes, and a cached
+    peak table — no O(window) pass on the common path."""
+    if error is not None:
+        # a failed multiply's wall time is chain-walk time, not
+        # dispatch latency: keep its recompile/fallback deltas in the
+        # storm windows but keep it out of the latency median
+        dur_ms = None
+    compiles = _counter_total("dbcsr_tpu_jit_compiles_total")
+    fallbacks = _counter_total("dbcsr_tpu_driver_fallback_total")
+    with _lock:
+        if compiles < _last["compiles"] or fallbacks < _last["fallbacks"]:
+            # a counter shrank: metrics.reset() ran mid-run — resync
+            # the baselines instead of clamping every delta to zero
+            # until the fresh counters outgrow the stale totals (which
+            # would silently disarm the storm detectors)
+            _last["compiles"] = compiles
+            _last["fallbacks"] = fallbacks
+        d_comp = max(0.0, compiles - _last["compiles"])
+        d_fall = max(0.0, fallbacks - _last["fallbacks"])
+        _last["compiles"] = compiles
+        _last["fallbacks"] = fallbacks
+        # -- latency spike: vs the PRIOR window's cached median/MAD
+        # threshold (refreshed every _LAT_REFRESH appends — a detector
+        # threshold, not a benchmark; staleness of <8 samples is noise)
+        n_prior = len(_samples)
+        if dur_ms is not None and n_prior >= _MIN_SAMPLES:
+            _lat_cache[2] += 1
+            if _lat_cache[1] is None or _lat_cache[2] >= _LAT_REFRESH:
+                durs = [s["dur_ms"] for s in _samples
+                        if s["dur_ms"] is not None]
+                med = median(durs) if durs else 0.0
+                if med > 0:
+                    rel = max(
+                        _threshold("DBCSR_TPU_HEALTH_LATENCY_RELTOL", 0.5),
+                        3.0 * mad(durs) / med)
+                    _lat_cache[0] = med
+                    _lat_cache[1] = med * (1.0 + rel)
+                else:
+                    _lat_cache[1] = None
+                _lat_cache[2] = 0
+        spike_th = _lat_cache[1] if (dur_ms is not None
+                                     and n_prior >= _MIN_SAMPLES) else None
+        # -- append + running sums (evict before the deque drops it)
+        if len(_samples) == _samples.maxlen:
+            old = _samples[0]
+            _sums["recompiles"] -= old["recompiles"]
+            _sums["fallbacks"] -= old["fallbacks"]
+        _samples.append({"dur_ms": dur_ms, "recompiles": d_comp,
+                         "fallbacks": d_fall})
+        _sums["recompiles"] += d_comp
+        _sums["fallbacks"] += d_fall
+        n = len(_samples)
+        sum_comp, sum_fall = _sums["recompiles"], _sums["fallbacks"]
+    # -- storms: rate over the window (running sums) ------------------
+    if n >= _MIN_SAMPLES:
+        rate = sum_comp / n
+        th = _threshold("DBCSR_TPU_HEALTH_RECOMPILE_RATE", 0.5)
+        if rate > th:
+            _fire("recompile_storm", "recompile_storm",
+                  {"rate_per_multiply": round(rate, 3), "threshold": th,
+                   "window": n})
+        else:
+            _clear_state("recompile_storm")
+        rate = sum_fall / n
+        th = _threshold("DBCSR_TPU_HEALTH_FALLBACK_RATE", 0.25)
+        if rate > th:
+            _fire("fallback_storm", "fallback_storm",
+                  {"rate_per_multiply": round(rate, 3), "threshold": th,
+                   "window": n})
+        else:
+            _clear_state("fallback_storm")
+    if spike_th is not None:
+        if dur_ms > spike_th:
+            _fire("dispatch_latency_spike", "dispatch_latency_spike",
+                  {"dur_ms": round(dur_ms, 3),
+                   "median_ms": round(_lat_cache[0], 3),
+                   "threshold_ms": round(spike_th, 3)})
+        else:
+            _clear_state("dispatch_latency_spike")
+    _observe_roofline()
+
+
+def _attainable(kind: str, dtype: str, d_fl: float, d_by: float) -> float:
+    """min(peak compute, intensity * bandwidth) with the (kind, dtype)
+    peak pair cached — `costmodel.peaks_for` re-reads the environment
+    per call, too heavy for a per-multiply sample."""
+    key = (kind, dtype)
+    pk = _peak_cache.get(key)
+    if pk is None:
+        from dbcsr_tpu.obs import costmodel
+
+        pk = _peak_cache[key] = (costmodel.peak_gflops(kind, dtype),
+                                 float(costmodel.peaks_for(kind)["gbs"]))
+    peak, gbs = pk
+    if d_by > 0:
+        return min(peak, (d_fl / d_by) * gbs)
+    return peak
+
+
+def _observe_roofline() -> None:
+    """Per-driver roofline fraction of the work THIS multiply added
+    (delta of the cumulative rollup), appended to per-driver history;
+    collapse = current below half the window median."""
+    try:
+        from dbcsr_tpu.core import stats
+        from dbcsr_tpu.obs import costmodel
+    except Exception:
+        return
+    kind = costmodel.device_kind()
+    ratio = _threshold("DBCSR_TPU_HEALTH_COLLAPSE_RATIO", 0.5)
+    with _lock:
+        for driver, agg in stats._driver_agg.items():
+            prev = _last_rollup.get(driver, (0, 0, 0.0))
+            if agg.flops < prev[0]:  # stats.reset() ran mid-run: resync
+                _last_rollup[driver] = (agg.flops, agg.nbytes, agg.seconds)
+                continue
+            d_fl = agg.flops - prev[0]
+            d_by = agg.nbytes - prev[1]
+            d_s = agg.seconds - prev[2]
+            if d_fl <= 0 or d_s <= 0:
+                continue
+            _last_rollup[driver] = (agg.flops, agg.nbytes, agg.seconds)
+            dtype = max(agg.by_dtype, key=agg.by_dtype.get) \
+                if agg.by_dtype else "float64"
+            attainable = _attainable(kind, dtype, d_fl, d_by)
+            frac = (d_fl / d_s / 1e9) / attainable if attainable else 0.0
+            hist = _rl_hist.setdefault(
+                driver, collections.deque(maxlen=_window_n()))
+            n_prior = len(hist)
+            if n_prior >= _MIN_SAMPLES:
+                med = median(hist)
+                if med > 1e-6 and frac < ratio * med:
+                    _fire("roofline_collapse", ("roofline_collapse", driver),
+                          {"driver": driver, "fraction": round(frac, 5),
+                           "window_median": round(med, 5),
+                           "threshold": round(ratio * med, 5)})
+                else:
+                    _clear_state(("roofline_collapse", driver))
+            hist.append(frac)
+
+
+def active_anomalies() -> dict:
+    """{kind: [detail…]} of detectors currently in the anomalous
+    state (rising-edge flags, not historical counts)."""
+    out: dict = {}
+    with _lock:
+        for key, on in _active.items():
+            if not on:
+                continue
+            if isinstance(key, tuple):
+                out.setdefault(key[0], []).append(key[1])
+            else:
+                out.setdefault(key, []).append(None)
+    return out
+
+
+# ------------------------------------------------------------- verdict
+
+def _eval_drivers() -> dict:
+    from dbcsr_tpu.resilience import breaker
+
+    status, reasons = OK, []
+    board = breaker._board  # do not CREATE a board just to inspect it
+    snap = board.snapshot() if board is not None else {}
+    open_keys = [k for k, v in snap.items() if v["state"] == "open"]
+    half = [k for k, v in snap.items() if v["state"] == "half_open"]
+    if half:
+        status = DEGRADED
+        reasons.append(f"breaker half-open (trial pending): "
+                       f"{', '.join(sorted(half))}")
+    if open_keys:
+        status = DEGRADED
+        reasons.append("breaker open: " + ", ".join(
+            f"{k} ({snap[k]['last_kind']})" for k in sorted(open_keys)))
+        crit_n = _env_int("DBCSR_TPU_HEALTH_BREAKER_CRITICAL_N", 4)
+        if any(k.startswith("xla|") for k in open_keys):
+            status = CRITICAL
+            reasons.append("the safe xla driver itself has an open "
+                           "breaker — the failover chain is losing its "
+                           "backstop")
+        elif len(open_keys) >= crit_n:
+            status = CRITICAL
+            reasons.append(f"{len(open_keys)} breakers open "
+                           f"(critical at {crit_n})")
+    return {"status": status, "reasons": reasons,
+            "open": len(open_keys), "half_open": len(half),
+            "tracked": len(snap)}
+
+
+def _eval_watchdog() -> dict:
+    from dbcsr_tpu.obs import metrics
+
+    status, reasons = OK, []
+    streaks = {}
+    g = metrics._gauges.get("dbcsr_tpu_watchdog_wedge_streak")
+    if g is not None:
+        for key, v in g.values.items():
+            name = dict(key).get("name", "?")
+            streaks[name] = v
+            if v >= 3:
+                status = CRITICAL
+                reasons.append(f"channel {name!r} wedged {int(v)}x "
+                               f"consecutively (backoff is hours)")
+            elif v >= 1:
+                if status == OK:
+                    status = DEGRADED
+                reasons.append(f"channel {name!r} wedge streak {int(v)}")
+    return {"status": status, "reasons": reasons, "wedge_streaks": streaks}
+
+
+def _eval_engine() -> dict:
+    status, reasons = OK, []
+    retries = _counter_by("dbcsr_tpu_checksum_retry_total")
+    for key, v in retries.items():
+        outcome = dict(key).get("outcome")
+        if outcome in ("deterministic", "unstable") and v:
+            status = CRITICAL
+            reasons.append(f"checksum retry classified {outcome} "
+                           f"({int(v)}x): proven numeric corruption")
+    degraded = _counter_total("dbcsr_tpu_multihost_degraded_total")
+    if degraded:
+        if status == OK:
+            status = DEGRADED
+        reasons.append(f"{int(degraded)} world join(s) degraded to "
+                       f"serial")
+    anomalies = active_anomalies()
+    for kind in ("recompile_storm", "fallback_storm",
+                 "dispatch_latency_spike"):
+        if kind in anomalies:
+            if status == OK:
+                status = DEGRADED
+            reasons.append(f"active anomaly: {kind}")
+    return {"status": status, "reasons": reasons,
+            "fallbacks": _counter_total("dbcsr_tpu_driver_fallback_total"),
+            "failures": _counter_total("dbcsr_tpu_driver_failures_total"),
+            "faults_injected": _counter_total(
+                "dbcsr_tpu_faults_injected_total")}
+
+
+def _eval_perf() -> dict:
+    status, reasons = OK, []
+    fractions: dict = {}
+    try:
+        from dbcsr_tpu.core import stats
+        from dbcsr_tpu.obs import costmodel
+
+        kind = costmodel.device_kind()
+        for driver, agg in stats.driver_rollup().items():
+            if agg["seconds"] <= 0:
+                continue
+            dtype = max(agg["by_dtype"], key=agg["by_dtype"].get) \
+                if agg["by_dtype"] else "float64"
+            fractions[driver] = round(costmodel.roofline(
+                agg["flops"], agg["bytes"], agg["seconds"], kind=kind,
+                dtype=dtype)["roofline_fraction"], 5)
+    except Exception:
+        pass
+    collapsed = active_anomalies().get("roofline_collapse")
+    if collapsed:
+        status = DEGRADED
+        reasons.append("active roofline collapse: "
+                       + ", ".join(str(d) for d in collapsed))
+    return {"status": status, "reasons": reasons,
+            "roofline_fraction": fractions}
+
+
+def verdict() -> dict:
+    """The full health verdict: worst component status + per-component
+    reasons + the active anomaly set (the ``/healthz`` payload)."""
+    components = {
+        "drivers": _eval_drivers(),
+        "watchdog": _eval_watchdog(),
+        "engine": _eval_engine(),
+        "perf": _eval_perf(),
+    }
+    worst = max((c["status"] for c in components.values()),
+                key=_RANK.get)
+    from dbcsr_tpu.obs import events as _events
+
+    return {
+        "status": worst,
+        "components": components,
+        "anomalies": active_anomalies(),
+        "anomaly_counts": {
+            dict(k).get("kind", "?"): v
+            for k, v in _counter_by("dbcsr_tpu_anomalies_total").items()},
+        "window": len(_samples),
+        "bus_enabled": _events.enabled(),
+        "t_unix": time.time(),
+    }
+
+
+# back-compat friendly alias: "evaluate" reads naturally at call sites
+evaluate = verdict

@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import threading
 
+from dbcsr_tpu.obs import events as _events
 from dbcsr_tpu.obs import tracer as _trace
 
 _lock = threading.Lock()
@@ -142,9 +143,11 @@ def record_jit(fn: str, key) -> bool:
     counter("dbcsr_tpu_jit_compiles_total",
             "distinct XLA specializations triggered per jitted hot "
             "function").inc(fn=fn)
-    # compiles also land in the trace stream, so tools/trace_summary.py
-    # can rank recompile offenders from the JSONL alone
-    _trace.instant("jit_compile", {"fn": fn, "key": str(key)})
+    # compiles also land on the event bus (product-correlated: "which
+    # multiply triggered this recompile") and in the trace stream, so
+    # tools/trace_summary.py can rank recompile offenders from the
+    # JSONL alone — one publish feeds both
+    _events.publish("jit_compile", {"fn": fn, "key": str(key)})
     return True
 
 

@@ -1,0 +1,239 @@
+"""Opt-in HTTP introspection endpoint for live long-running jobs.
+
+A tiered capture loop or a multihost perf run used to be a black box:
+the only way to inspect it was to kill it and read JSONL off disk.
+With ``DBCSR_TPU_OBS_PORT=<port>`` set (or `start()` called), every
+engine process serves its live observability state over plain stdlib
+``http.server`` — no dependencies, daemon thread, zero cost when off:
+
+====================  ==================================================
+route                 payload
+====================  ==================================================
+``/metrics``          Prometheus text exposition (`metrics.
+                      prometheus_text()`) — scrapeable
+``/healthz``          `health.verdict()` JSON; HTTP 200 for OK/
+                      DEGRADED, 503 for CRITICAL (load-balancer
+                      convention)
+``/flight``           the flight-recorder ring (`flight.records()`)
+``/events``           the event-bus ring; filters ``?product_id=…``,
+                      ``?kind=…``, ``?limit=N``
+``/``                 route index JSON
+====================  ==================================================
+
+**Multihost**: N processes sharing one env value must not fight over
+one port — each binds ``base_port + process_index``.  When the index
+is not yet knowable at activation (env activation runs before the
+backend exists), the server starts on the base port best-effort and
+`parallel.multihost.init_multihost` calls `rebind()` once the world
+forms, restarting the listener on its offset port; a bind conflict at
+activation simply defers the start to that rebind (same lazy-index
+contract as `tracer._process_index`).
+
+Loopback by default (``DBCSR_TPU_OBS_HOST``, default ``127.0.0.1``):
+this is an introspection port, not a public API.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from dbcsr_tpu.obs import tracer as _trace
+
+_lock = threading.Lock()
+_server: "ObsServer | None" = None
+# remembered when an early start() could not bind (index unknown and
+# the base port was taken by another rank): rebind() retries with the
+# resolved offset
+_pending_base: int | None = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dbcsr-tpu-obs/1"
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+    def _send(self, body: str, content_type: str, code: int = 200) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, obj, code: int = 200) -> None:
+        self._send(json.dumps(obj, default=str), "application/json", code)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        try:
+            url = urlparse(self.path)
+            route = url.path.rstrip("/") or "/"
+            if route == "/metrics":
+                from dbcsr_tpu.obs import metrics
+
+                self._send(metrics.prometheus_text(),
+                           "text/plain; version=0.0.4")
+            elif route == "/healthz":
+                from dbcsr_tpu.obs import health
+
+                v = health.verdict()
+                self._send_json(
+                    v, code=503 if v["status"] == health.CRITICAL else 200)
+            elif route == "/flight":
+                from dbcsr_tpu.obs import flight
+
+                self._send_json(flight.records())
+            elif route == "/events":
+                from dbcsr_tpu.obs import events
+
+                q = parse_qs(url.query)
+                limit = None
+                if "limit" in q:
+                    try:
+                        limit = int(q["limit"][0])
+                    except ValueError:
+                        pass
+                self._send_json(events.records(
+                    product_id=q.get("product_id", [None])[0],
+                    kind=q.get("kind", [None])[0], limit=limit))
+            elif route == "/":
+                self._send_json({
+                    "routes": ["/metrics", "/healthz", "/flight",
+                               "/events?product_id=&kind=&limit="],
+                    "process_index": _server.process_index
+                    if _server else None,
+                })
+            else:
+                self._send_json({"error": f"no route {route}"}, code=404)
+        except Exception as exc:  # introspection must never kill the job
+            try:
+                self._send_json(
+                    {"error": f"{type(exc).__name__}: {exc}"}, code=500)
+            except Exception:
+                pass
+
+
+class ObsServer:
+    """One listening introspection endpoint (daemon thread)."""
+
+    def __init__(self, host: str, port: int, process_index: int):
+        self.process_index = process_index
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.daemon_threads = True
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, name="dbcsr-tpu-obs-server",
+            daemon=True)
+        self.thread.start()
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def host(self) -> str:
+        return self.httpd.server_address[0]
+
+    def close(self) -> None:
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:
+            pass
+
+
+def _host() -> str:
+    return os.environ.get("DBCSR_TPU_OBS_HOST", "127.0.0.1")
+
+
+def start(port: int | None = None) -> "ObsServer | None":
+    """Start (or restart) the endpoint on ``base port +
+    process_index``.  ``port=0`` binds an ephemeral port (tests).
+    Returns the server, or None when the bind failed with the process
+    index still unknown — `rebind` retries once `init_multihost`
+    resolves it."""
+    global _server, _pending_base
+    if port is None:
+        raw = os.environ.get("DBCSR_TPU_OBS_PORT")
+        if not raw:
+            raise ValueError(
+                "no port: pass one or set DBCSR_TPU_OBS_PORT")
+        port = int(raw)
+    with _lock:
+        if _server is not None:
+            _server.close()
+            _server = None
+        idx = _trace._process_index() or 0
+        bind_port = port + idx if port else 0
+        try:
+            _server = ObsServer(_host(), bind_port, idx)
+            _pending_base = port if port else None
+        except OSError:
+            # base port taken (very likely a sibling rank on this host,
+            # our own index not yet knowable): defer to rebind()
+            _pending_base = port if port else None
+            return None
+        return _server
+
+
+def stop() -> None:
+    global _server, _pending_base
+    with _lock:
+        if _server is not None:
+            _server.close()
+            _server = None
+        _pending_base = None
+
+
+def running() -> bool:
+    return _server is not None
+
+
+def get() -> "ObsServer | None":
+    return _server
+
+
+def url() -> str | None:
+    """The endpoint base URL, or None when not running."""
+    s = _server
+    return f"http://{s.host}:{s.port}" if s is not None else None
+
+
+def rebind(process_index: int | None = None) -> None:
+    """Settle the endpoint onto its ``base + process_index`` port once
+    the world's index is known (called by `init_multihost`, mirroring
+    `tracer.rebind`).  No-op when the endpoint was never requested or
+    is already on its final port."""
+    global _server
+    base = _pending_base
+    if base is None:
+        return
+    if process_index is None:
+        process_index = _trace._process_index()
+    if process_index is None:
+        return
+    idx = int(process_index)
+    with _lock:
+        if _server is not None and _server.process_index == idx \
+                and _server.port == base + idx:
+            return
+        if _server is not None:
+            _server.close()
+            _server = None
+        try:
+            _server = ObsServer(_host(), base + idx, idx)
+        except OSError:
+            _server = None
+
+
+# env activation: DBCSR_TPU_OBS_PORT set at import serves the endpoint
+# with no code changes anywhere (mirrors DBCSR_TPU_TRACE); a bind
+# conflict defers to init_multihost's rebind
+if os.environ.get("DBCSR_TPU_OBS_PORT"):
+    try:
+        start()
+    except (ValueError, OSError):
+        pass

@@ -34,6 +34,22 @@ from dbcsr_tpu.obs import tracer as _trace
 from dbcsr_tpu.resilience import faults as _faults
 
 
+def _obs_rebind() -> None:
+    """World-join obs bookkeeping that does NOT need the trace barrier:
+    settle the event-bus sink shard onto its final ``p{index}`` name
+    and move the introspection endpoint to its ``base + index`` port —
+    both no-ops when the respective layer is off."""
+    try:
+        from dbcsr_tpu.obs import events as _events
+        from dbcsr_tpu.obs import server as _server
+
+        idx = int(jax.process_index())
+        _events.rebind(idx)
+        _server.rebind(idx)
+    except Exception:
+        pass  # obs bookkeeping must never fail a world join
+
+
 def _trace_clock_align() -> None:
     """World-join trace bookkeeping: settle this process's trace shard
     onto its final ``p{process_index}`` name, then emit a
@@ -87,6 +103,7 @@ def _note_degraded_to_serial(exc: BaseException, coordinator, timeout_s) -> None
     was round 5's nightmare diagnosis."""
     import warnings
 
+    from dbcsr_tpu.obs import events as _events
     from dbcsr_tpu.obs import flight as _flight
     from dbcsr_tpu.obs import metrics as _metrics
 
@@ -99,7 +116,7 @@ def _note_degraded_to_serial(exc: BaseException, coordinator, timeout_s) -> None
     _flight.begin(op="multihost_init", name="init_multihost",
                   coordinator=str(coordinator), timeout_s=timeout_s)
     _flight.commit(error=f"degraded to serial: {type(exc).__name__}: {exc}")
-    _trace.instant("multihost_degraded_to_serial", {
+    _events.publish("multihost_degraded_to_serial", {
         "coordinator": str(coordinator), "timeout_s": timeout_s,
         "error": f"{type(exc).__name__}: {exc}"[:300],
     })
@@ -164,6 +181,7 @@ def init_multihost(
                 raise
             _note_degraded_to_serial(exc, coordinator_address, timeout_s)
             return False
+        _obs_rebind()
         _trace_clock_align()
         return True
     try:
@@ -174,6 +192,7 @@ def init_multihost(
         # else: no cluster environment to auto-detect — the quiet
         # serial-stub path stays quiet
         return False
+    _obs_rebind()
     _trace_clock_align()
     return True
 

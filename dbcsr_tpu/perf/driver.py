@@ -397,7 +397,17 @@ def _checksum_retry_safe(cfg: PerfConfig, run_once, cs_first: float,
     ``dbcsr_tpu_checksum_retry_total{outcome}`` counter, the returned
     result dict (``checksum_retry``), and the raised message."""
     from dbcsr_tpu.core.config import get_config, set_config
+    from dbcsr_tpu.obs import events as _events
     from dbcsr_tpu.obs import metrics as _metrics
+
+    def _publish_retry(outcome: str) -> None:
+        # the bus record correlates the retry verdict with the flight
+        # records already dumped (same process, adjacent products)
+        _events.publish("checksum_retry", {
+            "outcome": outcome, "safe_driver": SAFE_DRIVER,
+            "original_mm_driver": prev_driver,
+            "error": str(first_err)[:300],
+        })
 
     live = get_config()
     prev_driver, prev_dense = live.mm_driver, live.mm_dense
@@ -410,6 +420,7 @@ def _checksum_retry_safe(cfg: PerfConfig, run_once, cs_first: float,
             "dbcsr_tpu_checksum_retry_total",
             "checksum-gate safe-driver retries by outcome",
         ).inc(outcome="retry_error")
+        _publish_retry("retry_error")
         raise PerfChecksumError(
             f"{first_err}; safe-driver retry also failed "
             f"({type(exc).__name__}: {exc})") from first_err
@@ -426,12 +437,14 @@ def _checksum_retry_safe(cfg: PerfConfig, run_once, cs_first: float,
     except PerfChecksumError:
         outcome = ("deterministic" if cs == cs_first else "unstable")
         counter.inc(outcome=outcome)
+        _publish_retry(outcome)
         raise PerfChecksumError(
             f"{first_err}; safe-driver ({SAFE_DRIVER}) retry "
             f"{'reproduced the same wrong checksum' if cs == cs_first else f'produced yet another checksum {cs:.15e}'}"
             f" — classified {outcome.upper()}") from first_err
     outcome = "transient" if retried_same_path else "driver"
     counter.inc(outcome=outcome)
+    _publish_retry(outcome)
     if verbose:
         print(f" checksum gate: safe-driver retry PASSED — original "
               f"failure classified {outcome.upper()} "

@@ -107,9 +107,8 @@ class BreakerBoard:
 
     def _emit(self, driver: str, key, br: Breaker, transition: str) -> None:
         try:
-            from dbcsr_tpu.obs import flight as _flight
+            from dbcsr_tpu.obs import events as _events
             from dbcsr_tpu.obs import metrics as _metrics
-            from dbcsr_tpu.obs import tracer as _trace
 
             shape = "x".join(str(x) for x in key) if key else "-"
             _metrics.gauge(
@@ -117,13 +116,17 @@ class BreakerBoard:
                 "circuit-breaker state per (driver, shape): 0=closed, "
                 "1=half_open, 2=open",
             ).set(_STATE_CODE[br.state], driver=driver, shape=shape)
-            _trace.instant("breaker_transition", {
-                "driver": driver, "shape": shape, "to": br.state,
-                "transition": transition, "failures": br.failures,
-                "kind": br.last_kind,
-            })
-            _flight.note_event("breaker", driver=driver, shape=shape,
-                               to=br.state, why=transition)
+            # single choke point: the bus record, the trace instant and
+            # the flight event all come from one publish (correlated to
+            # the open multiply's product_id when there is one)
+            _events.publish(
+                "breaker_transition",
+                {"driver": driver, "shape": shape, "to": br.state,
+                 "transition": transition, "failures": br.failures,
+                 "kind": br.last_kind},
+                flight=("breaker", {"driver": driver, "shape": shape,
+                                    "to": br.state, "why": transition}),
+            )
         except Exception:
             pass
 

@@ -232,21 +232,23 @@ def _note(site: str, spec: FaultSpec, labels: dict) -> None:
         # trace session must only open in engine processes
         return
     try:
+        from dbcsr_tpu.obs import events as _events
         from dbcsr_tpu.obs import metrics as _metrics
-        from dbcsr_tpu.obs import tracer as _trace
 
         _metrics.counter(
             "dbcsr_tpu_faults_injected_total",
             "faults injected by dbcsr_tpu.resilience.faults per site/kind",
         ).inc(site=site, kind=spec.kind)
-        _trace.instant("fault_injected", {
-            "site": site, "kind": spec.kind, "target": spec.target,
-            "fired": spec.fired, **{k: str(v) for k, v in labels.items()},
-        })
-        from dbcsr_tpu.obs import flight as _flight
-
-        _flight.note_event("fault_injected", site=site, kind=spec.kind,
-                           target=spec.target)
+        # one publish = bus record (product-correlated) + trace instant
+        # + flight event, replacing the three hand-rolled emissions
+        _events.publish(
+            "fault_injected",
+            {"site": site, "kind": spec.kind, "target": spec.target,
+             "fired": spec.fired,
+             **{k: str(v) for k, v in labels.items()}},
+            flight=("fault_injected", {"site": site, "kind": spec.kind,
+                                       "target": spec.target}),
+        )
     except Exception:
         pass  # observability must never turn an injected fault into a real one
 

@@ -172,8 +172,8 @@ class Watchdog:
             # open shards meant for its bench subprocesses
             return
         try:
+            from dbcsr_tpu.obs import events as _events
             from dbcsr_tpu.obs import metrics as _metrics
-            from dbcsr_tpu.obs import tracer as _trace
 
             _metrics.counter(
                 "dbcsr_tpu_watchdog_outcomes_total",
@@ -183,10 +183,12 @@ class Watchdog:
                 "dbcsr_tpu_watchdog_wedge_streak",
                 "consecutive WEDGED outcomes per watchdog channel",
             ).set(self.wedge_streak, name=self.name)
-            _trace.instant("watchdog_outcome", {
+            _events.publish("watchdog_outcome", {
                 "name": self.name, "outcome": result.outcome,
                 "elapsed_s": round(result.elapsed_s, 3),
-                "streak": self.streak, "error": result.error,
+                "streak": self.streak,
+                "wedge_streak": self.wedge_streak,
+                "error": result.error,
             })
         except Exception:
             pass

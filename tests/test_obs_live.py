@@ -1,0 +1,448 @@
+"""Live ops plane tests (`dbcsr_tpu.obs.{events,health,server}` +
+`tools/doctor.py`): event-bus correlation under injected faults, the
+HTTP introspection endpoint on an ephemeral port, health state
+transitions, all four anomaly detectors, the sharded JSONL sink (incl.
+a real 2-process world mirroring `test_trace_multihost.py`), finalize
+parity, and the doctor CLI (live + `--selftest`).
+
+All runnable under JAX_PLATFORMS=cpu (conftest forces it)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import dbcsr_tpu as dt
+from dbcsr_tpu.core import stats
+from dbcsr_tpu.obs import events, flight, health, metrics, server
+from dbcsr_tpu.resilience import breaker, faults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+import doctor  # noqa: E402
+
+
+def setup_function(_):
+    metrics.reset()
+    health.reset()
+    events.clear()
+    events.set_enabled(True)
+    flight.clear()
+    breaker.reset_board()
+
+
+def _small_multiply(seed=0, occ=0.5):
+    rng = np.random.default_rng(seed)
+    rbs = [4] * 6
+    a = dt.make_random_matrix("A", rbs, rbs, occupation=occ, rng=rng)
+    b = dt.make_random_matrix("B", rbs, rbs, occupation=occ, rng=rng)
+    c = dt.create("C", rbs, rbs)
+    dt.multiply("N", "N", 1.0, a, b, 0.0, c)
+    return c
+
+
+# ------------------------------------------------------ bus correlation
+
+def test_one_faulted_multiply_correlates_across_stores():
+    """One multiply under an injected fault: the fault, the failure,
+    the failover and the multiply_end must all carry ONE product_id,
+    which also names the flight record."""
+    with faults.inject_faults("execute_stack:raise,times=1"):
+        _small_multiply()
+    ends = events.records(kind="multiply_end")
+    assert len(ends) == 1
+    pid = ends[0]["product_id"]
+    assert pid
+    correlated = {e["event"] for e in events.records(product_id=pid)}
+    assert {"multiply_begin", "fault_injected", "driver_failure",
+            "driver_failover", "multiply_end"} <= correlated
+    # the payload "kind" (fault kind) must not shadow the event name
+    fev = events.records(kind="fault_injected")[0]
+    assert fev["event"] == "fault_injected" and fev["kind"] == "raise"
+    # the flight record joins on the same key
+    rec = flight.records()[-1]
+    assert rec["product_id"] == pid
+    flight_kinds = {e["event"] for e in rec.get("events", [])}
+    assert {"fault_injected", "driver_failure", "failover"} <= flight_kinds
+    # multiply_end summarizes the record
+    assert ends[0]["dur_ms"] > 0 and ends[0]["drivers"]
+
+
+def test_distinct_multiplies_get_distinct_products():
+    _small_multiply(seed=1)
+    _small_multiply(seed=2)
+    pids = [e["product_id"] for e in events.records(kind="multiply_end")]
+    assert len(pids) == 2 and pids[0] != pids[1]
+
+
+def test_failed_multiply_still_ends_its_product():
+    # an UNCONDITIONAL raise at every driver launch exhausts the whole
+    # failover chain: the multiply dies, but its product must close
+    # with the error on the bus and no leaked correlation id
+    with pytest.raises(Exception):
+        with faults.inject_faults("execute_stack:raise"):
+            _small_multiply()
+    ends = events.records(kind="multiply_end")
+    assert len(ends) == 1 and "error" in ends[0]
+    assert events.current_product() is None  # stack not leaked
+
+
+def test_bus_off_forwards_but_records_nothing():
+    events.set_enabled(False)
+    try:
+        with faults.inject_faults("execute_stack:raise,times=1"):
+            _small_multiply()
+        assert events.records() == []
+        # the pre-bus emissions still happened: flight carries the events
+        kinds = {e["event"] for r in flight.records()
+                 for e in r.get("events", [])}
+        assert "fault_injected" in kinds and "failover" in kinds
+    finally:
+        events.set_enabled(True)
+
+
+def test_sink_writes_sharded_jsonl(tmp_path):
+    base = str(tmp_path / "events.jsonl")
+    path = events.enable_sink(base)
+    try:
+        _small_multiply()
+    finally:
+        events.disable_sink()
+    # single process: shard settles on p0
+    assert os.path.basename(events.sink_path() or path).startswith("events.p") \
+        or path.endswith(".jsonl")
+    final = tmp_path / "events.p0.jsonl"
+    assert final.exists(), sorted(p.name for p in tmp_path.iterdir())
+    recs = [json.loads(ln) for ln in final.read_text().splitlines()]
+    assert any(r["event"] == "multiply_end" for r in recs)
+    assert all("product_id" in r for r in recs)
+
+
+# ------------------------------------------------------------- endpoint
+
+@pytest.fixture
+def endpoint():
+    s = server.start(port=0)
+    assert s is not None
+    yield server.url()
+    server.stop()
+
+
+def _get(url, route):
+    try:
+        with urllib.request.urlopen(url + route, timeout=30) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_endpoint_serves_metrics_and_healthz(endpoint):
+    _small_multiply()
+    code, text = _get(endpoint, "/metrics")
+    assert code == 200
+    assert "dbcsr_tpu_multiplies_total" in text
+    assert "# TYPE dbcsr_tpu_flops_total counter" in text
+    # well-formed: the doctor's parser reads every sample line
+    parsed = doctor.parse_prometheus(text)
+    assert parsed["dbcsr_tpu_multiplies_total"][0][1] >= 1
+    code, body = _get(endpoint, "/healthz")
+    assert code == 200
+    v = json.loads(body)
+    assert v["status"] in ("OK", "DEGRADED")
+    assert set(v["components"]) == {"drivers", "watchdog", "engine", "perf"}
+
+
+def test_endpoint_serves_flight_and_filtered_events(endpoint):
+    _small_multiply()
+    code, body = _get(endpoint, "/flight")
+    assert code == 200
+    fl = json.loads(body)
+    pid = fl[-1]["product_id"]
+    code, body = _get(endpoint, f"/events?product_id={pid}")
+    assert code == 200
+    evs = json.loads(body)
+    assert evs and all(e["product_id"] == pid for e in evs)
+    assert {"multiply_begin", "multiply_end"} <= {e["event"] for e in evs}
+    code, body = _get(endpoint, "/events?kind=multiply_end&limit=1")
+    assert len(json.loads(body)) == 1
+    assert _get(endpoint, "/nope")[0] == 404
+
+
+def test_endpoint_healthz_503_on_critical(endpoint):
+    board = breaker.get_board()
+    board.record_failure("xla", (4, 4, 4, "float64"), kind="validation")
+    code, body = _get(endpoint, "/healthz")
+    assert code == 503
+    v = json.loads(body)
+    assert v["status"] == "CRITICAL"
+    assert any("xla" in r for r in v["components"]["drivers"]["reasons"])
+
+
+# --------------------------------------------------------------- health
+
+def test_health_forced_open_breaker_degrades_with_reason():
+    board = breaker.get_board()
+    for _ in range(3):
+        board.record_failure("pallas", (23, 23, 23, "float64"),
+                             kind="runtime")
+    v = health.verdict()
+    assert v["status"] == "DEGRADED"
+    drv = v["components"]["drivers"]
+    assert drv["status"] == "DEGRADED" and drv["open"] == 1
+    assert any("pallas|23x23x23xfloat64" in r for r in drv["reasons"])
+    # breaker transition itself rode the bus
+    assert events.records(kind="breaker_transition")
+
+
+def test_health_wedge_streak_escalates():
+    metrics.gauge("dbcsr_tpu_watchdog_wedge_streak").set(1, name="tpu_probe")
+    assert health.verdict()["components"]["watchdog"]["status"] == "DEGRADED"
+    metrics.gauge("dbcsr_tpu_watchdog_wedge_streak").set(3, name="tpu_probe")
+    v = health.verdict()
+    assert v["status"] == "CRITICAL"
+    assert v["components"]["watchdog"]["status"] == "CRITICAL"
+
+
+def test_health_checksum_corruption_is_critical():
+    metrics.counter("dbcsr_tpu_checksum_retry_total").inc(
+        outcome="deterministic")
+    v = health.verdict()
+    assert v["components"]["engine"]["status"] == "CRITICAL"
+
+
+# ---------------------------------------------------- anomaly detectors
+
+def _anomaly_count(kind):
+    c = metrics._counters.get("dbcsr_tpu_anomalies_total")
+    return c.value(kind=kind) if c is not None else 0
+
+
+def test_anomaly_recompile_storm_fires_once():
+    for i in range(12):
+        metrics.record_jit("fn", ("shape", i))  # fresh key every multiply
+        health.observe_multiply(dur_ms=1.0)
+    assert _anomaly_count("recompile_storm") == 1  # rising edge only
+    ev = events.records(kind="anomaly")
+    assert ev and ev[-1]["kind"] == "recompile_storm"
+    assert "recompile_storm" in health.active_anomalies()
+    assert health.verdict()["components"]["engine"]["status"] == "DEGRADED"
+
+
+def test_anomaly_fallback_storm():
+    for _ in range(10):
+        metrics.counter("dbcsr_tpu_driver_fallback_total").inc(
+            **{"from": "pallas", "to": "xla"})
+        health.observe_multiply(dur_ms=1.0)
+    assert _anomaly_count("fallback_storm") == 1
+    assert "fallback_storm" in health.active_anomalies()
+
+
+def test_anomaly_dispatch_latency_spike_and_rearm():
+    for _ in range(10):
+        health.observe_multiply(dur_ms=1.0)
+    health.observe_multiply(dur_ms=50.0)
+    assert _anomaly_count("dispatch_latency_spike") == 1
+    # back under the threshold: the detector re-arms, then re-fires
+    health.observe_multiply(dur_ms=1.0)
+    assert "dispatch_latency_spike" not in health.active_anomalies()
+    health.observe_multiply(dur_ms=80.0)
+    assert _anomaly_count("dispatch_latency_spike") == 2
+
+
+def test_anomaly_roofline_collapse_per_driver():
+    for _ in range(10):  # healthy rate: N flops in 1 ms each
+        stats.record_stack(8, 8, 8, 1000, driver="xla", seconds=0.001,
+                           nbytes=10**6)
+        health.observe_multiply(dur_ms=1.0)
+    stats.record_stack(8, 8, 8, 1000, driver="xla", seconds=1.0,
+                       nbytes=10**6)  # same work, 1000x slower
+    health.observe_multiply(dur_ms=1.0)
+    assert _anomaly_count("roofline_collapse") == 1
+    assert health.active_anomalies()["roofline_collapse"] == ["xla"]
+    v = health.verdict()
+    assert v["components"]["perf"]["status"] == "DEGRADED"
+    assert "xla" in v["components"]["perf"]["roofline_fraction"]
+
+
+def test_anomaly_events_from_real_multiplies_correlate():
+    """Detector output is correlated too: a storm fired from inside a
+    multiply's end_product carries that multiply's product_id."""
+    # host-targeted so the chain always has somewhere to fall over to:
+    # one failover per multiply = a guaranteed storm after the window
+    with faults.inject_faults("host:raise"):
+        for i in range(10):
+            _small_multiply(seed=i)
+    ev = events.records(kind="anomaly")
+    assert any(e["kind"] == "fallback_storm" for e in ev)
+    storm = [e for e in ev if e["kind"] == "fallback_storm"][0]
+    assert storm["product_id"]  # fired while a product was open
+
+
+# ------------------------------------------------------------- finalize
+
+def test_finalize_emits_snapshot_and_health_json():
+    _small_multiply()
+    lines = []
+    dt.finalize_lib(print_stats=True, out=lines.append)
+    js = [ln for ln in lines if ln.startswith("{")]
+    assert len(js) == 1
+    doc = json.loads(js[0])
+    assert doc["health"]["status"] in ("OK", "DEGRADED", "CRITICAL")
+    assert "flops_by_driver" in doc["snapshot"]
+    assert doc["obs_schema"] >= 3
+    # legacy tables still lead the report
+    assert any("DBCSR-TPU STATISTICS" in ln for ln in lines)
+
+
+# --------------------------------------------------------------- doctor
+
+def test_doctor_selftest_cli_smoke():
+    """The tier-1 CI wiring for `tools/doctor.py --selftest`."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "doctor.py"),
+         "--selftest"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "selftest: OK" in out.stdout
+
+
+def test_doctor_live_mode_against_endpoint():
+    s = server.start(port=0)
+    try:
+        with faults.inject_faults("execute_stack:raise,times=1"):
+            _small_multiply()
+        rc = doctor.main(["--url", server.url()])
+        report_rc = doctor.main(["--url", server.url(), "--json"])
+        assert rc == 0 and report_rc == 0
+        live = doctor.fetch_live(server.url())
+        report = doctor.analyze(
+            live["health"], doctor.parse_prometheus(live["metrics_text"]),
+            live["events"], live["flight"], [], [])
+        assert report["health"]["status"] in ("OK", "DEGRADED")
+        offenders = dict(report["offenders"]["fallbacks"])
+        pid = live["flight"][-1]["product_id"]
+        assert offenders.get(pid) == 1
+    finally:
+        server.stop()
+
+
+def test_doctor_artifact_mode_from_sink(tmp_path):
+    base = str(tmp_path / "events.jsonl")
+    events.enable_sink(base)
+    try:
+        with faults.inject_faults("execute_stack:raise,times=1"):
+            _small_multiply()
+    finally:
+        events.disable_sink()
+    rc = doctor.main(["--events", base,
+                      "--probe", str(tmp_path / "none.jsonl"),
+                      "--captures", str(tmp_path / "none2.jsonl"),
+                      "--json"])
+    assert rc == 0
+
+
+def test_doctor_runbook_anchors_exist():
+    """Every hint's runbook anchor must resolve to a real heading in
+    docs/resilience.md (GitHub anchor convention)."""
+    import re
+
+    md = open(os.path.join(_REPO, "docs", "resilience.md")).read()
+    anchors = set()
+    for line in md.splitlines():
+        m = re.match(r"^(#+)\s+(.*)$", line)
+        if m:
+            a = m.group(2).lower().strip()
+            a = re.sub(r"[^\w\s-]", "", a)
+            # GitHub maps EACH space to a hyphen (no collapsing):
+            # "failover + breakers" -> "failover--breakers"
+            anchors.add("#" + a.replace(" ", "-"))
+    for kind, (_, anchor) in doctor.HINTS.items():
+        assert anchor in anchors, (kind, anchor, sorted(anchors))
+
+
+# -------------------------------------------- multihost sink sharding
+
+_WORKER = r'''
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+port, pid = sys.argv[1], int(sys.argv[2])
+# env activation (DBCSR_TPU_EVENTS is in the environment) opened a
+# provisional sink shard at import; init_multihost must rebind it
+from dbcsr_tpu import obs
+from dbcsr_tpu.obs import events
+from dbcsr_tpu.parallel import multihost
+assert events.sink_active(), "DBCSR_TPU_EVENTS did not activate the sink"
+ok = multihost.init_multihost(f"localhost:{{port}}", 2, pid)
+assert ok and multihost.process_count() == 2
+assert events.sink_path().endswith(f".p{{pid}}.jsonl"), events.sink_path()
+events.publish("rank_note", {{"rank": pid}})
+events.disable_sink()
+print(f"WORKER{{pid}} OK shard={{events.sink_path()}}")
+multihost.shutdown_multihost()
+'''
+
+
+def _run_world(worker, events_base, attempt_timeout):
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, DBCSR_TPU_EVENTS=events_base)
+    env.pop("JAX_PLATFORMS", None)  # worker sets the platform itself
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(port), str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=attempt_timeout)[0])
+    except subprocess.TimeoutExpired:
+        outs = None  # port race / hung join: caller may retry
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            try:
+                p.communicate(timeout=10)
+            except Exception:
+                pass
+    return procs, outs
+
+
+def test_two_process_event_sink_shards(tmp_path):
+    """Mirror of test_trace_multihost: a REAL 2-process world with
+    DBCSR_TPU_EVENTS pointing both ranks at ONE base path — each must
+    write its own events.p{index}.jsonl shard with its own records."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER.format(repo=_REPO))
+    base = str(tmp_path / "events.jsonl")
+    procs, outs = _run_world(worker, base, attempt_timeout=120)
+    if outs is None:
+        procs, outs = _run_world(worker, base, attempt_timeout=240)
+    assert outs is not None, "world never formed (twice)"
+    for i, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{o[-3000:]}"
+    shard0 = tmp_path / "events.p0.jsonl"
+    shard1 = tmp_path / "events.p1.jsonl"
+    assert shard0.exists() and shard1.exists(), sorted(
+        p.name for p in tmp_path.iterdir())
+    # no provisional leftovers: every shard settled on its final name
+    assert not [p.name for p in tmp_path.iterdir() if ".ptmp" in p.name]
+    for pid, shard in enumerate((shard0, shard1)):
+        recs = [json.loads(ln) for ln in shard.read_text().splitlines()]
+        notes = [r for r in recs if r.get("event") == "rank_note"]
+        assert notes and notes[0]["rank"] == pid

@@ -100,6 +100,33 @@ def test_predict_exact_shape_beats_permuted_donor(table):
     assert got2["r0"] == 8 and "predicted_from" not in got2
 
 
+def test_predict_exact_shape_tiebreak_survives_onchip_pool(table):
+    """ADVICE r5 regression pin, onchip leg: the permutation-pair
+    tie-break must hold INSIDE the provenance-quarantined pool too.
+    Both rows onchip, the (5,13,23) donor tuned at the exact queried
+    stack size (so the stack-size term favors the donor): the exact
+    (23,13,5) row must still win — the exactness term outranks ds in
+    the (d, exact, ds) key — and must come back as exact evidence
+    (no "predicted_from"), with ITS params, not the donor's."""
+    donor = {"m": 5, "n": 13, "k": 23, "dtype": "float64",
+             "stack_size": 30000, "driver": "xla_group", "grouping": None,
+             "r0": 8, "env": "onchip", "gflops": 6.1}
+    exact = {"m": 23, "n": 13, "k": 5, "dtype": "float64",
+             "stack_size": 100000, "driver": "xla_group", "grouping": None,
+             "r0": 16, "env": "onchip", "gflops": 6.7}
+    _write(table, [donor, exact])
+    got = params_mod.predict(23, 13, 5, np.float64, stack_size=30000)
+    assert (got["m"], got["n"], got["k"]) == (23, 13, 5)
+    assert got["r0"] == 16 and "predicted_from" not in got
+    # and with no stack size given (larger-S preference would also
+    # favor... the exact row here; flip: donor gets the bigger S)
+    donor2 = dict(donor, stack_size=200000)
+    _write(table, [donor2, exact])
+    got = params_mod.predict(23, 13, 5, np.float64)
+    assert (got["m"], got["n"], got["k"]) == (23, 13, 5)
+    assert got["r0"] == 16 and "predicted_from" not in got
+
+
 def test_predict_untagged_exact_row_muted_by_onchip_donor(table):
     """ADVICE r5 (low): ONE policy for legacy untagged rows — the early
     return must not trust them when _prefer_onchip would quarantine

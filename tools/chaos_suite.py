@@ -115,9 +115,17 @@ def _one_product(entry: dict, seed: int):
     return checksum(c)
 
 
-def run_chaos(seed: int, rounds: int, verbose: bool = False) -> dict:
+def run_chaos(seed: int, rounds: int, verbose: bool = False,
+              check_events: bool = False) -> dict:
     """Run ``rounds`` randomized schedules over the corpus; returns a
-    result dict (also JSONL-printable)."""
+    result dict (also JSONL-printable).
+
+    ``check_events`` additionally asserts the ops-plane correlation
+    contract per faulted product: every fault the schedule actually
+    fired must appear on the event bus (`dbcsr_tpu.obs.events`) as a
+    ``fault_injected`` record carrying the multiply's ``product_id`` —
+    a fault that fires invisibly, or outside its product's correlation
+    scope, is a failure even when the checksum survives."""
     import jax
 
     jax.config.update("jax_enable_x64", True)
@@ -125,6 +133,13 @@ def run_chaos(seed: int, rounds: int, verbose: bool = False) -> dict:
     from dbcsr_tpu.resilience import breaker, faults
 
     import numpy as np
+
+    if check_events:
+        from dbcsr_tpu.obs import events as obs_events
+
+        # the assertion is meaningless with the bus off (an inherited
+        # DBCSR_TPU_EVENTS=0 would fail every case vacuously)
+        obs_events.set_enabled(True)
 
     rng = random.Random(seed)
     cases = corpus()
@@ -139,13 +154,16 @@ def run_chaos(seed: int, rounds: int, verbose: bool = False) -> dict:
 
     failures = []
     schedules = []
+    events_checked = 0
     for rnd in range(rounds):
         schedule = random_schedule(rng)
         schedules.append(schedule)
         for name, entry in cases:
             breaker.reset_board()
+            if check_events:
+                obs_events.clear()
             try:
-                with faults.inject_faults(schedule):
+                with faults.inject_faults(schedule) as installed:
                     cs = _one_product(entry, seed=1234)
             except Exception as exc:  # unrecovered failure
                 failures.append({
@@ -153,6 +171,21 @@ def run_chaos(seed: int, rounds: int, verbose: bool = False) -> dict:
                     "error": f"{type(exc).__name__}: {exc}",
                 })
                 continue
+            if check_events:
+                fired = sum(spec.fired for spec in installed)
+                on_bus = obs_events.records(kind="fault_injected")
+                uncorrelated = [e for e in on_bus
+                                if not e.get("product_id")]
+                events_checked += fired
+                if len(on_bus) != fired or uncorrelated:
+                    failures.append({
+                        "round": rnd, "case": name, "schedule": schedule,
+                        "events_error": (
+                            f"{fired} faults fired, {len(on_bus)} on the "
+                            f"bus, {len(uncorrelated)} without a "
+                            f"product_id"),
+                    })
+                    continue
             ref = refs[name]
             rel = abs(cs - ref) / max(abs(ref), 1e-300)
             if rel > _tol(entry):
@@ -169,6 +202,7 @@ def run_chaos(seed: int, rounds: int, verbose: bool = False) -> dict:
         "runs": rounds * len(cases),
         "failures": failures,
         "schedules": schedules,
+        "events_checked": events_checked if check_events else None,
     }
 
 
@@ -178,19 +212,25 @@ def main(argv=None) -> int:
                     help="schedule seed (default: clock; always logged)")
     ap.add_argument("--rounds", type=int, default=8,
                     help="randomized schedules per case (default 8)")
+    ap.add_argument("--events", action="store_true",
+                    help="also assert every injected fault is visible "
+                         "on the event bus with a correlated product_id")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     seed = args.seed if args.seed is not None else int(time.time()) % 2**31
     print(f"chaos suite: seed={seed} rounds={args.rounds} "
           f"(replay: python tools/chaos_suite.py --seed {seed})")
-    res = run_chaos(seed, args.rounds, verbose=args.verbose)
+    res = run_chaos(seed, args.rounds, verbose=args.verbose,
+                    check_events=args.events)
     print(json.dumps({k: v for k, v in res.items() if k != "schedules"}))
     if res["failures"]:
         for f in res["failures"]:
             print(f"FAIL {f}", file=sys.stderr)
         return 1
+    extra = (f", {res['events_checked']} faults correlated on the bus"
+             if args.events else "")
     print(f"chaos suite PASSED: {res['runs']} faulted multiplies, "
-          f"all checksums correct")
+          f"all checksums correct{extra}")
     return 0
 
 

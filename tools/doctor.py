@@ -1,0 +1,462 @@
+"""dbcsr_tpu doctor: one diagnosis of a live job or its artifacts.
+
+The CLI reader of the live ops plane (`dbcsr_tpu.obs`): points at a
+running process's introspection endpoint (``DBCSR_TPU_OBS_PORT``) or
+at the artifacts a finished/killed run left on disk, and prints what
+an on-call engineer needs first — per-component health, breaker and
+watchdog state, the multiplies that caused the recompile/fallback
+churn, per-driver roofline fractions, and runbook pointers
+(docs/resilience.md) for every active anomaly.
+
+Live mode (reads ``/healthz``, ``/metrics``, ``/events``, ``/flight``):
+
+    python tools/doctor.py --url http://127.0.0.1:9100
+    python tools/doctor.py --port 9100          # localhost shorthand
+
+Artifact mode (any subset; shard bases expand like DBCSR_TPU_TRACE):
+
+    python tools/doctor.py --events events.jsonl --trace trace.jsonl \\
+        --probe capture_probe.jsonl --captures BENCH_CAPTURES.jsonl
+
+With no arguments the doctor looks for the default artifact names in
+the current directory.  ``--json`` emits the report machine-readable;
+``--selftest`` runs the full pipeline offline against synthetic events
+plus the committed bench artifacts and exits 0 — the tier-1 CI smoke.
+
+No dbcsr_tpu import in artifact mode (works on files copied off
+another machine); live mode is stdlib urllib.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import re
+import sys
+
+RUNBOOK = "docs/resilience.md"
+
+# anomaly kind -> (one-line action, runbook anchor)
+HINTS = {
+    "recompile_storm": (
+        "new shapes are arriving every multiply and XLA is recompiling "
+        "for each; bucket/pad the block sizes or pin the workload's "
+        "shape set", "#anomaly-recompile-storm"),
+    "fallback_storm": (
+        "a quarantined driver keeps being re-routed; check the open "
+        "breakers below and the driver chain", "#anomaly-fallback-storm"),
+    "dispatch_latency_spike": (
+        "a multiply ran far over the rolling median; on a remote "
+        "tunnel this is the wedge signature — see the wedged-tunnel "
+        "runbook", "#anomaly-dispatch-latency-spike"),
+    "roofline_collapse": (
+        "a driver's achieved fraction of roofline dropped below half "
+        "its window median; device throttled or tunnel latency regime "
+        "changed", "#anomaly-roofline-collapse"),
+    "breaker_open": (
+        "a (driver, shape) is quarantined; the chain re-routes it — "
+        "fix the kernel or force a safe driver",
+        "#driver-failover--circuit-breakers"),
+    "wedge_streak": (
+        "a guarded hardware channel is not answering; backoff is "
+        "exponential — check the tunnel before resetting anything",
+        "#runbook-wedged-tunnel"),
+    "checksum_corruption": (
+        "a checksum retry classified deterministic/unstable: proven "
+        "numeric corruption — quarantine the driver and capture the "
+        "flight dump", "#checksum-gate-one-shot-safe-driver-retry"),
+}
+
+
+# --------------------------------------------------------- prometheus
+
+def parse_prometheus(text: str) -> dict:
+    """{metric: [(labels dict, value)]} from text exposition."""
+    out: dict = collections.defaultdict(list)
+    pat = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+    lab = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="([^"]*)"')
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = pat.match(line)
+        if m is None:
+            continue
+        labels = dict(lab.findall(m.group(2) or ""))
+        try:
+            val = float(m.group(3))
+        except ValueError:
+            continue
+        out[m.group(1)].append((labels, val))
+    return dict(out)
+
+
+# ------------------------------------------------------------- inputs
+
+def _read_jsonl(path: str) -> list:
+    recs = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail line
+    except OSError:
+        return []
+    return recs
+
+
+def expand_shards(base: str) -> list:
+    """A shard base (``events.jsonl``) expands to its ``p*`` shards; a
+    concrete file (or glob) stays itself.  Delegates to the ONE
+    sharding-contract implementation (`tools/trace_merge.py` — skips
+    unsettled ``.ptmp*`` shards, drops chrome exports)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_merge
+
+    return trace_merge.expand_shards([base])
+
+
+def fetch_live(url: str, timeout: float = 10.0) -> dict:
+    """Pull /healthz /metrics /events /flight off a live endpoint."""
+    import urllib.error
+    import urllib.request
+
+    def get(route):
+        try:
+            with urllib.request.urlopen(url.rstrip("/") + route,
+                                        timeout=timeout) as r:
+                return r.read().decode()
+        except urllib.error.HTTPError as e:  # 503 CRITICAL still has a body
+            return e.read().decode()
+
+    return {
+        "health": json.loads(get("/healthz")),
+        "metrics_text": get("/metrics"),
+        "events": json.loads(get("/events")),
+        "flight": json.loads(get("/flight")),
+    }
+
+
+# ----------------------------------------------------------- analysis
+
+def analyze(health: dict | None, prom: dict, events: list,
+            flight: list, probe: list, captures: list,
+            top: int = 5) -> dict:
+    """Fold every available signal into one report dict (the renderer
+    and --json both consume this)."""
+    report: dict = {"health": health, "hints": []}
+
+    # breakers: live gauge wins; else reconstruct last state per
+    # (driver, shape) from breaker_transition events
+    breakers = {}
+    for labels, v in prom.get("dbcsr_tpu_breaker_state", []):
+        state = {0: "closed", 1: "half_open", 2: "open"}.get(int(v), "?")
+        breakers[f"{labels.get('driver')}|{labels.get('shape')}"] = state
+    if not breakers:
+        for e in events:
+            if e.get("event") == "breaker_transition":
+                breakers[f"{e.get('driver')}|{e.get('shape')}"] = e.get("to")
+    report["breakers"] = breakers
+    open_breakers = {k: s for k, s in breakers.items()
+                     if s in ("open", "half_open")}
+    if open_breakers:
+        report["hints"].append(_hint("breaker_open", detail=", ".join(
+            sorted(open_breakers))))
+
+    # watchdog: live gauge, else the LAST persisted probe record per
+    # channel (the capture loop's capture_probe.jsonl)
+    watchdog = {}
+    for labels, v in prom.get("dbcsr_tpu_watchdog_wedge_streak", []):
+        watchdog[labels.get("name", "?")] = {"wedge_streak": int(v)}
+    for rec in probe:
+        name = rec.get("name", "?")
+        watchdog[name] = {
+            "wedge_streak": int(rec.get("wedge_streak", 0)),
+            "streak": int(rec.get("streak", 0)),
+            "outcome": rec.get("outcome"), "ts": rec.get("ts"),
+        }
+    report["watchdog"] = watchdog
+    wedged = {n: w for n, w in watchdog.items()
+              if w.get("wedge_streak", 0) >= 1}
+    if wedged:
+        report["hints"].append(_hint("wedge_streak", detail=", ".join(
+            f"{n} (streak {w['wedge_streak']})"
+            for n, w in sorted(wedged.items()))))
+
+    # offenders: events grouped by product_id (the correlation payoff —
+    # "which multiplies caused the churn")
+    def offenders(kind):
+        by_product: dict = collections.Counter()
+        for e in events:
+            if e.get("event") == kind:
+                by_product[e.get("product_id") or "<no product>"] += 1
+        return by_product.most_common(top)
+
+    report["offenders"] = {
+        "recompiles": offenders("jit_compile"),
+        "fallbacks": offenders("driver_failover"),
+        "failures": offenders("driver_failure"),
+        "faults_injected": offenders("fault_injected"),
+    }
+    # name the offender products where the events carry the context
+    names = {}
+    for e in events:
+        if e.get("event") in ("multiply_begin", "multiply_end") \
+                and e.get("product_id"):
+            ent = names.setdefault(e["product_id"], {})
+            for f in ("name", "mnk", "dur_ms", "algorithm", "error"):
+                if e.get(f) is not None:
+                    ent[f] = e[f]
+    for r in flight:
+        if r.get("product_id"):
+            names.setdefault(r["product_id"], {}).update(
+                {f: r.get(f) for f in ("name", "mnk", "dur_ms", "error")
+                 if r.get(f) is not None})
+    report["products"] = names
+
+    # roofline per driver: live gauges, else the latest capture rows'
+    # embedded modeled block
+    roofline = {}
+    for labels, v in prom.get("dbcsr_tpu_roofline_fraction", []):
+        roofline[labels.get("driver", "?")] = round(v, 5)
+    if not roofline:
+        for row in captures:
+            modeled = row.get("modeled") or {}
+            frac = modeled.get("roofline_fraction")
+            if frac is not None:
+                key = row.get("algorithm") or row.get("metric", "?")[:40]
+                roofline[key] = round(float(frac), 5)
+    report["roofline"] = roofline
+
+    # anomalies: live health verdict first, else anomaly events
+    anomalies: dict = collections.Counter()
+    if health:
+        for kind, n in (health.get("anomaly_counts") or {}).items():
+            anomalies[kind] += int(n)
+    for e in events:
+        if e.get("event") == "anomaly" and not health:
+            anomalies[e.get("kind", "?")] += 1
+    report["anomalies"] = dict(anomalies)
+    for kind in anomalies:
+        if kind in HINTS:
+            report["hints"].append(_hint(kind))
+
+    # corruption verdicts ride the checksum_retry counter/events
+    corrupt = 0.0
+    for labels, v in prom.get("dbcsr_tpu_checksum_retry_total", []):
+        if labels.get("outcome") in ("deterministic", "unstable"):
+            corrupt += v
+    corrupt += sum(1 for e in events
+                   if e.get("event") == "checksum_retry"
+                   and e.get("outcome") in ("deterministic", "unstable"))
+    if corrupt:
+        report["hints"].append(_hint("checksum_corruption",
+                                     detail=f"{int(corrupt)} verdict(s)"))
+
+    # synthesize a health verdict from artifacts when no live one exists
+    if health is None:
+        status = "OK"
+        if open_breakers or wedged or anomalies:
+            status = "DEGRADED"
+        if corrupt or any(w.get("wedge_streak", 0) >= 3
+                          for w in watchdog.values()):
+            status = "CRITICAL"
+        report["health"] = {"status": status, "source": "artifacts"}
+    return report
+
+
+def _hint(kind: str, detail: str = "") -> dict:
+    action, anchor = HINTS[kind]
+    return {"kind": kind, "detail": detail, "action": action,
+            "runbook": RUNBOOK + anchor}
+
+
+# ----------------------------------------------------------- renderer
+
+def render(report: dict, out=print) -> None:
+    h = report.get("health") or {}
+    out(f" dbcsr_tpu doctor — overall: {h.get('status', '?')}"
+        + (f"  (source: {h['source']})" if h.get("source") else ""))
+    comps = (h.get("components") or {})
+    if comps:
+        out(f"   {'component':<12} {'status':<10} reasons")
+        for name, c in sorted(comps.items()):
+            reasons = "; ".join(c.get("reasons") or []) or "-"
+            out(f"   {name:<12} {c.get('status', '?'):<10} {reasons}")
+    if report.get("breakers"):
+        openish = {k: s for k, s in report["breakers"].items()
+                   if s != "closed"}
+        out(f" breakers: {len(report['breakers'])} tracked, "
+            f"{len(openish)} not closed"
+            + (": " + ", ".join(f"{k}={s}"
+                                for k, s in sorted(openish.items()))
+               if openish else ""))
+    if report.get("watchdog"):
+        for name, w in sorted(report["watchdog"].items()):
+            extra = f" last={w['outcome']}" if w.get("outcome") else ""
+            out(f" watchdog {name}: wedge_streak={w.get('wedge_streak', 0)}"
+                f"{extra}")
+    for label, key in (("recompile offenders", "recompiles"),
+                       ("fallback offenders", "fallbacks"),
+                       ("failure offenders", "failures")):
+        offs = report.get("offenders", {}).get(key) or []
+        if not offs:
+            continue
+        out(f" top {label} (by product):")
+        for pid, n in offs:
+            ctx = report.get("products", {}).get(pid, {})
+            mnk = ctx.get("mnk")
+            desc = f" {ctx.get('name', '')}" \
+                   + (f" {tuple(mnk)}" if mnk else "")
+            out(f"   {n:>6}x  {pid}{desc}")
+    if report.get("roofline"):
+        out(" roofline fraction per driver:")
+        for drv, frac in sorted(report["roofline"].items()):
+            out(f"   {drv:<40} {frac}")
+    if report.get("anomalies"):
+        out(" anomalies: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(report["anomalies"].items())))
+    if report.get("hints"):
+        out(" hints:")
+        for hint in report["hints"]:
+            det = f" [{hint['detail']}]" if hint.get("detail") else ""
+            out(f"   - {hint['kind']}{det}: {hint['action']}")
+            out(f"     runbook: {hint['runbook']}")
+    if not any(report.get(k) for k in
+               ("breakers", "watchdog", "anomalies", "roofline")) \
+            and not (report.get("offenders") or {}).get("recompiles"):
+        out(" (no signals found — is the job instrumented / are the "
+            "artifact paths right?)")
+
+
+# ----------------------------------------------------------- selftest
+
+def _selftest(repo_root: str) -> int:
+    """Offline smoke: synthetic correlated events + the committed bench
+    artifacts through the full analyze/render pipeline.  Exit 0 iff
+    every expected section materializes."""
+    pid = "self-1"
+    events = [
+        {"event": "multiply_begin", "product_id": pid, "name": "C",
+         "mnk": [184, 184, 184]},
+        {"event": "fault_injected", "product_id": pid,
+         "site": "execute_stack", "kind": "raise", "target": "pallas"},
+        {"event": "driver_failure", "product_id": pid, "driver": "pallas",
+         "kind": "runtime", "shape": "23x23x23xfloat64"},
+        {"event": "breaker_transition", "product_id": pid,
+         "driver": "pallas", "shape": "23x23x23xfloat64", "to": "open",
+         "transition": "threshold"},
+        {"event": "driver_failover", "product_id": pid, "from": "pallas",
+         "to": "xla_group", "shape": "23x23x23xfloat64"},
+        {"event": "jit_compile", "product_id": pid,
+         "fn": "acc.smm._process_stack_xla", "key": "(23, 23, 23)"},
+        {"event": "anomaly", "kind": "fallback_storm",
+         "rate_per_multiply": 1.0, "product_id": None},
+        {"event": "multiply_end", "product_id": pid, "dur_ms": 12.5,
+         "algorithm": "stack"},
+    ]
+    probe = [{"ts": "2026-01-01T00:00:00", "name": "tpu_probe",
+              "outcome": "WEDGED", "streak": 4, "wedge_streak": 2,
+              "elapsed_s": 120.0, "error": "DeadlineExceeded"}]
+    captures = []
+    for path in sorted(glob.glob(os.path.join(repo_root, "BENCH_r0*.json"))):
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict):
+            captures.append(parsed)
+    captures += _read_jsonl(os.path.join(repo_root, "BENCH_CAPTURES.jsonl"))
+    report = analyze(None, {}, events, [], probe, captures)
+    render(report)
+    ok = (
+        report["health"]["status"] in ("DEGRADED", "CRITICAL")
+        and report["breakers"].get("pallas|23x23x23xfloat64") == "open"
+        and report["watchdog"].get("tpu_probe", {}).get("wedge_streak") == 2
+        and report["offenders"]["fallbacks"][0][0] == pid
+        and report["anomalies"].get("fallback_storm") == 1
+        and any(h["kind"] == "wedge_streak" for h in report["hints"])
+        and any(h["kind"] == "breaker_open" for h in report["hints"])
+    )
+    print(f" selftest: {'OK' if ok else 'FAILED'} "
+          f"(captures read: {len(captures)})")
+    return 0 if ok else 1
+
+
+# --------------------------------------------------------------- main
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--url", help="live endpoint base URL")
+    ap.add_argument("--port", type=int,
+                    help="live endpoint on localhost:<port>")
+    ap.add_argument("--events", default="events.jsonl",
+                    help="event-bus JSONL (shard base or file)")
+    ap.add_argument("--trace", default="trace.jsonl",
+                    help="trace JSONL (shard base or file) — instants "
+                         "feed the offender tables when no events exist")
+    ap.add_argument("--probe", default="capture_probe.jsonl",
+                    help="watchdog probe JSONL (capture loop)")
+    ap.add_argument("--captures", default="BENCH_CAPTURES.jsonl",
+                    help="bench capture JSONL (roofline fractions)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="offender table size (default 5)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report")
+    ap.add_argument("--selftest", action="store_true",
+                    help="offline smoke against synthetic events + the "
+                         "committed bench artifacts; exit 0 on success")
+    args = ap.parse_args(argv)
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if args.selftest:
+        return _selftest(repo_root)
+
+    health = None
+    prom: dict = {}
+    events: list = []
+    flight: list = []
+    if args.url or args.port:
+        url = args.url or f"http://127.0.0.1:{args.port}"
+        try:
+            live = fetch_live(url)
+        except Exception as exc:
+            print(f"doctor: cannot reach {url}: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
+            return 2
+        health = live["health"]
+        prom = parse_prometheus(live["metrics_text"])
+        events = live["events"]
+        flight = live["flight"]
+    else:
+        for shard in expand_shards(args.events):
+            events.extend(_read_jsonl(shard))
+        if not events:
+            # fall back to trace instants: same event names, no ring
+            for shard in expand_shards(args.trace):
+                for rec in _read_jsonl(shard):
+                    if rec.get("ev") == "instant":
+                        events.append(dict(rec.get("args") or {},
+                                           event=rec.get("name")))
+    probe = _read_jsonl(args.probe)
+    captures = _read_jsonl(args.captures)
+
+    report = analyze(health, prom, events, flight, probe, captures,
+                     top=args.top)
+    if args.as_json:
+        print(json.dumps(report, default=str))
+    else:
+        render(report)
+    status = (report.get("health") or {}).get("status", "OK")
+    return 1 if status == "CRITICAL" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
