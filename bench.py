@@ -305,7 +305,157 @@ def _run_bench(cfg, fallback: bool, dtype_enum: int):
     return res, mm_driver
 
 
+def run_chain_bench(fallback: bool) -> None:
+    """The chained-workload tier: a McWeeny purification chain
+    (north-star-shaped 23x23 f64 blocks, >=5 iterations) timed twice —
+    memory pool + device mirrors ON (the device-residency path) vs OFF
+    (the re-stage-every-multiply control) — with bitwise-identical
+    checksums asserted across the legs.  Prints ONE JSON line whose
+    ``ab`` field carries a perf_gate-compatible record per leg, plus
+    per-iteration wall seconds and per-iteration restage bytes
+    (h2d+d2h deltas): with residency on, bytes collapse to ~zero after
+    iteration 1.
+
+    Production-shaped configuration: the stack engine is forced
+    (``mm_dense=False`` — the dense path would densify the near-full
+    steady-state pattern on CPU and hide the staging story), the
+    device-side ``xla`` driver is forced (the CPU-tuned native host
+    driver computes ON host, so its per-multiply C round-trips are
+    algorithmic, not restage overhead — on the TPU target every auto
+    driver is device-side), and the chain FILTERS
+    (``DBCSR_TPU_CHAIN_FILTER_EPS``, default 1e-9) like the real
+    linear-scaling-DFT loop: filtered products are value-dependent, so
+    the stack-plan cache cannot help and every multiply re-derives its
+    stacks — exactly the regime the device index mirrors exist for."""
+    import jax
+
+    import numpy as np
+
+    from dbcsr_tpu.core import mempool
+    from dbcsr_tpu.core.config import set_config
+    from dbcsr_tpu.core.lib import init_lib
+    from dbcsr_tpu.core import stats
+    from dbcsr_tpu.mm import multiply as mm_multiply
+    from dbcsr_tpu.models.purify import make_test_density, mcweeny_step
+    from dbcsr_tpu.ops.test_methods import to_dense
+
+    init_lib()
+    set_config(mm_dense=False, mm_driver="xla")
+    iters = max(5, int(os.environ.get("DBCSR_TPU_CHAIN_ITERS", "6")))
+    nblk = int(os.environ.get("DBCSR_TPU_CHAIN_BLOCKS", "32"))
+    filter_eps = float(os.environ.get("DBCSR_TPU_CHAIN_FILTER_EPS", "1e-9"))
+    bs = 23
+    m = nblk * bs
+
+    def _build_p0():
+        # pre-iterate to the sparsity-pattern fixpoint so the measured
+        # chain is structure-stable from its first iteration (the
+        # SCF-loop steady state the tier models); the cold-staging cost
+        # is then entirely in measured iteration 1
+        p = make_test_density(nblk, bs, occ=0.2, seed=7)
+        for _ in range(2):
+            p = mcweeny_step(p, filter_eps=filter_eps or None)
+        return p
+
+    def _run_leg(pooled: bool, timed: bool):
+        mempool.set_enabled(pooled)
+        p0 = _build_p0()
+        mempool.clear()
+        mempool.reset_stats()
+        mm_multiply._plan_cache.clear()
+        per_iter_s, per_iter_bytes, flops0 = [], [], stats.total_flops()
+        with mempool.chain() as ch:
+            cur = p0
+            for _ in range(iters):
+                tr0 = mempool.transfer_totals()
+                t0 = time.perf_counter()
+                new = mcweeny_step(cur, filter_eps=filter_eps or None)
+                for b in new.bins:
+                    jax.block_until_ready(b.data)
+                per_iter_s.append(time.perf_counter() - t0)
+                tr1 = mempool.transfer_totals()
+                per_iter_bytes.append(
+                    (tr1["h2d"] - tr0["h2d"]) + (tr1["d2h"] - tr0["d2h"]))
+                if cur is not p0:
+                    ch.retire(cur)
+                cur = new
+            ch.detach(cur)
+        dense = np.asarray(to_dense(cur))
+        flops = stats.total_flops() - flops0
+        secs = sum(per_iter_s)
+        return {
+            "seconds": round(secs, 4),
+            "per_iter_seconds": [round(s, 4) for s in per_iter_s],
+            "per_iter_bytes": per_iter_bytes,
+            "gflops": round(flops / secs / 1e9, 3) if secs else 0.0,
+            "flops": int(flops),
+            "pool": mempool.pool_stats() if timed else None,
+        }, dense
+
+    # absorb every XLA compile (incl. the pool's donated-rezero and
+    # donated-axpby variants) before either timed leg, so the legs
+    # compare staging + dispatch, not compilation order
+    _run_leg(False, timed=False)
+    _run_leg(True, timed=False)
+
+    from dbcsr_tpu import obs as _obs
+    from dbcsr_tpu.obs import costmodel as _costmodel
+
+    metric = (f"mcweeny_chain GFLOP/s ({m}^2 BCSR, 23x23 blocks, f64, "
+              f"{iters} iters)")
+    stamps = {
+        "unit": "GFLOP/s",
+        "device": str(jax.devices()[0]),
+        "device_fallback": fallback,
+        "device_kind": _costmodel.device_kind(),
+        "jax_version": jax.__version__,
+        "obs_schema": _obs.OBS_SCHEMA_VERSION,
+        "stack_mode": "fused",
+        "mm_driver": "xla",
+        "filter_eps": filter_eps or None,
+        "chain_iters": iters,
+    }
+    legs = {}
+    checks = {}
+    for name, pooled in (("unpooled", False), ("pooled", True)):
+        res, dense = _run_leg(pooled, timed=True)
+        checks[name] = dense
+        legs[name] = dict(stamps, metric=metric, value=res.pop("gflops"),
+                          chain_pool=pooled, **res)
+    match = bool(np.array_equal(checks["pooled"], checks["unpooled"]))
+    out = dict(
+        stamps,
+        metric=metric,
+        value=legs["pooled"]["value"],
+        checksum=float(np.sum(checks["pooled"])),
+        checksum_bitwise_match=match,
+        speedup_pooled=round(
+            legs["unpooled"]["seconds"] / legs["pooled"]["seconds"], 3)
+        if legs["pooled"]["seconds"] else None,
+        # restage collapse: steady-state (iters 2..N) bytes per
+        # iteration vs the chain's first (cold) iteration
+        restage_bytes_iter1=legs["pooled"]["per_iter_bytes"][0],
+        restage_bytes_steady=max(legs["pooled"]["per_iter_bytes"][1:]),
+        ab=legs,
+    )
+    if not match:
+        out["error"] = "pooled/unpooled checksums differ"
+    print(json.dumps(out))
+    if not match:
+        sys.exit(1)
+
+
 def main():
+    if "--chain" in sys.argv:
+        probe_timeout = int(os.environ.get(
+            "DBCSR_TPU_BENCH_PROBE_TIMEOUT", "600"))
+        fallback = not _probe_tpu(probe_timeout)
+        if fallback:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        return run_chain_bench(fallback)
     probe_timeout = int(os.environ.get("DBCSR_TPU_BENCH_PROBE_TIMEOUT", "600"))
     carve = _pick_carve_from_evidence()
     os.environ["DBCSR_TPU_DENSE_CARVE"] = carve

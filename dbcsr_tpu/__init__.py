@@ -42,6 +42,8 @@ from dbcsr_tpu.core.dist import (
     dist_bin,
 )
 from dbcsr_tpu.core.matrix import BlockIterator, BlockSparseMatrix, create
+from dbcsr_tpu.core import mempool
+from dbcsr_tpu.core.mempool import chain
 from dbcsr_tpu.mm.multiply import multiply
 from dbcsr_tpu import obs
 from dbcsr_tpu import resilience
@@ -159,7 +161,9 @@ __all__ = [
     "convert_sizes_to_offsets",
     "copy",
     "copy_into_existing",
+    "chain",
     "create",
+    "mempool",
     "crop_matrix",
     "csr_create_from_matrix",
     "csr_from_matrix",

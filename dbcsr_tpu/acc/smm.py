@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dbcsr_tpu.core import mempool as _mempool
 from dbcsr_tpu.core.config import get_config
 from dbcsr_tpu.core.kinds import real_dtype_of
 from dbcsr_tpu.obs import costmodel as _costmodel
@@ -570,7 +571,14 @@ def _prepare_stack_impl(c_data, a_data, b_data, a_idx, b_idx, c_idx,
         plan.r_grp = r0  # metadata: the R-tile grouping actually used
         plan.a_pad_row = a_pad_row
         plan.b_pad_row = b_pad_row
-        plan.group_idx = (jnp.asarray(ga), jnp.asarray(gb), jnp.asarray(gc))
+        # the device index mirror (core.mempool): pattern-stable
+        # repeats (incl. filtered products the plan cache skips)
+        # re-upload nothing
+        plan.group_idx = (
+            _mempool.upload_index("grp_a", ga),
+            _mempool.upload_index("grp_b", gb),
+            _mempool.upload_index("grp_c", gc),
+        )
         _note_driver(
             "xla_group",
             "config-forced" if cfg.mm_driver == "xla_group"
@@ -724,7 +732,7 @@ def _prepare_stack_impl(c_data, a_data, b_data, a_idx, b_idx, c_idx,
             plan.a_pad_row = a_pad_row
             plan.b_pad_row = b_pad_row
             plan.launches = [
-                tuple(map(jnp.asarray, lc))
+                tuple(_mempool.upload_index("pl_idx", x) for x in lc)
                 for lc in pallas_smm.prepare_launches(
                     ai2, bi2, ci2, r_grp, a_pad_row, b_pad_row
                 )
@@ -768,9 +776,9 @@ def _prepare_stack_impl(c_data, a_data, b_data, a_idx, b_idx, c_idx,
         or (cfg.mm_driver == "auto" and tuned_driver == "xla_flat")
     ) else "xla"
     plan.xla_idx = (
-        jnp.asarray(ai.reshape(nchunks, chunk)),
-        jnp.asarray(bi.reshape(nchunks, chunk)),
-        jnp.asarray(ci.reshape(nchunks, chunk)),
+        _mempool.upload_index("stk_a", ai.reshape(nchunks, chunk)),
+        _mempool.upload_index("stk_b", bi.reshape(nchunks, chunk)),
+        _mempool.upload_index("stk_c", ci.reshape(nchunks, chunk)),
     )
     if plan.driver == "xla_flat":
         why = "config.flat_gather" if cfg.flat_gather else "tuned"
@@ -1142,10 +1150,12 @@ def _execute_plan(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
             c_np = np.zeros(c_data.shape, np.dtype(c_data.dtype))
         else:
             c_np = np.array(c_data)  # writable host copy (memcpy)
-        ok = native.host_smm(
-            c_np, np.asarray(a_data), np.asarray(b_data), ai, bi, ci, alpha
-        )
+            _mempool.record_d2h(c_np.nbytes)
+        a_np, b_np = np.asarray(a_data), np.asarray(b_data)
+        _mempool.record_d2h(a_np.nbytes + b_np.nbytes)
+        ok = native.host_smm(c_np, a_np, b_np, ai, bi, ci, alpha)
         if ok:
+            _mempool.record_h2d(c_np.nbytes)
             return jnp.asarray(c_np)
         # native library vanished after planning (e.g. DBCSR_TPU_NATIVE
         # flipped): rebuild the plan in place without the host driver.
@@ -1585,15 +1595,17 @@ def _dispatch_superstack(c_data, a_datas, b_datas, splan: SuperstackPlan,
             c_np = np.zeros(c_data.shape, np.dtype(c_data.dtype))
         else:
             c_np = np.array(c_data)  # ONE writable host copy per bin
+            _mempool.record_d2h(c_np.nbytes)
         for plan, a_d, b_d in zip(plans, a_datas, b_datas):
             ai, bi, ci = plan.host_idx
-            ok = native.host_smm(
-                c_np, np.asarray(a_d), np.asarray(b_d), ai, bi, ci, alpha
-            )
+            a_np, b_np = np.asarray(a_d), np.asarray(b_d)
+            _mempool.record_d2h(a_np.nbytes + b_np.nbytes)
+            ok = native.host_smm(c_np, a_np, b_np, ai, bi, ci, alpha)
             if not ok:
                 raise RuntimeError(
                     "native host driver unavailable during a fused "
                     "superstack launch")
+        _mempool.record_h2d(c_np.nbytes)
         return jnp.asarray(c_np)
     compiled, jit_key = _record_superstack_jit(splan, c_data, a_datas,
                                                b_datas)
@@ -1819,5 +1831,6 @@ def block_norms(data):
     Ref `c_calculate_norms` (`src/acc/cuda_hip/calculate_norms.cpp`),
     used for on-the-fly norm-product filtering in the stack builder.
     """
-    out = _block_norms(data)
-    return np.asarray(out, dtype=real_dtype_of(data.dtype))
+    out = np.asarray(_block_norms(data), dtype=real_dtype_of(data.dtype))
+    _mempool.record_d2h(out.nbytes)
+    return out

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dbcsr_tpu.core import mempool
 from dbcsr_tpu.core.dist import Distribution
 from dbcsr_tpu.core.kinds import dtype_of, is_complex
 from dbcsr_tpu.core.lib import ensure_init
@@ -133,6 +134,18 @@ class BlockSparseMatrix:
         self._work: Dict[Tuple[int, int], np.ndarray] = {}
         # batched staging: (keys int64, blocks (N, bm, bn), summation)
         self._work_batches: List[Tuple[np.ndarray, np.ndarray, bool]] = []
+        # device residency (core.mempool): pool-owned matrices donate
+        # replaced bin buffers back to the pool from the mutation
+        # funnels; copy() marks bins shared, which disables donation
+        self._pool_owned = False
+        self._bins_shared = False
+        # per-matrix device index mirrors, invalidated when the pattern
+        # fingerprint changes (any structure-altering finalize)
+        self._dev_mirrors: Dict = {}
+        self._mirror_fp = None
+        ch = mempool.current_chain()
+        if ch is not None:
+            ch.adopt(self)
 
     # ---------------------------------------------------------------- shape
     @property
@@ -191,6 +204,14 @@ class BlockSparseMatrix:
     def valid_index(self) -> bool:
         """Finalized and consistent (ref `dbcsr_valid_index`)."""
         return self.valid
+
+    @property
+    def _donatable(self) -> bool:
+        """THE donation-eligibility rule, single-sourced: replaced bin
+        buffers may return to the memory pool only when this matrix is
+        pool-owned (chain-adopted) and its bins were never shared
+        through `copy` (a shared buffer must never be recycled)."""
+        return self._pool_owned and not self._bins_shared
 
     def get_data_size(self) -> int:
         """Stored elements incl. bucket padding — the data-area size
@@ -421,7 +442,8 @@ class BlockSparseMatrix:
         shape_to_bin = {(int(bm), int(bn)): i for i, (bm, bn) in enumerate(shapes)}
         counts = np.bincount(nb, minlength=len(shapes))
         data_arrs = [
-            jnp.zeros((bucket_size(int(counts[i])), int(bm), int(bn)), self.dtype)
+            mempool.zeros((bucket_size(int(counts[i])), int(bm), int(bn)),
+                          self.dtype)
             for i, (bm, bn) in enumerate(shapes)
         ]
         # 1) surviving old blocks: device-to-device migration per shape
@@ -436,16 +458,19 @@ class BlockSparseMatrix:
                 data_arrs[b] = _migrate_blocks(
                     data_arrs[b],
                     src.data,
-                    jnp.asarray(self.ent_slot[old_sel]),
-                    jnp.asarray(nsl[pos_old[old_sel]]),
+                    mempool.upload_index("fin_src", self.ent_slot[old_sel]),
+                    mempool.upload_index("fin_dst", nsl[pos_old[old_sel]]),
                 )
         # 2) staged batches in call order (a batch is shape-uniform ->
         #    exactly one bin; single puts were prepended as a batch)
         for keys_b, arr, summation in self._work_batches:
             b = shape_to_bin[(arr.shape[1], arr.shape[2])]
             slots = nsl[np.searchsorted(merged, keys_b)]
+            if isinstance(arr, np.ndarray):
+                mempool.record_h2d(arr.nbytes)  # staged host blocks
             data_arrs[b] = _scatter_staged(
-                data_arrs[b], jnp.asarray(arr), jnp.asarray(slots), bool(summation)
+                data_arrs[b], jnp.asarray(arr),
+                mempool.upload_index("fin_slot", slots), bool(summation)
             )
         bins = [
             _Bin((int(bm), int(bn)), data_arrs[i], int(counts[i]))
@@ -462,13 +487,24 @@ class BlockSparseMatrix:
         """Adopt a prebuilt index + device bins (used by the multiply
         engine, which assembles C on device).  ``binning`` optionally
         carries a precomputed ``_bin_entries`` result to avoid
-        recomputing it."""
+        recomputing it.
+
+        Caller contract (every in-tree caller satisfies it): ``bins``
+        hold FRESHLY CONSTRUCTED device arrays not aliased into any
+        other matrix — which is why a full restructure clears the
+        `copy`-induced shared mark: the new bins are exclusively this
+        matrix's again, so pool donation resumes."""
         keys = np.ascontiguousarray(keys, np.int64)
         rows = (keys // self.nblkcols).astype(np.int64)
         cols = (keys % self.nblkcols).astype(np.int64)
         if binning is None:
             binning = _bin_entries(self.row_blk_sizes, self.col_blk_sizes, rows, cols)
         bin_ids, slots, shapes = binning
+        # pool-owned matrices donate the buffers this restructure
+        # retires (the dbcsr_mem_methods "return to pool" half);
+        # anything aliased into the NEW bins — or ever shared via
+        # copy() — is kept
+        old_data = [b.data for b in self.bins] if self._donatable else None
         self.keys = keys
         self.row_ptr = np.zeros(self.nblkrows + 1, np.int64)
         self.row_ptr[1:] = np.cumsum(np.bincount(rows, minlength=self.nblkrows))
@@ -480,6 +516,12 @@ class BlockSparseMatrix:
         self._work.clear()
         self._work_batches.clear()
         self.invalidate_dense_cache()  # structure changed
+        if old_data is not None:
+            live = {id(b.data) for b in self.bins}
+            for d in old_data:
+                if id(d) not in live:
+                    mempool.release(d)
+        self._bins_shared = False  # fresh bins: exclusively owned again
         self.valid = True
 
     # --------------------------------------------------------------- access
@@ -504,9 +546,58 @@ class BlockSparseMatrix:
                 return None
             b = self.bins[self.ent_bin[e]]
             blk = np.asarray(b.data[self.ent_slot[e]])
+            mempool.record_d2h(blk.nbytes)
         if folded and unfold:
             blk = _fold_block(blk, self.matrix_type)
         return blk
+
+    def get_blocks(self, rows, cols, unfold: bool = True) -> List:
+        """Fetch many blocks with ONE batched device gather per shape
+        bin instead of a per-entry D2H round-trip (`get_block` in a
+        loop fetches block-by-block; this is its `stage_device_blocks`
+        sibling on the read side).  Returns a list aligned with
+        ``rows``/``cols``; absent blocks are None.  Blocks still
+        sitting in the pre-finalize work buffer are served from host."""
+        rows = np.ascontiguousarray(rows, np.int64)
+        cols = np.ascontiguousarray(cols, np.int64)
+        if len(rows) != len(cols):
+            raise ValueError("rows/cols length mismatch")
+        n = len(rows)
+        out: List = [None] * n
+        if n == 0:
+            return out
+        self._validate_coords(rows, cols)
+        srows, scols = rows.copy(), cols.copy()
+        folded = np.zeros(n, bool)
+        if self.matrix_type != NO_SYMMETRY:
+            folded = rows > cols
+            srows = np.where(folded, cols, rows)
+            scols = np.where(folded, rows, cols)
+        keys = srows * self.nblkcols + scols
+        pos = np.searchsorted(self.keys, keys)
+        pos_c = np.minimum(pos, max(len(self.keys) - 1, 0))
+        found = (
+            np.zeros(n, bool) if len(self.keys) == 0
+            else self.keys[pos_c] == keys
+        )
+        for b_id, b in enumerate(self.bins):
+            sel = np.nonzero(found & (self.ent_bin[pos_c] == b_id))[0]
+            if not len(sel):
+                continue
+            slots = self.ent_slot[pos_c[sel]]
+            fetched = np.asarray(
+                jnp.take(b.data, mempool.upload_index("getblk", slots),
+                         axis=0))
+            mempool.record_d2h(fetched.nbytes)
+            for i, e in enumerate(sel):
+                out[e] = fetched[i]
+        for e in range(n):
+            key = (int(srows[e]), int(scols[e]))
+            if key in self._work:
+                out[e] = self._work[key].copy()
+            if out[e] is not None and folded[e] and unfold:
+                out[e] = _fold_block(out[e], self.matrix_type)
+        return out
 
     def iterate_blocks(self) -> Iterator[Tuple[int, int, np.ndarray]]:
         """Iterate stored blocks in index order (ref `dbcsr_iterator_*`,
@@ -515,6 +606,7 @@ class BlockSparseMatrix:
         if not self.valid:
             raise RuntimeError("finalize() before iterating")
         host_bins = [np.asarray(b.data[: b.count]) for b in self.bins]
+        mempool.record_d2h(sum(hb.nbytes for hb in host_bins))
         rows, cols = self.entry_coords()
         for e in range(self.nblks):
             yield int(rows[e]), int(cols[e]), host_bins[self.ent_bin[e]][
@@ -529,7 +621,17 @@ class BlockSparseMatrix:
         return BlockIterator(self)
 
     def block_norms(self) -> np.ndarray:
-        """Frobenius norm per finalized entry, key-ordered (device compute)."""
+        """Frobenius norm per finalized entry, key-ordered (device
+        compute).  Memoized against the bin data-array identities
+        under device residency (`core.mempool`): a matrix used as both
+        operands of a filtered product — or reused across a chain's
+        multiplies — computes (and fetches) its norms once, like the
+        reference's per-data-area `calc_norms` caching.  The cache
+        holds the hashed arrays, so ids cannot recycle."""
+        key = tuple(id(b.data) for b in self.bins)
+        cached = getattr(self, "_norms_cache", None)
+        if mempool.enabled() and cached is not None and cached[0] == key:
+            return cached[1]
         from dbcsr_tpu.acc.smm import block_norms as _bn
 
         out = np.zeros(self.nblks, np.float64)
@@ -539,6 +641,8 @@ class BlockSparseMatrix:
             norms = _bn(b.data)
             mask = self.ent_bin == b_id
             out[mask] = np.asarray(norms)[self.ent_slot[mask]]
+        if mempool.enabled():
+            self._norms_cache = (key, out, [b.data for b in self.bins])
         return out
 
     # ------------------------------------------------------------ structure
@@ -581,6 +685,11 @@ class BlockSparseMatrix:
         m._work = {k: v.copy() for k, v in self._work.items()}
         m._work_batches = [(k.copy(), a.copy(), s) for (k, a, s) in self._work_batches]
         m.valid = self.valid
+        # both sides now alias the same device buffers: neither may
+        # ever donate them back to the pool (conservative, permanent)
+        if self.bins:
+            self._bins_shared = True
+            m._bins_shared = True
         return m
 
     def map_bin_data(self, fn) -> None:
@@ -591,22 +700,96 @@ class BlockSparseMatrix:
         relies on the rows-beyond-count-are-zero invariant, which an
         arbitrary elementwise fn (fn(0) != 0) would otherwise break.
         """
+        releasable = self._donatable
+        all_fresh = True
         for b in self.bins:
             if b.count:
                 data = fn(b.data)
                 if data.shape[0] > b.count:
                     data = _rezero_pad_rows(data, b.count)
+                if releasable and data is not b.data:
+                    mempool.release(b.data)
+                if data is b.data:
+                    all_fresh = False
                 b.data = data
+            else:
+                all_fresh = False  # empty bin: data possibly still aliased
+        if all_fresh and self.bins:
+            # every buffer was replaced with a fresh fn output: a
+            # copy-induced shared mark no longer applies (a chain whose
+            # lineage passed through copy()+scale regains donation)
+            self._bins_shared = False
         self.invalidate_dense_cache()  # values changed
 
+    def device_index(self, tag, build):
+        """Per-matrix device mirror of a structure-derived index array
+        (or tuple of arrays) — the `acc_devmem` + `acc_ready` analog:
+        ``build`` runs on the first request and whenever the sparsity
+        pattern changed since (any finalize that altered structure
+        invalidates — the mirror is keyed to `pattern_fingerprint`, so
+        a same-pattern finalize keeps it).  Only STRUCTURE-derived
+        uploads belong here; value-dependent arrays must not be
+        mirrored.  Honors the residency knob like every other mirror:
+        with `mempool` disabled, ``build`` runs every call (the
+        historical re-upload-per-op engine)."""
+
+        def _count(x):
+            for leaf in x if isinstance(x, (tuple, list)) else (x,):
+                mempool.record_h2d(
+                    int(np.prod(leaf.shape))
+                    * int(jnp.dtype(leaf.dtype).itemsize))
+
+        if not mempool.enabled():
+            hit = build()
+            _count(hit)
+            return hit
+        fp = self.pattern_fingerprint()
+        if self._mirror_fp != fp:
+            self._dev_mirrors.clear()
+            self._mirror_fp = fp
+        hit = self._dev_mirrors.get(tag)
+        if hit is None:
+            hit = self._dev_mirrors[tag] = build()
+            _count(hit)
+        return hit
+
+    def free(self) -> None:
+        """Release this matrix's device storage back to the memory pool
+        (the `dbcsr_release` analog): bin buffers and any cached dense
+        canvas are donated when this matrix owns them exclusively
+        (pool-owned, never shared through `copy`), then the matrix is
+        emptied and marked invalid.  Stale outside references to the
+        released buffers raise on use once recycled — they never read
+        recycled data."""
+        if self._donatable:
+            for b in self.bins:
+                mempool.release(b.data)
+            cache = getattr(self, "_dense_canvas_cache", None)
+            if cache is not None:
+                mempool.release(cache[1])
+        self.bins = []
+        self._shape_to_bin = {}
+        self.keys = np.empty(0, np.int64)
+        self.row_ptr = np.zeros(self.nblkrows + 1, np.int64)
+        self.ent_bin = np.empty(0, np.int32)
+        self.ent_slot = np.empty(0, np.int32)
+        self._work.clear()
+        self._work_batches.clear()
+        self._dev_mirrors.clear()
+        self._mirror_fp = None
+        self._dense_canvas_cache = None
+        self._norms_cache = None
+        self.valid = False
+
     def invalidate_dense_cache(self) -> None:
-        """Drop the cached dense canvas (multiply engine).  Correctness
-        never depends on this — the cache is keyed by bin data-array
-        identity, so any rebind misses — but code that rebinds bin
-        ``data`` on a matrix that may carry a live canvas should call
-        it to release the stale canvas/array references early
+        """Drop the cached dense canvas (multiply engine) and the
+        block-norms memo.  Correctness never depends on this — both
+        caches key by bin data-array identity, so any rebind misses —
+        but the caches PIN the old device arrays (id-stability), so
+        every mutation funnel calls this to release them early
         (`map_bin_data` / `set_structure_from_device` do)."""
         self._dense_canvas_cache = None
+        self._norms_cache = None
 
     def zero_data(self) -> None:
         self.map_bin_data(lambda d: jnp.zeros_like(d))

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dbcsr_tpu.core import stats
+from dbcsr_tpu.core import mempool, stats
 from dbcsr_tpu.core.kinds import is_complex
 from dbcsr_tpu.core.matrix import (
     NO_SYMMETRY,
@@ -267,11 +267,16 @@ def _multiply_body(a, b, c, alpha, beta, retain_sparsity, filter_eps,
             new_keys = np.union1d(old_keys, np.unique(cand_keys))
 
     # plan-cache key: patterns + product options fully determine the
-    # stack plan; filtered products depend on VALUES (norms), so
-    # they are not cached (ref: the reference rebuilds stacks every
-    # multiply — caching across same-pattern repeats beats it)
+    # stack plan for UNFILTERED products.  Filtered products depend on
+    # VALUES (the norm filter prunes candidates), so their key
+    # additionally digests the surviving candidate list — an iterative
+    # chain whose filter keeps reaching the same survivors (the
+    # structure-stable steady state) then hits the cache too, paying a
+    # host hash instead of the full group-sort + index re-upload.
+    # Device-residency gated (mempool.enabled): the unpooled control
+    # is the historical rebuild-every-multiply engine.
     plan_key = None
-    if filter_eps is None:
+    if filter_eps is None or mempool.enabled():
         from dbcsr_tpu.acc import params as params_mod
         from dbcsr_tpu.core.config import get_config as _cfg
 
@@ -288,6 +293,13 @@ def _multiply_body(a, b, c, alpha, beta, retain_sparsity, filter_eps,
              cfg_.validate_kernels),
             params_mod._table_gen,
         )
+        if filter_eps is not None:
+            import hashlib
+
+            h = hashlib.sha1(cand_keys.tobytes())
+            h.update(a_ent.tobytes())
+            h.update(b_ent.tobytes())
+            plan_key += ("filtered", float(filter_eps), h.digest())
 
     with timed("multiply_c_assemble"):
         _rebuild_c(c, new_keys, beta, beta_window=beta_window)
@@ -664,17 +676,24 @@ def _to_dense_device(m: BlockSparseMatrix):
     for b_id, b in enumerate(m.bins):
         if b.count == 0:
             continue
-        sel = np.nonzero(m.ent_bin == b_id)[0]
-        cap = b.data.shape[0]
-        # dead (bucket-padding) slots get out-of-range offsets -> dropped;
-        # the full-capacity buffer keeps the jit shape stable across counts
-        ro = np.full(cap, m.nfullrows, np.int64)
-        co = np.full(cap, m.nfullcols, np.int64)
-        ro[m.ent_slot[sel]] = roff[sel]
-        co[m.ent_slot[sel]] = coff[sel]
+
+        def _offsets(b_id=b_id, b=b):
+            sel = np.nonzero(m.ent_bin == b_id)[0]
+            cap = b.data.shape[0]
+            # dead (bucket-padding) slots get out-of-range offsets ->
+            # dropped; the full-capacity buffer keeps the jit shape
+            # stable across counts
+            ro = np.full(cap, m.nfullrows, np.int64)
+            co = np.full(cap, m.nfullcols, np.int64)
+            ro[m.ent_slot[sel]] = roff[sel]
+            co[m.ent_slot[sel]] = coff[sel]
+            return jnp.asarray(ro), jnp.asarray(co)
+
+        # structure-derived offsets ride the per-matrix device mirror:
+        # a repeated same-pattern densify uploads them once
+        ro_d, co_d = m.device_index(("dense_off", b_id), _offsets)
         canvas = _scatter_bin_to_canvas(
-            canvas, b.data, jnp.asarray(ro), jnp.asarray(co),
-            bm=b.shape[0], bn=b.shape[1],
+            canvas, b.data, ro_d, co_d, bm=b.shape[0], bn=b.shape[1],
         )
     return canvas
 
@@ -852,7 +871,8 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
         return _blocks_to_dense(
             m.bins[0].data[: m.nblks] if m.nblks
             else jnp.zeros((0, brow, bcol), c.dtype),
-            jnp.asarray(rows), jnp.asarray(cols), nr, nc_, brow, bcol,
+            mempool.upload_index("dense_rows", rows),
+            mempool.upload_index("dense_cols", cols), nr, nc_, brow, bcol,
         )
 
     profile = os.environ.get("DBCSR_TPU_DENSE_PROFILE") == "1"
@@ -1261,7 +1281,7 @@ def _rebuild_c(c: BlockSparseMatrix, new_keys: np.ndarray, beta,
     for b_id, (bm, bn) in enumerate(shapes):
         count = int((nb == b_id).sum())
         cap = bucket_size(count)
-        data = jnp.zeros((cap, bm, bn), c.dtype)
+        data = mempool.zeros((cap, bm, bn), c.dtype)
         in_bin = (nb[pos_old] == b_id) if n_old else np.zeros(0, bool)
 
         def scatter(sel_mask, factor):
@@ -1272,7 +1292,8 @@ def _rebuild_c(c: BlockSparseMatrix, new_keys: np.ndarray, beta,
             src_bin = old_bins[old_ent_bin[sel[0]]]
             data = _scatter_scaled(
                 data, src_bin.data,
-                jnp.asarray(old_ent_slot[sel]), jnp.asarray(nsl[pos_old[sel]]),
+                mempool.upload_index("rebuild_src", old_ent_slot[sel]),
+                mempool.upload_index("rebuild_dst", nsl[pos_old[sel]]),
                 factor,
             )
 
@@ -1290,7 +1311,8 @@ def _rebuild_c(c: BlockSparseMatrix, new_keys: np.ndarray, beta,
                 src_bin = old_bins[old_ent_bin[sel[0]]]
                 data = _scatter_scaled_window(
                     data, src_bin.data,
-                    jnp.asarray(old_ent_slot[sel]), jnp.asarray(nsl[pos_old[sel]]),
+                    mempool.upload_index("rebuild_src", old_ent_slot[sel]),
+                    mempool.upload_index("rebuild_dst", nsl[pos_old[sel]]),
                     beta_dev,
                     jnp.asarray(rl), jnp.asarray(rh),
                     jnp.asarray(cl), jnp.asarray(ch),
@@ -1477,6 +1499,15 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
     zero_bins = set(range(len(c.bins))) if c_zero else set()
     itemsize = np.dtype(c.dtype).itemsize
     dt_name = str(np.dtype(c.dtype))
+    # drivers that do not donate C (host family) leave the replaced
+    # buffer alive: pool-owned Cs hand it back for the next checkout
+    c_releasable = c._donatable
+
+    def _swap_cbin(cbin, out):
+        old = c.bins[cbin].data
+        c.bins[cbin].data = out
+        if c_releasable and out is not old:
+            mempool.release(old)  # no-op for donated (deleted) buffers
     fused_bins = 0
     i = 0
     n_spans = len(spans_meta)
@@ -1503,7 +1534,7 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
             if sync:
                 jax.block_until_ready(out)
             dt_s = time.perf_counter() - t0
-            c.bins[cbin].data = out
+            _swap_cbin(cbin, out)
             zero_bins.discard(cbin)
             fused_bins += was_fused
             nseg = out.shape[0]
@@ -1538,7 +1569,7 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
             if sync:
                 jax.block_until_ready(out)
             dt_s = time.perf_counter() - t0
-            c.bins[cbin].data = out
+            _swap_cbin(cbin, out)
             zero_bins.discard(cbin)
             stats.record_stack(
                 m, n, k, cnt, driver=plan.driver, seconds=dt_s,

@@ -22,6 +22,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from dbcsr_tpu.core import mempool
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
 from dbcsr_tpu.mm.multiply import multiply
 from dbcsr_tpu.ops.operations import add_on_diag, frobenius_norm, gershgorin_norm, scale
@@ -32,16 +33,26 @@ def invsqrt_step(
     z: BlockSparseMatrix,
     filter_eps: Optional[float] = None,
 ) -> Tuple[BlockSparseMatrix, BlockSparseMatrix]:
-    """One coupled Newton–Schulz step: (Y, Z) -> (Y T, T Z)."""
-    t = BlockSparseMatrix("T", y.row_blk_sizes, y.col_blk_sizes, y.dtype, y.dist)
-    multiply("N", "N", 1.0, z, y, 0.0, t, filter_eps=filter_eps)
-    # T = (3I - Z Y) / 2
-    scale(t, -0.5)
-    add_on_diag(t, 1.5)
-    y2 = BlockSparseMatrix("Y'", y.row_blk_sizes, y.col_blk_sizes, y.dtype, y.dist)
-    multiply("N", "N", 1.0, y, t, 0.0, y2, filter_eps=filter_eps)
-    z2 = BlockSparseMatrix("Z'", z.row_blk_sizes, z.col_blk_sizes, z.dtype, z.dist)
-    multiply("N", "N", 1.0, t, z, 0.0, z2, filter_eps=filter_eps)
+    """One coupled Newton–Schulz step: (Y, Z) -> (Y T, T Z).
+
+    Chain-scoped (core.mempool): T retires to the memory pool once
+    both products consumed it; Y'/Z' escape via ``detach``."""
+    with mempool.chain() as ch:
+        t = BlockSparseMatrix("T", y.row_blk_sizes, y.col_blk_sizes,
+                              y.dtype, y.dist)
+        multiply("N", "N", 1.0, z, y, 0.0, t, filter_eps=filter_eps)
+        # T = (3I - Z Y) / 2
+        scale(t, -0.5)
+        add_on_diag(t, 1.5)
+        y2 = BlockSparseMatrix("Y'", y.row_blk_sizes, y.col_blk_sizes,
+                               y.dtype, y.dist)
+        multiply("N", "N", 1.0, y, t, 0.0, y2, filter_eps=filter_eps)
+        z2 = BlockSparseMatrix("Z'", z.row_blk_sizes, z.col_blk_sizes,
+                               z.dtype, z.dist)
+        multiply("N", "N", 1.0, t, z, 0.0, z2, filter_eps=filter_eps)
+        ch.retire(t)
+        ch.detach(y2)
+        ch.detach(z2)
     return y2, z2
 
 
@@ -69,22 +80,36 @@ def invsqrt_iteration(
     y = desymmetrize(s) if s.matrix_type != NO_SYMMETRY else copy(s, name="Y")
     scale(y, 1.0 / sf)
     z = _identity_like(s)
-    for it in range(max_iter):
-        # residual R = I - Z Y — doubles as the step's T = I + R/2
-        # (T = (3I - Z Y)/2), so each iteration is 3 multiplies total
-        r = BlockSparseMatrix("R", s.row_blk_sizes, s.col_blk_sizes, s.dtype, s.dist)
-        multiply("N", "N", -1.0, z, y, 0.0, r, filter_eps=filter_eps)
-        add_on_diag(r, 1.0)
-        if frobenius_norm(r) < tol:
-            return z, sf, it
-        t = r
-        scale(t, 0.5)
-        add_on_diag(t, 1.0)
-        y2 = BlockSparseMatrix("Y'", s.row_blk_sizes, s.col_blk_sizes, s.dtype, s.dist)
-        multiply("N", "N", 1.0, y, t, 0.0, y2, filter_eps=filter_eps)
-        z2 = BlockSparseMatrix("Z'", s.row_blk_sizes, s.col_blk_sizes, s.dtype, s.dist)
-        multiply("N", "N", 1.0, t, z, 0.0, z2, filter_eps=filter_eps)
-        y, z = y2, z2
+    # one residency chain for the whole coupled iteration: each
+    # replaced iterate and residual returns its bins to the pool; the
+    # converged Z escapes via detach
+    with mempool.chain() as ch:
+        ch.adopt(y)
+        ch.adopt(z)
+        for it in range(max_iter):
+            # residual R = I - Z Y — doubles as the step's T = I + R/2
+            # (T = (3I - Z Y)/2), so each iteration is 3 multiplies total
+            r = BlockSparseMatrix("R", s.row_blk_sizes, s.col_blk_sizes,
+                                  s.dtype, s.dist)
+            multiply("N", "N", -1.0, z, y, 0.0, r, filter_eps=filter_eps)
+            add_on_diag(r, 1.0)
+            if frobenius_norm(r) < tol:
+                ch.detach(z)
+                return z, sf, it
+            t = r
+            scale(t, 0.5)
+            add_on_diag(t, 1.0)
+            y2 = BlockSparseMatrix("Y'", s.row_blk_sizes, s.col_blk_sizes,
+                                   s.dtype, s.dist)
+            multiply("N", "N", 1.0, y, t, 0.0, y2, filter_eps=filter_eps)
+            z2 = BlockSparseMatrix("Z'", s.row_blk_sizes, s.col_blk_sizes,
+                                   s.dtype, s.dist)
+            multiply("N", "N", 1.0, t, z, 0.0, z2, filter_eps=filter_eps)
+            ch.retire(t)
+            ch.retire(y)
+            ch.retire(z)
+            y, z = y2, z2
+        ch.detach(z)
     return z, sf, max_iter
 
 

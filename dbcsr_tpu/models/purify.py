@@ -12,6 +12,7 @@ from typing import Optional
 
 import numpy as np
 
+from dbcsr_tpu.core import mempool
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
 from dbcsr_tpu.mm.multiply import multiply
 from dbcsr_tpu.ops.operations import add, trace
@@ -21,13 +22,27 @@ from dbcsr_tpu.parallel.dist_matrix import DistMatrix, multiply_distributed
 def mcweeny_step(
     p: BlockSparseMatrix, filter_eps: Optional[float] = None
 ) -> BlockSparseMatrix:
-    """One purification step on the single-chip engine; returns P'."""
-    p2 = BlockSparseMatrix("P2", p.row_blk_sizes, p.col_blk_sizes, p.dtype, p.dist)
-    multiply("N", "N", 1.0, p, p, 0.0, p2, filter_eps=filter_eps)
-    p3 = BlockSparseMatrix("P3", p.row_blk_sizes, p.col_blk_sizes, p.dtype, p.dist)
-    multiply("N", "N", 1.0, p2, p, 0.0, p3, filter_eps=filter_eps)
-    # P' = 3 P² - 2 P³
-    return add(p2, p3, 3.0, -2.0)
+    """One purification step on the single-chip engine; returns P'.
+
+    Runs in a device-residency `chain` (core.mempool): P³'s bins and
+    every internal temporary return to the memory pool when the step
+    ends, and the result (P² restructured in place by `add` — a
+    donated elementwise update when patterns align) escapes via
+    ``detach``, so a purification loop recycles the same device
+    buffers iteration after iteration instead of re-allocating and
+    re-staging."""
+    with mempool.chain() as ch:
+        p2 = BlockSparseMatrix("P2", p.row_blk_sizes, p.col_blk_sizes,
+                               p.dtype, p.dist)
+        multiply("N", "N", 1.0, p, p, 0.0, p2, filter_eps=filter_eps)
+        p3 = BlockSparseMatrix("P3", p.row_blk_sizes, p.col_blk_sizes,
+                               p.dtype, p.dist)
+        multiply("N", "N", 1.0, p2, p, 0.0, p3, filter_eps=filter_eps)
+        # P' = 3 P² - 2 P³
+        out = add(p2, p3, 3.0, -2.0)
+        ch.retire(p3)
+        ch.detach(out)
+    return out
 
 
 def mcweeny_purify(
@@ -37,15 +52,26 @@ def mcweeny_purify(
     tol: Optional[float] = None,
 ):
     """Iterate purification; optionally stop when |tr(P) - tr(P²)| < tol
-    (idempotency measure).  Returns (P_final, trace_history)."""
+    (idempotency measure).  Returns (P_final, trace_history).
+
+    The whole loop shares one `chain`: each iterate is retired (its
+    device bins donated back to the pool) the moment its successor
+    exists — the caller's input is never touched, and the final P
+    escapes the chain."""
     history = []
-    for _ in range(steps):
-        p = mcweeny_step(p, filter_eps=filter_eps)
-        history.append(trace(p))
-        if tol is not None and len(history) > 1:
-            if abs(history[-1] - history[-2]) < tol:
-                break
-    return p, history
+    with mempool.chain() as ch:
+        cur = p
+        for _ in range(steps):
+            new = mcweeny_step(cur, filter_eps=filter_eps)
+            if cur is not p:
+                ch.retire(cur)
+            cur = new
+            history.append(trace(cur))
+            if tol is not None and len(history) > 1:
+                if abs(history[-1] - history[-2]) < tol:
+                    break
+        ch.detach(cur)
+    return cur, history
 
 
 def mcweeny_step_distributed(p_a: DistMatrix, p_b: DistMatrix) -> DistMatrix:

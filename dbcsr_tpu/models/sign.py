@@ -12,11 +12,12 @@ from __future__ import annotations
 
 from typing import Optional
 
+from dbcsr_tpu.core import mempool
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
 from dbcsr_tpu.mm.multiply import multiply
 from dbcsr_tpu.ops.operations import (
-    add,
     add_on_diag,
+    added,
     copy,
     frobenius_norm,
     gershgorin_norm,
@@ -27,14 +28,23 @@ from dbcsr_tpu.ops.operations import (
 def sign_step(
     x: BlockSparseMatrix, filter_eps: Optional[float] = None
 ) -> BlockSparseMatrix:
-    """One Newton–Schulz step: X' = X (3I - X²) / 2."""
-    x2 = BlockSparseMatrix("X2", x.row_blk_sizes, x.col_blk_sizes, x.dtype, x.dist)
-    multiply("N", "N", 1.0, x, x, 0.0, x2, filter_eps=filter_eps)
-    # T = 3I - X²  (in place on X²'s storage)
-    scale(x2, -1.0)
-    add_on_diag(x2, 3.0)
-    out = BlockSparseMatrix("X'", x.row_blk_sizes, x.col_blk_sizes, x.dtype, x.dist)
-    multiply("N", "N", 0.5, x, x2, 0.0, out, filter_eps=filter_eps)
+    """One Newton–Schulz step: X' = X (3I - X²) / 2.
+
+    Chain-scoped (core.mempool): X² is retired to the memory pool once
+    the step's second multiply consumed it, so an iteration loop keeps
+    reusing the same device buffers."""
+    with mempool.chain() as ch:
+        x2 = BlockSparseMatrix("X2", x.row_blk_sizes, x.col_blk_sizes,
+                               x.dtype, x.dist)
+        multiply("N", "N", 1.0, x, x, 0.0, x2, filter_eps=filter_eps)
+        # T = 3I - X²  (in place on X²'s storage)
+        scale(x2, -1.0)
+        add_on_diag(x2, 3.0)
+        out = BlockSparseMatrix("X'", x.row_blk_sizes, x.col_blk_sizes,
+                                x.dtype, x.dist)
+        multiply("N", "N", 0.5, x, x2, 0.0, out, filter_eps=filter_eps)
+        ch.retire(x2)
+        ch.detach(out)
     return out
 
 
@@ -55,13 +65,20 @@ def sign_iteration(
     if a.matrix_type != NO_SYMMETRY:
         a = desymmetrize(a)  # iterates mix with plain multiply results
     g = gershgorin_norm(a)
-    x = scale(copy(a, name="X"), 1.0 / g if g > 0 else 1.0)
+    x0 = x = scale(copy(a, name="X"), 1.0 / g if g > 0 else 1.0)
     history = []
-    for _ in range(steps):
-        x_new = sign_step(x, filter_eps=filter_eps)
-        diff = add(copy(x_new), x, 1.0, -1.0)
-        history.append(frobenius_norm(diff))
-        x = x_new
-        if history[-1] < tol:
-            break
+    with mempool.chain() as ch:
+        for _ in range(steps):
+            x_new = sign_step(x, filter_eps=filter_eps)
+            # out-of-place diff: no copy, so neither iterate is ever
+            # marked shared and both keep donating to the pool
+            diff = added(x_new, x, 1.0, -1.0, name="diff")
+            history.append(frobenius_norm(diff))
+            ch.retire(diff)
+            if x is not x0:
+                ch.retire(x)
+            x = x_new
+            if history[-1] < tol:
+                break
+        ch.detach(x)
     return x, history

@@ -19,8 +19,10 @@ prints.
 * ``engine`` — proven numeric corruption (checksum retries classified
   ``deterministic``/``unstable``) is critical; a degraded-to-serial
   world join or an active fallback/recompile storm degrades.
-* ``perf`` — an active roofline-collapse anomaly degrades; the
-  per-driver roofline fractions ride along for inspection.
+* ``perf`` — an active roofline-collapse anomaly degrades, as does
+  memory-pool thrash (budget evictions while checkouts still miss —
+  the pool's byte budget is below the chain's working set); the
+  per-driver roofline fractions and pool counters ride along.
 
 **Anomaly detectors** (rolling windows over the last
 ``DBCSR_TPU_HEALTH_WINDOW`` = 64 multiplies, fed by
@@ -454,8 +456,34 @@ def _eval_perf() -> dict:
         status = DEGRADED
         reasons.append("active roofline collapse: "
                        + ", ".join(str(d) for d in collapsed))
+    pool = {}
+    try:
+        from dbcsr_tpu.core import mempool
+
+        pool = mempool.pool_stats()
+        requests = pool["hits"] + pool["misses"]
+        ev_th = _env_int("DBCSR_TPU_HEALTH_POOL_EVICTIONS", 8)
+        if (pool["enabled"] and pool["evictions"] >= ev_th
+                and requests >= 16
+                and pool["hits"] < 0.5 * requests):
+            # buffers are being dropped at the budget while checkouts
+            # still miss: the byte budget is smaller than the chain's
+            # working set, so the pool churns instead of serving
+            if status == OK:
+                status = DEGRADED
+            reasons.append(
+                f"memory-pool thrash: {int(pool['evictions'])} budget "
+                f"evictions with hit ratio "
+                f"{pool['hits'] / max(1, requests):.2f} — raise "
+                f"DBCSR_TPU_POOL_BYTES (held "
+                f"{pool['bytes_held']}/{pool['budget_bytes']} B)")
+    except Exception:
+        pass
     return {"status": status, "reasons": reasons,
-            "roofline_fraction": fractions}
+            "roofline_fraction": fractions,
+            "pool": {k: pool[k] for k in
+                     ("hits", "misses", "returns", "evictions",
+                      "bytes_held", "high_water") if k in pool}}
 
 
 def verdict() -> dict:

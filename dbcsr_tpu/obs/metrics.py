@@ -181,12 +181,30 @@ def reset(include_stats: bool = True) -> None:
         _gauges.clear()
         _histograms.clear()
         _jit_seen.clear()
+    # mempool caches Counter OBJECTS for its hot-path increments: after
+    # the registry is cleared those objects are orphaned (increments
+    # would vanish from scrapes), so the cache must drop with the
+    # registry — on BOTH include_stats settings
+    try:
+        import sys
+
+        mp = sys.modules.get("dbcsr_tpu.core.mempool")
+        if mp is not None:
+            mp._metric_cache.clear()
+    except Exception:
+        pass
     if include_stats:
         from dbcsr_tpu.core import stats
         from dbcsr_tpu.obs import costmodel
 
         stats.reset()
         costmodel.reset()
+        try:
+            from dbcsr_tpu.core import mempool
+
+            mempool.reset_stats()
+        except Exception:
+            pass  # jax-free contexts (doctor --selftest parses only)
 
 
 def _roofline_rollup() -> dict:
@@ -273,6 +291,13 @@ def snapshot() -> dict:
     # so the snapshot's "gauges" section carries them too
     snap["roofline"] = _roofline_rollup()
     snap["device_kind"] = costmodel.device_kind()
+    try:
+        from dbcsr_tpu.core import mempool
+
+        snap["pool"] = mempool.pool_stats()
+        snap["transfer"] = mempool.transfer_totals()
+    except Exception:
+        pass  # jax-free contexts
     xc = costmodel.xla_costs()
     if xc:
         snap["xla_cost"] = xc

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dbcsr_tpu.core import mempool
 from dbcsr_tpu.core import stats  # noqa: F401  (kept for parity instrumentation)
 from dbcsr_tpu.core.kinds import is_complex, real_dtype_of
 from dbcsr_tpu.core.matrix import (
@@ -67,7 +68,8 @@ def _subset_bins(matrix: BlockSparseMatrix, keep: np.ndarray):
             # set_structure_from_device; skip the dispatch entirely
             continue
         slots = np.sort(ent_slot[mask])  # preserve key order within bin
-        data = _gather_pad(b.data, jnp.asarray(slots), bucket_size(count))
+        data = _gather_pad(b.data, mempool.upload_index("subset", slots),
+                           bucket_size(count))
         bins.append(_Bin(b.shape, data, count))
     return new_keys, bins
 
@@ -233,18 +235,53 @@ def function_of_elements(
 
 
 # ---------------------------------------------------------------- additive
-def add(
-    matrix_a: BlockSparseMatrix,
-    matrix_b: BlockSparseMatrix,
-    alpha_scalar=1.0,
-    beta_scalar=1.0,
-) -> BlockSparseMatrix:
-    """In-place A <- alpha*A + beta*B with pattern union
-    (ref `dbcsr_add`, `dbcsr_operations.F:608`)."""
+@functools.partial(jax.jit, donate_argnums=0)
+def _axpby_donate(da, db, alpha, beta):
+    """Same-pattern add with A's buffer DONATED into the result — the
+    chain-aware in-place update (`P' = 3P² - 2P³` becomes one
+    elementwise pass reusing P²'s device storage).  Pad rows stay zero
+    (alpha*0 + beta*0)."""
+    return alpha * da + beta * db
+
+
+@jax.jit
+def _axpby(da, db, alpha, beta):
+    return alpha * da + beta * db
+
+
+def _add_aligned(a: BlockSparseMatrix, b: BlockSparseMatrix) -> bool:
+    """True when a and b share pattern, dtype, and bin geometry, so
+    `add` reduces to per-bin elementwise axpby (bitwise-identical to
+    the gather/scatter path: same accumulation order, zero pads)."""
+    if a.nblks == 0 or a.nblks != b.nblks:
+        return False
+    if np.dtype(a.dtype) != np.dtype(b.dtype):
+        return False
+    if len(a.bins) != len(b.bins):
+        return False
+    if not np.array_equal(a.keys, b.keys):
+        return False
+    for ba, bb in zip(a.bins, b.bins):
+        if ba.shape != bb.shape or ba.count != bb.count \
+                or ba.data.shape != bb.data.shape:
+            return False
+    return bool(
+        np.array_equal(a.ent_bin, b.ent_bin)
+        and np.array_equal(a.ent_slot, b.ent_slot)
+    )
+
+
+def _add_checks(matrix_a, matrix_b) -> None:
     _require_valid(matrix_a, matrix_b)
     _same_blocking(matrix_a, matrix_b)
     if matrix_a.matrix_type != matrix_b.matrix_type:
         raise ValueError("mixed symmetry add not supported")
+
+
+def _add_union(dest, matrix_a, matrix_b, alpha, beta) -> None:
+    """alpha*A + beta*B on the pattern union, installed into ``dest``
+    (which may BE matrix_a — the in-place `add` — or a fresh matrix —
+    `added`).  Accumulation order is fixed (A's term first)."""
     new_keys = np.union1d(matrix_a.keys, matrix_b.keys)
     rows = (new_keys // matrix_a.nblkcols).astype(np.int64)
     cols = (new_keys % matrix_a.nblkcols).astype(np.int64)
@@ -253,8 +290,6 @@ def add(
     nb, nsl, shapes = _bin_entries(
         matrix_a.row_blk_sizes, matrix_a.col_blk_sizes, rows, cols
     )
-    alpha = jnp.asarray(alpha_scalar, dtype=matrix_a.dtype)
-    beta = jnp.asarray(beta_scalar, dtype=matrix_a.dtype)
     pos_a = np.searchsorted(new_keys, matrix_a.keys)
     pos_b = np.searchsorted(new_keys, matrix_b.keys)
     bins = []
@@ -262,7 +297,7 @@ def add(
         mask = nb == b_id
         count = int(mask.sum())
         cap = bucket_size(count)
-        data = jnp.zeros((cap, bm, bn), matrix_a.dtype)
+        data = mempool.zeros((cap, bm, bn), matrix_a.dtype)
         for src, pos, fac in ((matrix_a, pos_a, alpha), (matrix_b, pos_b, beta)):
             sel = nb[pos] == b_id  # src entries landing in this bin
             if not sel.any():
@@ -271,12 +306,83 @@ def add(
             src_bin = src.ent_bin[src_ent[0]]
             dst_slots = nsl[pos[sel]]
             src_slots = src.ent_slot[src_ent]
-            data = data.at[jnp.asarray(dst_slots)].add(
-                fac * jnp.take(src.bins[src_bin].data, jnp.asarray(src_slots), axis=0)
+            data = data.at[mempool.upload_index("add_dst", dst_slots)].add(
+                fac * jnp.take(src.bins[src_bin].data,
+                               mempool.upload_index("add_src", src_slots),
+                               axis=0)
             )
         bins.append(_Bin((bm, bn), data, count))
-    matrix_a.set_structure_from_device(new_keys, bins, binning=(nb, nsl, shapes))
+    dest.set_structure_from_device(new_keys, bins, binning=(nb, nsl, shapes))
+
+
+def add(
+    matrix_a: BlockSparseMatrix,
+    matrix_b: BlockSparseMatrix,
+    alpha_scalar=1.0,
+    beta_scalar=1.0,
+) -> BlockSparseMatrix:
+    """In-place A <- alpha*A + beta*B with pattern union
+    (ref `dbcsr_add`, `dbcsr_operations.F:608`).
+
+    Same-pattern operands skip the index rebuild entirely: one
+    elementwise axpby per bin, with A's buffer donated when A owns it
+    exclusively (chain-adopted, never shared) — the in-place device
+    update iterative chains live on."""
+    _add_checks(matrix_a, matrix_b)
+    alpha = jnp.asarray(alpha_scalar, dtype=matrix_a.dtype)
+    beta = jnp.asarray(beta_scalar, dtype=matrix_a.dtype)
+    if _add_aligned(matrix_a, matrix_b):
+        donate = (mempool.enabled() and matrix_a is not matrix_b
+                  and matrix_a._donatable)
+        for ba, bb in zip(matrix_a.bins, matrix_b.bins):
+            fn = _axpby_donate if donate and ba.data is not bb.data \
+                else _axpby
+            ba.data = mempool.run_donated(fn, ba.data, bb.data, alpha, beta)
+        matrix_a._bins_shared = False  # fresh outputs: exclusive again
+        matrix_a.invalidate_dense_cache()
+        return matrix_a
+    _add_union(matrix_a, matrix_a, matrix_b, alpha, beta)
     return matrix_a
+
+
+def added(
+    matrix_a: BlockSparseMatrix,
+    matrix_b: BlockSparseMatrix,
+    alpha_scalar=1.0,
+    beta_scalar=1.0,
+    name: Optional[str] = None,
+) -> BlockSparseMatrix:
+    """Out-of-place alpha*A + beta*B into a FRESH matrix, never
+    aliasing either operand — the residency-friendly sibling of `add`
+    for consumers that need both the sum and the operands afterwards
+    (e.g. a chain's convergence diff): no `copy()` is involved, so the
+    operands stay exclusively owned and keep donating to the memory
+    pool.  Bitwise-identical values to ``add(copy(A), B, ...)``."""
+    _add_checks(matrix_a, matrix_b)
+    out = BlockSparseMatrix(
+        name or f"{matrix_a.name}+{matrix_b.name}",
+        matrix_a.row_blk_sizes,
+        matrix_a.col_blk_sizes,
+        matrix_a.dtype,
+        matrix_a.dist,
+        matrix_a.matrix_type,
+    )
+    alpha = jnp.asarray(alpha_scalar, dtype=matrix_a.dtype)
+    beta = jnp.asarray(beta_scalar, dtype=matrix_a.dtype)
+    if _add_aligned(matrix_a, matrix_b):
+        shapes = [b.shape for b in matrix_a.bins]
+        bins = [
+            _Bin(ba.shape, _axpby(ba.data, bb.data, alpha, beta), ba.count)
+            for ba, bb in zip(matrix_a.bins, matrix_b.bins)
+        ]
+        out.set_structure_from_device(
+            matrix_a.keys.copy(), bins,
+            binning=(matrix_a.ent_bin.copy(), matrix_a.ent_slot.copy(),
+                     shapes),
+        )
+        return out
+    _add_union(out, matrix_a, matrix_b, alpha, beta)
+    return out
 
 
 def copy(matrix: BlockSparseMatrix, name: Optional[str] = None) -> BlockSparseMatrix:
@@ -379,12 +485,43 @@ def copy_into_existing(
 def reserve_blocks(matrix: BlockSparseMatrix, rows, cols) -> BlockSparseMatrix:
     """Ensure the listed blocks exist (zero where absent, existing data
     kept) — vectorized (ref `dbcsr_reserve_blocks`,
-    `dbcsr_block_access.F:493`).  Implemented as a summation-of-zeros
-    batch: scatter-add of 0 preserves present blocks and materializes
-    absent ones."""
+    `dbcsr_block_access.F:493`).
+
+    Already-present blocks are filtered out up front, so the steady
+    state of an iterative chain (every block already reserved) is a
+    pure host index check — no staging, no finalize, no host zero
+    blocks.  Missing blocks of a non-symmetric matrix stage as DEVICE
+    zeros (pool-recycled) through `stage_device_blocks`; the symmetric
+    fallback keeps the host `put_blocks` summation-of-zeros path."""
     rows = np.ascontiguousarray(rows, np.int64)
     cols = np.ascontiguousarray(cols, np.int64)
     if len(rows) == 0:
+        return matrix.finalize()
+    if matrix.matrix_type != NO_SYMMETRY:
+        fold = rows > cols
+        rows, cols = np.where(fold, cols, rows), np.where(fold, rows, cols)
+    keys = rows * matrix.nblkcols + cols
+    uniq, first = np.unique(keys, return_index=True)
+    rows, cols = rows[first], cols[first]
+    if matrix.valid and len(matrix.keys):
+        pos = np.minimum(np.searchsorted(matrix.keys, uniq),
+                         len(matrix.keys) - 1)
+        missing = matrix.keys[pos] != uniq
+        if not missing.any():
+            return matrix  # all present: zero work
+        rows, cols = rows[missing], cols[missing]
+    if matrix.matrix_type == NO_SYMMETRY:
+        bm = matrix.row_blk_sizes[rows].astype(np.int64)
+        bn = matrix.col_blk_sizes[cols].astype(np.int64)
+        code = bm << 32 | bn
+        for u in np.unique(code):
+            sel = np.nonzero(code == u)[0]
+            matrix.stage_device_blocks(
+                rows[sel], cols[sel],
+                mempool.zeros((len(sel), int(u >> 32), int(u & 0xFFFFFFFF)),
+                              matrix.dtype),
+                summation=True,
+            )
         return matrix.finalize()
     bm = matrix.row_blk_sizes[rows]
     bn = matrix.col_blk_sizes[cols]
@@ -480,7 +617,7 @@ def trace(matrix: BlockSparseMatrix) -> complex:
         mask = (matrix.ent_bin == b_id) & (rows == cols)
         if not mask.any():
             continue
-        slots = jnp.asarray(matrix.ent_slot[mask])
+        slots = mempool.upload_index("trace", matrix.ent_slot[mask])
         blocks = jnp.take(b.data, slots, axis=0)
         d = min(b.shape)
         total += complex(jnp.sum(jnp.trace(blocks[:, :d, :d], axis1=1, axis2=2)))
@@ -596,49 +733,127 @@ def column_norms(matrix: BlockSparseMatrix) -> np.ndarray:
 
 
 # ----------------------------------------------------------------- diagonal
+@jax.jit
+def _gather_diagonals(data, slots):
+    """(S, d) diagonals of the selected blocks, one device gather."""
+    d = min(data.shape[1], data.shape[2])
+    blocks = jnp.take(data, slots, axis=0)
+    return jnp.diagonal(blocks[:, :d, :d], axis1=1, axis2=2)
+
+
+@jax.jit
+def _set_diagonals(data, slots, vals):
+    """Write (S, d) diagonal values into the selected blocks."""
+    d = vals.shape[1]
+    idx = jnp.arange(d)
+    return data.at[slots[:, None], idx[None, :], idx[None, :]].set(vals)
+
+
+@jax.jit
+def _add_alpha_eye(data, slots, alpha):
+    """Add alpha*I to the selected blocks (square up to min(bm, bn))."""
+    d = min(data.shape[1], data.shape[2])
+    idx = jnp.arange(d)
+    return data.at[slots[:, None], idx[None, :], idx[None, :]].add(
+        jnp.broadcast_to(alpha, (1, d)))
+
+
+def _diag_entries(matrix: BlockSparseMatrix, b_id: int, rows, cols):
+    """(entry indices, slots, block rows) of this bin's diagonal
+    blocks; ``rows``/``cols`` are the caller's one `entry_coords`
+    pass (hoisted so the per-bin loop is O(nblks) once, not per bin)."""
+    sel = np.nonzero((matrix.ent_bin == b_id) & (rows == cols))[0]
+    return sel, matrix.ent_slot[sel], rows[sel]
+
+
 def get_diag(matrix: BlockSparseMatrix) -> np.ndarray:
-    """Diagonal elements (ref `dbcsr_get_diag`)."""
+    """Diagonal elements (ref `dbcsr_get_diag`) — one batched device
+    gather per shape bin instead of a full per-block host fetch."""
     _require_valid(matrix)
     n = min(matrix.nfullrows, matrix.nfullcols)
     out = np.zeros(n, dtype=np.dtype(matrix.dtype))
     row_off = matrix.row_blk_offsets
-    for r, c, blk in matrix.iterate_blocks():
-        if r == c:
+    rows, cols = matrix.entry_coords()
+    for b_id, b in enumerate(matrix.bins):
+        sel, slots, rws = _diag_entries(matrix, b_id, rows, cols)
+        if not len(sel):
+            continue
+        diags = np.asarray(_gather_diagonals(
+            b.data, mempool.upload_index("diag", slots)))
+        mempool.record_d2h(diags.nbytes)
+        d = diags.shape[1]
+        for i, r in enumerate(rws):
             o = row_off[r]
-            d = min(blk.shape)
-            out[o : o + d] = np.diagonal(blk)[:d]
+            out[o : o + d] = diags[i][: max(0, n - o)]
     return out
 
 
 def set_diag(matrix: BlockSparseMatrix, values) -> BlockSparseMatrix:
-    """Set diagonal elements; diagonal blocks must exist
-    (ref `dbcsr_set_diag`)."""
+    """Set diagonal elements of the stored diagonal blocks
+    (ref `dbcsr_set_diag`) — one batched device scatter per shape bin,
+    no host round-trip of the block data.  A diagonal block straddling
+    the short edge of a non-square matrix gets only its in-range
+    prefix written; its tail keeps the stored values."""
     _require_valid(matrix)
     v = np.asarray(values)
+    n = min(matrix.nfullrows, matrix.nfullcols)
     row_off = matrix.row_blk_offsets
-    for r, c, blk in matrix.iterate_blocks():
-        if r == c:
+    rows, cols = matrix.entry_coords()
+    for b_id, b in enumerate(matrix.bins):
+        sel, slots, rws = _diag_entries(matrix, b_id, rows, cols)
+        if not len(sel):
+            continue
+        d = min(b.shape)
+        widths = np.maximum(0, np.minimum(d, n - row_off[rws]))
+        slots_dev = mempool.upload_index("diag", slots)
+        if (widths < d).any():
+            # straddling blocks: keep the out-of-range diagonal tail
+            # (np.array: a writable host copy — np.asarray of a jax
+            # array is a read-only view)
+            vals = np.array(_gather_diagonals(b.data, slots_dev),
+                            dtype=np.dtype(matrix.dtype))
+        else:
+            vals = np.zeros((len(sel), d), np.dtype(matrix.dtype))
+        for i, r in enumerate(rws):
             o = row_off[r]
-            d = min(blk.shape)
-            nb = blk.copy()
-            np.fill_diagonal(nb, v[o : o + d])
-            matrix.put_block(r, c, nb)
-    return matrix.finalize()
+            w = int(widths[i])
+            vals[i, :w] = v[o : o + w]
+        mempool.record_h2d(vals.nbytes)
+        new = _set_diagonals(b.data, slots_dev, jnp.asarray(vals))
+        if matrix._donatable:
+            mempool.release(b.data)  # non-donating jit: old buffer dies here
+        b.data = new
+    matrix.invalidate_dense_cache()
+    return matrix
 
 
 def add_on_diag(matrix: BlockSparseMatrix, alpha) -> BlockSparseMatrix:
     """A <- A + alpha*I, reserving missing diagonal blocks
-    (ref `dbcsr_add_on_diag`)."""
+    (ref `dbcsr_add_on_diag`).  Fully device-side: missing diagonal
+    blocks reserve through the pool-backed fast path (a no-op once the
+    chain's pattern is steady), then one scatter-add of alpha*I per
+    shape bin — the per-block host fetch+put round-trip this op used
+    to pay every chain iteration is gone."""
     _require_valid(matrix)
-    for r in range(min(matrix.nblkrows, matrix.nblkcols)):
+    n = min(matrix.nblkrows, matrix.nblkcols)
+    for r in range(n):
         if matrix.row_blk_sizes[r] != matrix.col_blk_sizes[r]:
             raise ValueError("add_on_diag needs square diagonal blocks")
-        blk = matrix.get_block(r, r)
-        if blk is None:
-            blk = np.zeros((matrix.row_blk_sizes[r],) * 2, matrix.dtype)
-        blk = blk + alpha * np.eye(matrix.row_blk_sizes[r], dtype=matrix.dtype)
-        matrix.put_block(r, r, blk)
-    return matrix.finalize()
+    idx = np.arange(n, dtype=np.int64)
+    reserve_blocks(matrix, idx, idx)
+    a = jnp.asarray(alpha).astype(matrix.dtype)
+    rows, cols = matrix.entry_coords()
+    for b_id, b in enumerate(matrix.bins):
+        sel, slots, _ = _diag_entries(matrix, b_id, rows, cols)
+        if not len(sel):
+            continue
+        new = _add_alpha_eye(
+            b.data, mempool.upload_index("diag", slots), a)
+        if matrix._donatable:
+            mempool.release(b.data)  # non-donating jit: old buffer dies here
+        b.data = new
+    matrix.invalidate_dense_cache()
+    return matrix
 
 
 # ------------------------------------------------------------ triu / crop

@@ -256,17 +256,46 @@ def test_repeated_multiply_reuses_stack_plan():
     assert len(mm._plan_cache) == 2
 
 
-def test_filtered_multiply_not_plan_cached():
-    """filter_eps products depend on values (norms) — never cached."""
+def test_filtered_multiply_plan_cache_contract():
+    """filter_eps products depend on values (norms): under device
+    residency (core.mempool) they cache keyed by a DIGEST of the
+    surviving candidate list — a value change that alters the
+    survivors must miss; with residency off they are never cached
+    (the historical contract)."""
     import dbcsr_tpu.mm.multiply as mm
+    from dbcsr_tpu.core import mempool
 
-    mm._plan_cache.clear()
     rbs = [3, 4]
     a = _rand("a", rbs, rbs, 1.0, seed=74)
     b = _rand("b", rbs, rbs, 1.0, seed=75)
-    c = create("c", rbs, rbs)
-    multiply("N", "N", 1.0, a, b, 0.0, c, filter_eps=1e-8)
-    assert len(mm._plan_cache) == 0
+    was = mempool.enabled()
+    try:
+        mempool.set_enabled(False)
+        mm._plan_cache.clear()
+        c = create("c", rbs, rbs)
+        multiply("N", "N", 1.0, a, b, 0.0, c, filter_eps=1e-8)
+        assert len(mm._plan_cache) == 0
+
+        mempool.set_enabled(True)
+        mm._plan_cache.clear()
+        c = create("c", rbs, rbs)
+        multiply("N", "N", 1.0, a, b, 0.0, c, filter_eps=1e-8)
+        assert len(mm._plan_cache) == 1
+        # same values -> same survivors -> cache HIT (no new entry)
+        c2 = create("c", rbs, rbs)
+        multiply("N", "N", 1.0, a, b, 0.0, c2, filter_eps=1e-8)
+        assert len(mm._plan_cache) == 1
+        # sink one block's norm below the filter so the survivor set
+        # changes: same patterns, different value digest -> new key
+        blk = a.get_block(0, 0)
+        a.put_block(0, 0, np.full_like(blk, 1e-30))
+        a.finalize()
+        c3 = create("c", rbs, rbs)
+        multiply("N", "N", 1.0, a, b, 0.0, c3, filter_eps=1e-8)
+        assert len(mm._plan_cache) == 2
+    finally:
+        mempool.set_enabled(was)
+        mm._plan_cache.clear()
 
 
 def test_dense_mode_matches_sparse_path():

@@ -227,11 +227,12 @@ def run_tier1() -> tuple:
 
 
 def run_bench(extra_env: dict, timeout_s: int, tier,
-              stderr_to: str = None) -> bool:
+              stderr_to: str = None, args: list = None) -> bool:
     env = dict(os.environ, **extra_env)
     env.setdefault("DBCSR_TPU_BENCH_PROBE_TIMEOUT", "240")
     res = _guarded_run(
-        f"tier{tier}_bench", [sys.executable, os.path.join(REPO, "bench.py")],
+        f"tier{tier}_bench",
+        [sys.executable, os.path.join(REPO, "bench.py")] + (args or []),
         timeout_s, capture_output=True, text=True, cwd=REPO, env=env,
     )
     if res.value is None:
@@ -298,6 +299,55 @@ def run_tier25(done: dict) -> None:
         log("tier2.5c: f32 dense-forced A/B vs banked stack run")
         run_bench({"DBCSR_TPU_BENCH_DTYPE": "1",
                    "DBCSR_TPU_MM_DENSE": "1"}, 900, 2.5)
+
+
+def run_chain_tier(done: dict) -> None:
+    """Tier 2.7: the chained-workload A/B (`bench.py --chain`) — a
+    McWeeny purification chain timed with device residency (memory
+    pool + index mirrors) ON vs OFF, checksums asserted bit-identical,
+    per-iteration restage bytes recorded.  The committed row's ``ab``
+    legs are then gated against each other with tools/perf_gate.py
+    (unpooled leg = baseline, pooled leg = candidate) and the verdict
+    logged — the machine check that device residency is a speedup, not
+    a regression, on this device."""
+    if done.get("tier27_chain"):
+        log("tier2.7: chain A/B already captured; skipping")
+        return
+    log("tier2.7: chained-workload A/B (pooled vs unpooled)")
+    if not run_bench({}, 1800, 2.7, args=["--chain"]):
+        return
+    # gate the freshly appended row's legs against each other
+    try:
+        row = None
+        with open(BENCH_CAPTURES) as fh:
+            for line in fh:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if r.get("tier") == 2.7 and r.get("ab"):
+                    row = r
+        if row is None:
+            return
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            basef = os.path.join(td, "unpooled.json")
+            candf = os.path.join(td, "pooled.json")
+            with open(basef, "w") as fh:
+                json.dump(row["ab"]["unpooled"], fh)
+            with open(candf, "w") as fh:
+                json.dump(row["ab"]["pooled"], fh)
+            r = subprocess.run(
+                [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+                 basef, candf],
+                capture_output=True, text=True, timeout=120,
+            )
+        log(f"tier2.7 perf_gate (pooled vs unpooled control): rc={r.returncode}"
+            f" speedup={row.get('speedup_pooled')}"
+            f" bitwise={row.get('checksum_bitwise_match')}")
+    except Exception as exc:  # the capture row is already banked
+        log(f"tier2.7 gate step failed: {exc}")
 
 
 def _rerun_tier3_on_new_evidence() -> None:
@@ -517,6 +567,8 @@ def _artifacts_done() -> dict:
                         done["tier25_profile"] = True
                     if env25.get("DBCSR_TPU_MM_DENSE") == "1":
                         done["tier25_f32dense"] = True
+                if r.get("tier") == 2.7 and r.get("ab"):
+                    done["tier27_chain"] = True
                 if r.get("tier") == 3:
                     dt = (r.get("env") or {}).get("DBCSR_TPU_BENCH_DTYPE",
                                                   "3")
@@ -608,6 +660,8 @@ def _attempt_tiers(st: dict) -> dict:
         ok3 = run_bench({}, 1800, 3)
     if ok3 and not _past_deadline():
         run_tier25(done)
+    if ok3 and not _past_deadline():
+        run_chain_tier(done)
     if ok3 and not done["tier3_f32"] and not _past_deadline():
         run_bench({"DBCSR_TPU_BENCH_DTYPE": "1"}, 1800, 3)
     st["tier3"] = ok3

@@ -61,6 +61,12 @@ def corpus():
         ("complex", dict(bs=[4] * 5, dtype=np.complex128, occ=0.5)),
         ("beta_accumulate", dict(bs=[5] * 6, dtype=np.float64, occ=0.5,
                                  alpha=2.0, beta=0.5)),
+        # chained case: a short McWeeny purification inside a device-
+        # residency chain (core.mempool) — faults that fire mid-chain
+        # must not corrupt pool-donated buffers (the PR-4 decompose
+        # caveat extended to recycled device storage)
+        ("mcweeny_chain", dict(bs=[4] * 6, dtype=np.float64, occ=0.4,
+                               chain_steps=3)),
     ]
 
 
@@ -102,6 +108,21 @@ def _one_product(entry: dict, seed: int):
     from dbcsr_tpu.mm.multiply import multiply
     from dbcsr_tpu.ops.test_methods import checksum, make_random_matrix
 
+    if entry.get("chain_steps"):
+        from dbcsr_tpu.core import mempool
+        from dbcsr_tpu.models.purify import make_test_density, mcweeny_step
+
+        p = make_test_density(len(entry["bs"]), int(entry["bs"][0]),
+                              occ=entry["occ"], seed=seed)
+        with mempool.chain() as ch:
+            cur = p
+            for _ in range(int(entry["chain_steps"])):
+                new = mcweeny_step(cur, filter_eps=1e-10)
+                if cur is not p:
+                    ch.retire(cur)
+                cur = new
+            ch.detach(cur)
+        return checksum(cur)
     rng = np.random.default_rng(seed)
     bs = entry["bs"]
     dt = entry["dtype"]

@@ -232,6 +232,25 @@ def analyze(health: dict | None, prom: dict, events: list,
                 roofline[key] = round(float(frac), 5)
     report["roofline"] = roofline
 
+    # memory pool: live counters/gauge (prometheus), else the health
+    # verdict's perf-component pool block
+    pool = {}
+    for kind in ("hits", "misses", "returns", "evictions"):
+        vals = prom.get(f"dbcsr_tpu_pool_{kind}_total")
+        if vals:
+            pool[kind] = int(sum(v for _, v in vals))
+    held = prom.get("dbcsr_tpu_pool_bytes_held")
+    if held:
+        pool["bytes_held"] = int(held[-1][1])
+    for kind in ("h2d", "d2h"):
+        vals = prom.get(f"dbcsr_tpu_{kind}_bytes_total")
+        if vals:
+            pool[f"{kind}_bytes"] = int(sum(v for _, v in vals))
+    if not pool and health:
+        pool = ((health.get("components") or {}).get("perf") or {}) \
+            .get("pool") or {}
+    report["pool"] = pool
+
     # anomalies: live health verdict first, else anomaly events
     anomalies: dict = collections.Counter()
     if health:
@@ -317,6 +336,16 @@ def render(report: dict, out=print) -> None:
         out(" roofline fraction per driver:")
         for drv, frac in sorted(report["roofline"].items()):
             out(f"   {drv:<40} {frac}")
+    if report.get("pool"):
+        p = report["pool"]
+        parts = [f"{k}={p[k]}" for k in
+                 ("hits", "misses", "returns", "evictions") if k in p]
+        if "bytes_held" in p:
+            parts.append(f"held={p['bytes_held'] / 1e6:.1f}MB")
+        for k in ("h2d_bytes", "d2h_bytes"):
+            if k in p:
+                parts.append(f"{k.split('_')[0]}={p[k] / 1e6:.1f}MB")
+        out(" memory pool: " + ", ".join(parts))
     if report.get("anomalies"):
         out(" anomalies: " + ", ".join(
             f"{k}={v}" for k, v in sorted(report["anomalies"].items())))
