@@ -52,6 +52,16 @@ class Config:
     # (same, explicit), or "per_span" (the historical one-dispatch-
     # per-span engine).  Env: DBCSR_TPU_SUPERSTACK.
     superstack: str = "auto"
+    # distributed Cannon tick scheduling (parallel/cannon.py +
+    # parallel/sparse_dist.py): "double_buffer" issues tick k+1's A/B
+    # ring shifts against a second operand buffer BEFORE tick k's
+    # contraction is consumed (per-tick dispatches; the comm-thread
+    # overlap of the reference's async isend/irecv panel exchange,
+    # dbcsr_mpiwrap.F:305-421), "serial" is the bitwise-reference
+    # single-program shift-after-compute path, "auto" double-buffers
+    # whenever the grid actually ring-shifts (s > 1 square Cannon).
+    # Env: DBCSR_TPU_CANNON_OVERLAP.
+    cannon_overlap: str = "auto"
     # keep per-(m,n,k) flop statistics (ref STATISTICS block)
     keep_stats: bool = True
     # largest block dim the fused Pallas kernel handles; bigger blocks
@@ -90,6 +100,10 @@ class Config:
             raise ValueError(
                 f"superstack must be 'auto'/'fused'/'per_span', "
                 f"got {self.superstack!r}")
+        if self.cannon_overlap not in ("auto", "double_buffer", "serial"):
+            raise ValueError(
+                f"cannon_overlap must be 'auto'/'double_buffer'/'serial', "
+                f"got {self.cannon_overlap!r}")
         if self.mm_stack_size <= 0:
             raise ValueError("mm_stack_size must be positive")
         if self.max_kernel_dim <= 0:

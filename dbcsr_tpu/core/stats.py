@@ -171,6 +171,56 @@ def record_multiply(marketing_flops: int) -> None:
     _totals["marketing_flops"] += marketing_flops
 
 
+# Cannon tick-loop overlap attribution, per (engine, grid): the MODELED
+# comm/compute ratio (obs.costmodel.cannon_tick_model /
+# mesh_tick_model) next to the MEASURED comm-exposed fraction the
+# per-tick driver times under DBCSR_TPU_SYNC_TIMING
+# (parallel/overlap.py).  metrics.snapshot()["roofline"] folds this
+# into the owning driver's rollup row.
+_cannon_overlap: dict = {}
+
+
+def record_cannon_overlap(engine: str, grid: str, *, mode: str | None = None,
+                          modeled: float | None = None,
+                          measured: float | None = None,
+                          shift_exposed_s: float | None = None,
+                          compute_s: float | None = None,
+                          drop_measured: bool = False) -> None:
+    """Merge one multiply's overlap attribution (modeled ratio and/or
+    measured exposed fraction) for an (engine, grid) cell; latest
+    values win — this is a point-in-time gauge, not an accumulator.
+    ``drop_measured`` clears any earlier measured sample from the cell
+    (the degrade path: a serial-delivered product must not keep a
+    previous double-buffer run's numbers attached to its mode)."""
+    from dbcsr_tpu.core.config import get_config
+
+    if not get_config().keep_stats:
+        return
+    row = _cannon_overlap.setdefault((engine, grid), {})
+    if drop_measured:
+        for k in ("measured_exposed", "shift_exposed_s", "compute_s"):
+            row.pop(k, None)
+    if mode is not None:
+        row["mode"] = mode
+    if modeled is not None:
+        row["modeled_ratio"] = float(modeled)
+    if measured is not None:
+        row["measured_exposed"] = float(measured)
+    if shift_exposed_s is not None:
+        row["shift_exposed_s"] = float(shift_exposed_s)
+    if compute_s is not None:
+        row["compute_s"] = float(compute_s)
+
+
+def cannon_overlap_rollup() -> dict:
+    """{engine: {grid: {mode, modeled_ratio, measured_exposed, ...}}}
+    since the last `reset()`."""
+    out: dict = {}
+    for (engine, grid), row in _cannon_overlap.items():
+        out.setdefault(engine, {})[grid] = dict(row)
+    return out
+
+
 # memory high-water meter (analog of `m_memory`, `dbcsr_machine.F`, and
 # the `max_memory` line `dbcsr_lib.F:326` prints): host side reads the
 # OS-tracked process peak (VmHWM) and current RSS; device side polls the
@@ -243,6 +293,7 @@ def reset() -> None:
     _by_mnk.clear()
     _comm.clear()
     _driver_agg.clear()
+    _cannon_overlap.clear()
     for k in _totals:
         _totals[k] = 0
     for k in _memory:

@@ -229,19 +229,13 @@ def roofline(flops: float, nbytes: float, seconds: float,
     }
 
 
-def cannon_tick_model(m: int, n: int, k: int, kl: int, s: int,
-                      itemsize: int, dtype: str,
-                      kind: str | None = None) -> dict:
-    """Per-device, per-tick comm/compute balance of the dense Cannon:
-    each metronome tick contracts a local (m/s, k/(kl*s)) x
-    (k/(kl*s), n/s) panel while ring-shifting both operand shards over
-    ICI.  ``overlap_ratio`` = modeled comm time / compute time — below
-    1.0 the collective hides fully behind the local dot (the comm-
-    thread overlap the reference gets from USE_COMM_THREAD)."""
+def _tick_balance(flops: float, comm_bytes: float, dtype: str,
+                  kind: str | None) -> dict:
+    """Comm/compute balance of one metronome tick against the roofline
+    peaks: ``overlap_ratio`` = modeled comm time / compute time — below
+    1.0 the collective hides fully behind the local contraction (the
+    comm-thread overlap the reference gets from USE_COMM_THREAD)."""
     kind = kind or device_kind()
-    m_loc, n_loc, k_loc = m / s, n / s, k / (kl * s)
-    flops = 2.0 * m_loc * n_loc * k_loc
-    comm_bytes = (m_loc * k_loc + k_loc * n_loc) * itemsize
     peak = peak_gflops(kind, dtype) * 1e9
     ici = peaks_for(kind)["ici_gbs"] * 1e9
     t_comp = flops / peak if peak else 0.0
@@ -253,6 +247,34 @@ def cannon_tick_model(m: int, n: int, k: int, kl: int, s: int,
         "t_comm_s": t_comm,
         "overlap_ratio": (t_comm / t_comp) if t_comp > 0 else 0.0,
     }
+
+
+def cannon_tick_model(m: int, n: int, k: int, kl: int, s: int,
+                      itemsize: int, dtype: str,
+                      kind: str | None = None) -> dict:
+    """Per-device, per-tick comm/compute balance of the dense Cannon:
+    each metronome tick contracts a local (m/s, k/(kl*s)) x
+    (k/(kl*s), n/s) panel while ring-shifting both operand shards over
+    ICI."""
+    m_loc, n_loc, k_loc = m / s, n / s, k / (kl * s)
+    flops = 2.0 * m_loc * n_loc * k_loc
+    comm_bytes = (m_loc * k_loc + k_loc * n_loc) * itemsize
+    return _tick_balance(flops, comm_bytes, dtype, kind)
+
+
+def mesh_tick_model(cap_a: int, cap_b: int, bm: int, bk: int, bn: int,
+                    entries: int, nticks: int, ndev: int,
+                    itemsize: int, dtype: str,
+                    kind: str | None = None) -> dict:
+    """Per-device, per-tick comm/compute balance of the block-sparse
+    mesh Cannon: each tick ring-shifts a full padded A panel
+    (``cap_a`` blocks of (bm, bk)) and B panel (``cap_b`` of (bk, bn))
+    while contracting this tick's share of the symbolic product's
+    ``entries`` (true flops split evenly over devices x ticks — the
+    stack fill balances by construction)."""
+    flops = 2.0 * bm * bn * bk * entries / max(ndev * nticks, 1)
+    comm_bytes = (cap_a * bm * bk + cap_b * bk * bn) * itemsize
+    return _tick_balance(flops, comm_bytes, dtype, kind)
 
 
 # ------------------------------------------------------- XLA cross-check

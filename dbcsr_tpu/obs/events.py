@@ -155,6 +155,30 @@ def end_product(rec: dict | None = None, error: str | None = None,
             _product_stack.pop()
 
 
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def product_scope(op: str, name: str, **flight_fields):
+    """One correlation scope around a multiply-like operation: opens a
+    product id + flight record, commits/closes them on exit, and on
+    error stamps both with the formatted exception before re-raising.
+    Used by the distributed engines (`parallel/sparse_dist.py`);
+    `mm.multiply` keeps its bespoke scope (it notes flops/algorithm on
+    the record between body and commit)."""
+    pid = begin_product(op=op, name=name)
+    _flight.begin(op=op, product_id=pid, **flight_fields)
+    try:
+        yield pid
+    except Exception as exc:
+        err = f"{type(exc).__name__}: {exc}"[:300]
+        rec = _flight.commit(error=err)
+        end_product(rec=rec, error=err)
+        raise
+    rec = _flight.commit()
+    end_product(rec=rec)
+
+
 # ------------------------------------------------------------- publish
 
 def publish(kind: str, args: dict | None = None, *, instant: bool = True,

@@ -241,6 +241,15 @@ def _roofline_rollup() -> dict:
         gauge("dbcsr_tpu_arithmetic_intensity",
               "modeled flops per HBM byte per driver").set(
             rl["arithmetic_intensity"], driver=driver)
+    # Cannon tick-loop overlap attribution rides on the owning driver's
+    # row (engine "mesh" -> driver "mesh", engine "dense" -> "dense"):
+    # per grid, the MODELED comm/compute ratio next to the MEASURED
+    # comm-exposed fraction (parallel/overlap.py, DBCSR_TPU_SYNC_TIMING).
+    # A standalone dense Cannon (cannon_multiply_dense called directly,
+    # no record_stack row) still surfaces: its attribution lands in a
+    # cannon_overlap-only row rather than being dropped.
+    for engine, grids in stats.cannon_overlap_rollup().items():
+        out.setdefault(engine, {})["cannon_overlap"] = grids
     return out
 
 

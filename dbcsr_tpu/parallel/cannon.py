@@ -26,10 +26,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dbcsr_tpu.core import stats
+from dbcsr_tpu.parallel import overlap as _overlap
+from dbcsr_tpu.parallel.overlap import _HashableMesh
 from dbcsr_tpu.utils.compat import shard_map as _shard_map
 from dbcsr_tpu.core.timings import timed
 from dbcsr_tpu.obs import costmodel as _costmodel
-from dbcsr_tpu.obs import metrics as _metrics
 from dbcsr_tpu.obs import tracer as _trace
 
 
@@ -54,8 +55,12 @@ def mark_varying(x, axes):
     return _mark_varying(x, axes)
 
 
+@functools.lru_cache(maxsize=None)
 def _skew_perm(s: int, kind: str):
-    """Static (src, dst) pairs over the flattened ('pr','pc') axis."""
+    """Static (src, dst) pairs over the flattened ('pr','pc') axis.
+    Cached per (s, kind): tick bodies and the split per-tick programs
+    reference these tables on every trace — build the Python tuples
+    once instead of once per trace."""
     pairs = []
     for i in range(s):
         for j in range(s):
@@ -84,6 +89,9 @@ def _local_cannon(a_loc, b_loc, s: int, acc_dtype):
     # mark the accumulator as device-varying so the fori_loop carry type
     # matches after the varying a@b lands in it
     c_loc = mark_varying(c_loc, ("kl", "pr", "pc"))
+    # permutation tables hoisted out of the traced tick body
+    shift_a = _skew_perm(s, "shift_a")
+    shift_b = _skew_perm(s, "shift_b")
 
     def tick(t, carry):
         a, b, c = carry
@@ -93,14 +101,131 @@ def _local_cannon(a_loc, b_loc, s: int, acc_dtype):
             preferred_element_type=acc_dtype,
         )
         if s > 1:
-            a = jax.lax.ppermute(a, axes, _skew_perm(s, "shift_a"))
-            b = jax.lax.ppermute(b, axes, _skew_perm(s, "shift_b"))
+            a = jax.lax.ppermute(a, axes, shift_a)
+            b = jax.lax.ppermute(b, axes, shift_b)
         return a, b, c
 
     _, _, c_loc = jax.lax.fori_loop(0, s, tick, (a_loc, b_loc, c_loc))
     # 2.5D layer reduction (ref dbcsr_mm_3d.F:1037)
     c_loc = jax.lax.psum(c_loc, "kl")
     return c_loc
+
+
+# ------------------------------------------------------------------
+# Split per-tick programs: the double-buffered metronome
+# (parallel/overlap.py) dispatches these independently so the ring
+# shift feeding tick k+1 runs concurrently with tick k's local dot —
+# per-tick op order matches `_local_cannon` exactly (bitwise identity).
+# ------------------------------------------------------------------
+
+_SPEC_A = P("pr", ("kl", "pc"))
+_SPEC_B = P(("kl", "pr"), "pc")
+_SPEC_C3 = P("kl", "pr", "pc")  # (kl, M, N): per-layer partial C
+
+
+@functools.partial(jax.jit, static_argnames=("s", "mesh_ref", "kind_a",
+                                             "kind_b"))
+def _dense_permute(a, b, *, s, mesh_ref, kind_a, kind_b):
+    """One A/B panel permutation (the skew, or one ring shift) as its
+    own SPMD program."""
+    def body(a_loc, b_loc):
+        axes = ("pr", "pc")
+        return (jax.lax.ppermute(a_loc, axes, _skew_perm(s, kind_a)),
+                jax.lax.ppermute(b_loc, axes, _skew_perm(s, kind_b)))
+
+    return _shard_map(
+        body, mesh=mesh_ref.val,
+        in_specs=(_SPEC_A, _SPEC_B), out_specs=(_SPEC_A, _SPEC_B),
+    )(a, b)
+
+
+@functools.partial(jax.jit, static_argnames=("acc_name", "mesh_ref"))
+def _dense_tick(a, b, c3, *, acc_name, mesh_ref):
+    """One metronome tick's local contraction: c += a @ b per device."""
+    acc_dtype = jnp.dtype(acc_name)
+
+    def body(a_loc, b_loc, c_loc):
+        c = c_loc.reshape(c_loc.shape[1:])
+        c = c + jax.lax.dot_general(
+            a_loc, b_loc, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=acc_dtype,
+        )
+        return c.reshape((1,) + c.shape)
+
+    return _shard_map(
+        body, mesh=mesh_ref.val,
+        in_specs=(_SPEC_A, _SPEC_B, _SPEC_C3), out_specs=_SPEC_C3,
+    )(a, b, c3)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh_ref",))
+def _dense_finish(c3, *, mesh_ref):
+    """2.5D layer reduction (ref dbcsr_mm_3d.F:1037) of the per-layer
+    partial C accumulators."""
+    def body(c_loc):
+        return jax.lax.psum(c_loc.reshape(c_loc.shape[1:]), "kl")
+
+    return _shard_map(
+        body, mesh=mesh_ref.val, in_specs=_SPEC_C3, out_specs=P("pr", "pc"),
+    )(c3)
+
+
+@functools.lru_cache(maxsize=64)
+def _fused_cannon_program(mesh_ref, s: int, acc_name: str):
+    """Cached jitted fused serial Cannon (the historical single-program
+    path): a fresh `jax.jit(shard_map(partial(...)))` per call would
+    retrace/recompile every multiply — on the exact path that serves as
+    the cheap bitwise-reference fallback."""
+    return jax.jit(
+        _shard_map(
+            functools.partial(_local_cannon, s=s,
+                              acc_dtype=jnp.dtype(acc_name)),
+            mesh=mesh_ref.val,
+            in_specs=(_SPEC_A, _SPEC_B),
+            out_specs=P("pr", "pc"),
+        )
+    )
+
+
+def _cannon_dense_ticks(mesh, a, b, kl, s, acc_dtype, mode, measure,
+                        timings):
+    """The host-driven tick loop behind the double-buffered (and
+    measured-serial) dense Cannon; returns C in the accumulator dtype,
+    bitwise identical to the fused `_local_cannon` program.  Appends
+    the measured (shift_exposed_s, compute_s) split to ``timings`` —
+    published by the caller only when the pipeline delivered the
+    result (overlap.run_split_pipeline)."""
+    from dbcsr_tpu.acc.smm import record_dispatch
+
+    mref = _HashableMesh(mesh)
+    acc_name = jnp.dtype(acc_dtype).name
+    m, n = a.shape[0], b.shape[1]
+    a, b = _dense_permute(a, b, s=s, mesh_ref=mref,
+                          kind_a="skew_a", kind_b="skew_b")
+    record_dispatch(_overlap.DRIVER)  # the skew program
+    c3 = _overlap.zeros_program(mref, (kl, m, n), acc_name, _SPEC_C3)()
+    record_dispatch(_overlap.DRIVER)  # the zeros program
+
+    def shift(aa, bb):
+        return _dense_permute(aa, bb, s=s, mesh_ref=mref,
+                              kind_a="shift_a", kind_b="shift_b")
+
+    def tick(aa, bb, cc, t):
+        return _dense_tick(aa, bb, cc, acc_name=acc_name, mesh_ref=mref)
+
+    c3, shift_s, comp_s = _overlap.run_ticks(
+        s, a, b, c3, shift, tick, mode=mode, engine="dense",
+        measure=measure,
+    )
+    # tick/shift dispatches were counted as issued (run_ticks — so a
+    # mid-pipeline failure still shows the round-trips it really
+    # paid); the finish program books its own below
+    if measure:
+        timings.append((shift_s, comp_s))
+    res = _dense_finish(c3, mesh_ref=mref)
+    record_dispatch(_overlap.DRIVER)
+    return res
 
 
 def cannon_multiply_dense(mesh: Mesh, a, b, acc_dtype=None):
@@ -146,42 +271,38 @@ def cannon_multiply_dense(mesh: Mesh, a, b, acc_dtype=None):
             # of the kl-1 steps moves every (pr,pc) position's C panel
             stats.record_comm("psum", (kl - 1) * s * s,
                               (kl - 1) * m * n * itemsize)
+        grid = f"{kl}x{s}x{s}"
         if s > 1:
             # comm/compute overlap attribution per metronome tick: the
-            # ring ppermute is scheduled concurrently with the local
-            # dot, so the modeled ratio says whether the collective is
-            # fully hideable on this grid/shape (the USE_COMM_THREAD
-            # question, answered from the static comm pattern + the
-            # roofline peaks instead of host threads)
+            # MODELED ratio says whether the collective is hideable on
+            # this grid/shape from the static comm pattern + roofline
+            # peaks (the USE_COMM_THREAD question); the double-buffered
+            # path below additionally MEASURES it under
+            # DBCSR_TPU_SYNC_TIMING (parallel/overlap.py)
             tick = _costmodel.cannon_tick_model(
                 m, n, k, kl, s, itemsize, jnp.dtype(a.dtype).name)
-            grid = f"{kl}x{s}x{s}"
-            _metrics.gauge(
-                "dbcsr_tpu_cannon_overlap_ratio",
-                "modeled comm-time / compute-time per Cannon tick "
-                "(<1 = the ring shift hides behind the local dot)",
-            ).set(tick["overlap_ratio"], grid=grid)
-            _metrics.gauge(
-                "dbcsr_tpu_cannon_tick_comm_bytes",
-                "per-device operand bytes ring-shifted per Cannon tick",
-            ).set(tick["tick_comm_bytes"], grid=grid)
-            _metrics.gauge(
-                "dbcsr_tpu_cannon_tick_flops",
-                "per-device flops contracted per Cannon tick",
-            ).set(tick["tick_flops"], grid=grid)
-            _trace.annotate(
-                cannon_overlap_ratio=round(tick["overlap_ratio"], 4),
-                tick_comm_bytes=tick["tick_comm_bytes"],
-                tick_flops=tick["tick_flops"],
+            _overlap.publish_modeled("dense", grid, tick)
+        acc = acc_dtype or a.dtype
+        mode, why = _overlap.resolve_mode("dense", grid, s)
+        _overlap.publish_decision("dense", grid, mode, why)
+        mref = _HashableMesh(mesh)
+
+        def serial_fn():
+            return _fused_cannon_program(
+                mref, s, jnp.dtype(acc).name)(a, b)
+
+        measure = s > 1 and _overlap.measuring()
+        if _overlap.use_split_pipeline(mode, why, measure):
+            # double-buffered ticks, or the measured serial reference
+            # (same per-tick op sequence, dispatched region by region
+            # so the shift/compute split is observable — the
+            # DBCSR_TPU_SYNC_TIMING seam); both bitwise identical to
+            # the fused program and guarded: an open cannon_db breaker
+            # or a split-pipeline failure falls back to serial_fn
+            return _overlap.run_split_pipeline(
+                "dense", grid, mode,
+                lambda timings: _cannon_dense_ticks(
+                    mesh, a, b, kl, s, acc, mode, measure, timings),
+                serial_fn, measure,
             )
-        fn = jax.jit(
-            _shard_map(
-                functools.partial(
-                    _local_cannon, s=s, acc_dtype=acc_dtype or a.dtype
-                ),
-                mesh=mesh,
-                in_specs=(P("pr", ("kl", "pc")), P(("kl", "pr"), "pc")),
-                out_specs=P("pr", "pc"),
-            )
-        )
-        return fn(a, b)
+        return serial_fn()

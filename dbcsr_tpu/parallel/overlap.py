@@ -1,0 +1,381 @@
+"""Double-buffered Cannon tick execution: overlap ring-shift
+communication with tick compute, and measure it.
+
+The reference hides its Cannon panel exchange behind the local block
+multiplies via async MPI (``mp_isend``/``mp_irecv`` double-buffered
+calc/comm sets, `dbcsr_mm_cannon.F:2977`, `dbcsr_mpiwrap.F:305-421`).
+Both TPU-native engines historically executed shift-then-compute
+strictly serially inside ONE fused SPMD program — correct, but the
+collective and the contraction were a single serialized stream.  This
+module is the shared metronome driver that makes the overlap real:
+
+* **double_buffer** — the tick loop runs at host level, one dispatch
+  per region: tick k+1's A/B ring shifts are dispatched *first*,
+  against a second operand buffer, then tick k's contraction is
+  dispatched.  The two programs share no data dependence, so the
+  runtime executes the collective concurrently with the batched
+  matmul (verified to overlap on the async PJRT CPU client as well as
+  on TPU ICI).  Per-tick op order is unchanged, so results are
+  **bitwise identical** to the serial path.  Memory cost: one extra
+  A+B panel per device (the second buffer).
+* **serial** — today's bitwise-reference path: the single fused
+  program with compute-then-shift ticks.  Under
+  ``DBCSR_TPU_SYNC_TIMING=1`` the serial leg also runs tick-by-tick
+  (same op order, blocking between sub-regions) so its shift/compute
+  split is measurable — that is the measurement seam, not a third
+  algorithm.
+* **auto** — double_buffer whenever the grid actually ring-shifts
+  (square Cannon, s > 1); serial elsewhere (the all-gather engine's
+  communication is one up-front collective — nothing to pipeline).
+
+Measurement: with ``DBCSR_TPU_SYNC_TIMING=1`` the driver times the
+*exposed* shift wait (how long the next tick blocked on a shift that
+compute did not hide) and the compute region, publishing a measured
+``dbcsr_tpu_cannon_overlap_measured{grid,engine,mode}`` gauge — the
+comm-exposed fraction, 0.0 = fully hidden — next to the *modeled*
+``dbcsr_tpu_cannon_overlap_ratio`` the cost model predicts, and
+rolling both into ``core.stats``/``metrics.snapshot()["roofline"]``.
+
+Resilience: the per-tick dispatch edge is a real host-level boundary,
+so it is a fault-injection site (``mesh_shift``) and breaker-guarded:
+any double-buffer failure records against the ``cannon_db`` pseudo-
+driver keyed by (engine, grid) and the multiply re-runs on the serial
+fused program from the pristine operands — bitwise identical, so an
+overlap failure is invisible in the product.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+from dbcsr_tpu.core import stats
+from dbcsr_tpu.obs import events as _events
+from dbcsr_tpu.obs import flight as _flight
+from dbcsr_tpu.obs import metrics as _metrics
+from dbcsr_tpu.obs import tracer as _trace
+from dbcsr_tpu.resilience import faults as _faults
+
+# breaker pseudo-driver of the double-buffered tick pipeline, keyed by
+# (engine, grid): its failures route the multiply back to the serial
+# fused program (where nothing is pipelined), never condemn the mesh/
+# dense drivers themselves — the FUSED_DRIVER convention of acc/smm
+DRIVER = "cannon_db"
+
+MEASURED_GAUGE = "dbcsr_tpu_cannon_overlap_measured"
+_MEASURED_HELP = (
+    "measured comm-exposed fraction of a distributed multiply's tick "
+    "loop (shift wait not hidden behind compute / total tick seconds; "
+    "0 = the ring shift fully overlaps the contraction)")
+
+
+class _HashableMesh:
+    """Static jit argument wrapper, keyed by mesh structure (axis
+    names/sizes + device ids) so recreating an identical mesh reuses the
+    compiled program and a recycled object id can never alias."""
+
+    def __init__(self, mesh):
+        self.val = mesh
+        self._key = (
+            tuple(mesh.axis_names),
+            tuple(int(x) for x in np.asarray(mesh.devices.shape)),
+            tuple(d.id for d in mesh.devices.flat),
+        )
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, _HashableMesh) and other._key == self._key
+
+
+@functools.lru_cache(maxsize=64)
+def zeros_program(mesh_ref: _HashableMesh, shape: tuple, dtype_name: str,
+                  spec) -> object:
+    """Cached jitted zeros constructor placing a partial-C accumulator
+    directly at its sharding (no host staging, no reshard copy)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    return jax.jit(
+        lambda: jnp.zeros(shape, jnp.dtype(dtype_name)),
+        out_shardings=NamedSharding(mesh_ref.val, spec),
+    )
+
+
+def resolve_mode(engine: str, grid: str, s: int,
+                 nticks: int | None = None) -> tuple:
+    """(mode, why) for one distributed multiply.
+
+    ``mode`` is "double_buffer" or "serial"; ``why`` says who decided
+    (config force, auto policy, grid shape, breaker state) — recorded
+    on the flight record and the trace span by `publish_decision`."""
+    from dbcsr_tpu.core.config import get_config
+
+    knob = get_config().cannon_overlap
+    if s <= 1 or (nticks is not None and nticks <= 1):
+        return "serial", "no-ring-shifts"
+    if knob == "serial":
+        return "serial", "config"
+    # allow() claims the half-open trial admission; the caller MUST
+    # then run the double-buffered attempt through `guarded`, whose
+    # record_success/record_failure resolves the trial (the
+    # execute_stack convention — never probe-and-walk-away)
+    from dbcsr_tpu.resilience import breaker as _breaker
+
+    if not _breaker.get_board().allow(DRIVER, (engine, grid)):
+        return "serial", "breaker-open"
+    return "double_buffer", ("config" if knob == "double_buffer" else "auto")
+
+
+def measuring() -> bool:
+    """True when sub-region (shift vs compute) timing is requested —
+    the ``DBCSR_TPU_SYNC_TIMING`` seam (`stats.sync_timing_enabled`)."""
+    return stats.sync_timing_enabled()
+
+
+def use_split_pipeline(mode: str, why: str, measure: bool) -> bool:
+    """Should this multiply run the split per-tick pipeline?  Yes for
+    double-buffered ticks, and for the measured serial reference leg —
+    unless the breaker already condemned the split programs
+    (``why == "breaker-open"`` forces the fused program, skipping
+    measurement).  The ONE admission policy both engines share."""
+    return mode == "double_buffer" or (measure and why != "breaker-open")
+
+
+def run_ticks(nticks: int, a, b, c, shift_fn, tick_fn, *,
+              mode: str, engine: str, measure: bool = False):
+    """Drive the Cannon metronome tick-by-tick at host level.
+
+    ``tick_fn(a, b, c, t) -> c`` dispatches tick t's contraction;
+    ``shift_fn(a, b) -> (a', b')`` dispatches one A/B ring shift.  In
+    ``double_buffer`` mode the shift feeding tick t+1 is dispatched
+    *before* tick t's contraction — both are in flight together, and
+    nothing blocks unless ``measure``.  In ``serial`` mode (the
+    measured reference ordering) each region is dispatched and drained
+    before the next.  Per-tick op order matches the fused serial
+    program exactly, so the result is bitwise identical either way.
+
+    Returns ``(c, shift_exposed_s, compute_s)`` — the timing fields
+    are 0.0 unless ``measure``.
+    """
+    import jax
+
+    from dbcsr_tpu.acc.smm import record_dispatch
+
+    db = mode == "double_buffer"
+    inject = db and _faults.active()
+    shift_exposed = 0.0
+    compute_s = 0.0
+    a_nxt = b_nxt = None
+    for t in range(nticks):
+        if t:
+            if measure and db:
+                # the exposed remainder of the shift dispatched last
+                # tick (serial already drained and timed it at its
+                # dispatch site — re-timing the drained arrays would
+                # inflate the serial baseline's exposure)
+                t0 = time.perf_counter()
+                jax.block_until_ready(a_nxt)
+                jax.block_until_ready(b_nxt)
+                shift_exposed += time.perf_counter() - t0
+            a, b = a_nxt, b_nxt
+        last = t == nticks - 1
+        if db:
+            if not last:
+                # the host-level tick/shift boundary: the one place a
+                # mid-shift fault can fire outside the SPMD program
+                if inject:
+                    _faults.maybe_inject("mesh_shift", engine=engine, tick=t)
+                a_nxt, b_nxt = shift_fn(a, b)
+                record_dispatch(DRIVER)
+                if inject:
+                    a_nxt = _faults.corrupt("mesh_shift", a_nxt,
+                                            engine=engine, tick=t)
+            c = tick_fn(a, b, c, t)
+            record_dispatch(DRIVER)
+            if measure:
+                t0 = time.perf_counter()
+                jax.block_until_ready(c)
+                compute_s += time.perf_counter() - t0
+        else:
+            c = tick_fn(a, b, c, t)
+            record_dispatch(DRIVER)
+            if measure:
+                t0 = time.perf_counter()
+                jax.block_until_ready(c)
+                compute_s += time.perf_counter() - t0
+            if not last:
+                a_nxt, b_nxt = shift_fn(a, b)
+                record_dispatch(DRIVER)
+                if measure:
+                    # serial reference: nothing else is in flight, the
+                    # whole shift wait is exposed by construction
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(a_nxt)
+                    jax.block_until_ready(b_nxt)
+                    shift_exposed += time.perf_counter() - t0
+    return c, shift_exposed, compute_s
+
+
+def checks_enabled() -> bool:
+    """Finite-output checking of the double-buffered result: always on
+    under fault injection (a ``mesh_shift:nan`` corruption must degrade
+    to serial, not escape into C), plus the production
+    ``DBCSR_TPU_CHECK_OUTPUTS=1`` opt-in (acc/smm convention)."""
+    if _faults.active():
+        return True
+    from dbcsr_tpu.acc.smm import _output_checks_enabled
+
+    return _output_checks_enabled()
+
+
+def output_corrupted(x) -> bool:
+    """True when the accumulated C panel holds non-finite values (the
+    acc/smm post-execution check: per-block sum then isfinite — NaN
+    and inf both propagate through the cheap reduction)."""
+    from dbcsr_tpu.acc.smm import _output_corrupted
+
+    return _output_corrupted(x)
+
+
+def guarded(engine: str, grid: str, db_fn, serial_fn):
+    """Run the double-buffered pipeline with the serial program as the
+    bitwise-identical escape hatch.
+
+    ``db_fn()`` runs the per-tick pipeline and returns C; any failure
+    (injected ``mesh_shift`` fault, corrupted output, real dispatch
+    error) is classified, recorded against the ``cannon_db`` breaker
+    for this (engine, grid), surfaced on the event bus + flight record,
+    and the multiply re-runs through ``serial_fn()`` from the pristine
+    operands — the decompose contract of the fused superstack, at the
+    tick-pipeline level."""
+    from dbcsr_tpu.resilience import breaker as _breaker
+
+    board = _breaker.get_board()
+    key = (engine, grid)
+    try:
+        out = db_fn()
+        if checks_enabled() and output_corrupted(out):
+            from dbcsr_tpu.acc.smm import CorruptedOutputError
+
+            raise CorruptedOutputError(
+                "double-buffered tick pipeline produced non-finite "
+                "output panels")
+    except Exception as exc:  # noqa: BLE001 — classified + degraded
+        from dbcsr_tpu.acc.smm import (
+            _classify_failure, _record_driver_failure, _record_fallback,
+        )
+
+        kind = _classify_failure(exc)
+        board.record_failure(DRIVER, key, kind=kind)
+        _record_driver_failure(DRIVER, kind, exc, key)
+        _record_fallback(DRIVER, "serial", key)
+        _trace.annotate(cannon_mode="serial",
+                        cannon_degraded=f"{type(exc).__name__}")
+        _flight.note("cannon_mode", "serial")
+        # the rollup's mode must say what actually RAN (evidence
+        # stamps read it), not what was attempted — and any earlier
+        # run's measured sample must not stay attached to it
+        stats.record_cannon_overlap(engine, grid, mode="serial",
+                                    drop_measured=True)
+        return serial_fn(), True
+    board.record_success(DRIVER, key)
+    return out, False
+
+
+def run_split_pipeline(engine: str, grid: str, mode: str, split_fn,
+                       serial_fn, measure: bool):
+    """Run the split per-tick pipeline guarded, for BOTH modes: the
+    double-buffered path and the measured serial reference leg share
+    the same programs and failure modes (separate compilations, the
+    extra accumulator buffer, per-tick dispatches), so both get the
+    same contract — an open ``cannon_db`` breaker or any pipeline
+    failure falls back to the fused program, with failures recorded so
+    later multiplies stop retrying a condemned pipeline.
+
+    ``split_fn(timings)`` must run the pipeline and append
+    ``(shift_exposed_s, compute_s)`` to ``timings``.  The measured
+    sample is published ONLY when the pipeline actually delivered the
+    result: a degraded run's partial timings must never become
+    committed overlap evidence (its product came from the fused
+    serial program)."""
+    if mode != "double_buffer":
+        # the serial reference leg never claims a double-buffer trial:
+        # an open breaker skips the condemned pipeline entirely
+        from dbcsr_tpu.resilience import breaker as _breaker
+
+        if not _breaker.get_board().allow(DRIVER, (engine, grid)):
+            return serial_fn()
+    timings: list = []
+    out, degraded = guarded(engine, grid, lambda: split_fn(timings),
+                            serial_fn)
+    if measure and not degraded and timings:
+        publish_measured(engine, grid, mode, *timings[-1])
+    return out
+
+
+def publish_decision(engine: str, grid: str, mode: str, why: str) -> None:
+    """Make the overlap decision visible: trace span attributes, the
+    flight record, and the bounded event bus."""
+    _trace.annotate(cannon_mode=mode, cannon_mode_why=why)
+    _flight.note("cannon_mode", mode)
+    _flight.note_event("cannon_overlap", engine=engine, grid=grid,
+                       mode=mode, why=why)
+    _events.publish("cannon_overlap",
+                    {"engine": engine, "grid": grid, "mode": mode,
+                     "why": why})
+    # rollup mode = the resolved decision; `guarded` overwrites it with
+    # "serial" if the pipeline later degrades, so evidence stamps
+    # (tools/mesh_perf.py) always read what actually ran
+    stats.record_cannon_overlap(engine, grid, mode=mode)
+
+
+def publish_modeled(engine: str, grid: str, tick: dict) -> None:
+    """Per-tick modeled comm/compute gauges, labeled by engine (the
+    dense Cannon and the sparse mesh publish the same family)."""
+    _metrics.gauge(
+        "dbcsr_tpu_cannon_overlap_ratio",
+        "modeled comm-time / compute-time per Cannon tick "
+        "(<1 = the ring shift hides behind the local contraction)",
+    ).set(tick["overlap_ratio"], grid=grid, engine=engine)
+    _metrics.gauge(
+        "dbcsr_tpu_cannon_tick_comm_bytes",
+        "per-device operand bytes ring-shifted per Cannon tick",
+    ).set(tick["tick_comm_bytes"], grid=grid, engine=engine)
+    _metrics.gauge(
+        "dbcsr_tpu_cannon_tick_flops",
+        "per-device flops contracted per Cannon tick",
+    ).set(tick["tick_flops"], grid=grid, engine=engine)
+    stats.record_cannon_overlap(engine, grid,
+                                modeled=tick["overlap_ratio"])
+    _trace.annotate(
+        cannon_overlap_ratio=round(tick["overlap_ratio"], 4),
+        tick_comm_bytes=tick["tick_comm_bytes"],
+        tick_flops=tick["tick_flops"],
+    )
+
+
+def publish_measured(engine: str, grid: str, mode: str,
+                     shift_exposed_s: float, compute_s: float) -> None:
+    """Fold one measured tick-loop decomposition into the gauges and
+    the `core.stats` overlap rollup.  The headline number is the
+    comm-exposed fraction: exposed shift seconds over total measured
+    loop seconds — double-buffering must push it toward 0 while the
+    serial ordering pays the full shift wait."""
+    total = shift_exposed_s + compute_s
+    if total <= 0:
+        return
+    exposed = shift_exposed_s / total
+    _metrics.gauge(MEASURED_GAUGE, _MEASURED_HELP).set(
+        exposed, grid=grid, engine=engine, mode=mode)
+    stats.record_cannon_overlap(
+        engine, grid, mode=mode, measured=exposed,
+        shift_exposed_s=shift_exposed_s, compute_s=compute_s)
+    _trace.annotate(cannon_overlap_measured=round(exposed, 4),
+                    cannon_shift_exposed_ms=round(shift_exposed_s * 1e3, 3),
+                    cannon_compute_ms=round(compute_s * 1e3, 3))
+    _flight.note("cannon_overlap_measured", round(exposed, 4))

@@ -44,7 +44,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dbcsr_tpu.core.matrix import NO_SYMMETRY, BlockSparseMatrix
 from dbcsr_tpu.core.timings import timed
+from dbcsr_tpu.obs import costmodel as _costmodel
+from dbcsr_tpu.obs import events as _events
 from dbcsr_tpu.ops.transformations import desymmetrize
+from dbcsr_tpu.parallel import overlap as _overlap
+from dbcsr_tpu.parallel.overlap import _HashableMesh
 from dbcsr_tpu.resilience import faults as _faults
 from dbcsr_tpu.utils.compat import shard_map as _shard_map
 from dbcsr_tpu.utils.rounding import bucket_size
@@ -227,6 +231,60 @@ def _tick_chunks(s_cap: int, r0: int) -> tuple:
     return nchunk, s_cap // nchunk
 
 
+@functools.lru_cache(maxsize=None)
+def _ring_perms(s: int) -> tuple:
+    """(shift_a, shift_b) ring permutations — A left along 'pc', B up
+    along 'pr' — built once per s instead of once per traced tick body
+    (shared by the fused metronome and the split shift program)."""
+    return (tuple(((j + 1) % s, j) for j in range(s)),
+            tuple(((i + 1) % s, i) for i in range(s)))
+
+
+def _stack_contrib(a, b, c, entries, *, r0, cap_c, acc_dtype):
+    """One stack chunk's contribution: gather → batched matmul →
+    sorted segment-sum.  ONE implementation shared by the fused
+    metronome body (`_cannon_tick_loop`) and the split per-tick
+    program (`_mesh_tick_program`) so the two execution modes are
+    bitwise identical by construction."""
+    bm, bk, bn = a.shape[1], a.shape[2], b.shape[2]
+    if r0:
+        ia = entries[:, :r0]
+        ib = entries[:, r0:2 * r0]
+        ic = entries[:, 2 * r0]
+        pa = jnp.take(a, ia.reshape(-1), axis=0).reshape(-1, r0, bm, bk)
+        pa = jnp.swapaxes(pa, 1, 2).reshape(-1, bm, r0 * bk)
+        pb = jnp.take(b, ib.reshape(-1), axis=0).reshape(-1, r0 * bk, bn)
+    else:
+        pa = jnp.take(a, entries[:, 0], axis=0)
+        pb = jnp.take(b, entries[:, 1], axis=0)
+        ic = entries[:, 2]
+    prod = jax.lax.dot_general(
+        pa, pb, (((2,), (1,)), ((0,), (0,))),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=acc_dtype,
+    )
+    return c + jax.ops.segment_sum(
+        prod, ic, num_segments=cap_c,
+        indices_are_sorted=True,
+    )
+
+
+def _tick_contrib_chunked(a, b, c, st_tick, *, r0, cap_c, acc_dtype):
+    """One tick's full contribution, run in `_tick_chunks` sub-chunks
+    (same chunk decomposition in both execution modes)."""
+    nchunk, rows = _tick_chunks(st_tick.shape[0], r0)
+    if nchunk > 1:
+        st_t = st_tick.reshape(nchunk, rows, st_tick.shape[1])
+        return jax.lax.fori_loop(
+            0, nchunk,
+            lambda j, cc: _stack_contrib(a, b, cc, st_t[j], r0=r0,
+                                         cap_c=cap_c, acc_dtype=acc_dtype),
+            c,
+        )
+    return _stack_contrib(a, b, c, st_tick, r0=r0, cap_c=cap_c,
+                          acc_dtype=acc_dtype)
+
+
 def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=0, nticks=None):
     """The shared Cannon metronome: ticks of gather → batched matmul →
     sorted segment-sum, ring-shifting A along 'pc' and B along 'pr'
@@ -237,48 +295,18 @@ def _cannon_tick_loop(a, b, st, s, cap_c, acc_dtype, r0=0, nticks=None):
     ``nticks`` overrides the tick count (defaults to s).  Each tick's
     stack additionally runs in `_tick_chunks` sub-chunks so peak temp
     memory stays bounded no matter how much product one tick carries."""
-    bm, bk, bn = a.shape[1], a.shape[2], b.shape[2]
+    bm, bn = a.shape[1], b.shape[2]
     from dbcsr_tpu.parallel.cannon import mark_varying
 
     c = jnp.zeros((cap_c, bm, bn), acc_dtype)
     c = mark_varying(c, ("kl", "pr", "pc"))
-    nchunk, rows = _tick_chunks(st.shape[1], r0)
-    width = st.shape[2]
-
-    def _contrib(a, b, c, entries):
-        if r0:
-            ia = entries[:, :r0]
-            ib = entries[:, r0:2 * r0]
-            ic = entries[:, 2 * r0]
-            pa = jnp.take(a, ia.reshape(-1), axis=0).reshape(-1, r0, bm, bk)
-            pa = jnp.swapaxes(pa, 1, 2).reshape(-1, bm, r0 * bk)
-            pb = jnp.take(b, ib.reshape(-1), axis=0).reshape(-1, r0 * bk, bn)
-        else:
-            pa = jnp.take(a, entries[:, 0], axis=0)
-            pb = jnp.take(b, entries[:, 1], axis=0)
-            ic = entries[:, 2]
-        prod = jax.lax.dot_general(
-            pa, pb, (((2,), (1,)), ((0,), (0,))),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=acc_dtype,
-        )
-        return c + jax.ops.segment_sum(
-            prod, ic, num_segments=cap_c,
-            indices_are_sorted=True,
-        )
+    shift_a, shift_b = _ring_perms(s) if s > 1 else ((), ())
 
     def tick(t, carry):
         a, b, c = carry
-        if nchunk > 1:
-            st_t = st[t].reshape(nchunk, rows, width)
-            c = jax.lax.fori_loop(
-                0, nchunk, lambda j, cc: _contrib(a, b, cc, st_t[j]), c
-            )
-        else:
-            c = _contrib(a, b, c, st[t])
+        c = _tick_contrib_chunked(a, b, c, st[t], r0=r0, cap_c=cap_c,
+                                  acc_dtype=acc_dtype)
         if s > 1:
-            shift_a = tuple(((j + 1) % s, j) for j in range(s))
-            shift_b = tuple(((i + 1) % s, i) for i in range(s))
             a = jax.lax.ppermute(a, ("pc",), shift_a)
             b = jax.lax.ppermute(b, ("pr",), shift_b)
         return a, b, c
@@ -453,6 +481,153 @@ def _run_sparse_mesh(a_panels, b_panels, stacks, c_init, alpha, beta_fac,
     return fn(a_panels, b_panels, stacks, c_init, alpha, beta_fac)
 
 
+# --------------------------------------------------------------------------
+# Split per-tick programs: the double-buffered metronome
+# (parallel/overlap.py) dispatches these independently so the panel
+# ring shift feeding tick k+1 runs concurrently with tick k's gather +
+# batched matmul + segment-sum.  Per-tick op order (`_stack_contrib`,
+# `_tick_contrib_chunked`) is shared with the fused serial program, so
+# the two execution modes are bitwise identical.
+# --------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cap_c", "acc_name", "mesh_ref", "r0"),
+)
+def _mesh_tick_program(a_panels, b_panels, stacks, c_acc, t, *,
+                       cap_c, acc_name, mesh_ref, r0=0):
+    """One Cannon tick's chunked contribution into the per-layer
+    accumulator ``c_acc`` (global (kl, pr, pc, cap_c, bm, bn))."""
+    mesh = mesh_ref.val
+    acc_dtype = jnp.dtype(acc_name)
+
+    def body(a_p, b_p, st, c_p, t):
+        a = a_p.reshape(a_p.shape[3:])
+        b = b_p.reshape(b_p.shape[3:])
+        st = st.reshape(st.shape[3:])    # (nticks, s_cap, w)
+        c = c_p.reshape(c_p.shape[3:])   # (cap_c, bm, bn)
+        entries = jax.lax.dynamic_index_in_dim(st, t, axis=0, keepdims=False)
+        c = _tick_contrib_chunked(a, b, c, entries, r0=r0, cap_c=cap_c,
+                                  acc_dtype=acc_dtype)
+        return c.reshape((1, 1, 1) + c.shape)
+
+    fn = _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P("kl", "pr", "pc"),
+            P("kl", "pr", "pc"),
+            P("kl", "pr", "pc"),
+            P("kl", "pr", "pc"),
+            P(),
+        ),
+        out_specs=P("kl", "pr", "pc"),
+    )
+    return fn(a_panels, b_panels, stacks, c_acc, t)
+
+
+@functools.partial(jax.jit, static_argnames=("s", "mesh_ref"))
+def _mesh_shift_program(a_panels, b_panels, *, s, mesh_ref):
+    """One A/B panel ring shift (A left along 'pc', B up along 'pr')
+    as its own SPMD program — the second operand buffer of the
+    double-buffered tick."""
+    shift_a, shift_b = _ring_perms(s)
+
+    def body(a_p, b_p):
+        a = a_p.reshape(a_p.shape[3:])
+        b = b_p.reshape(b_p.shape[3:])
+        a = jax.lax.ppermute(a, ("pc",), shift_a)
+        b = jax.lax.ppermute(b, ("pr",), shift_b)
+        return (a.reshape((1, 1, 1) + a.shape),
+                b.reshape((1, 1, 1) + b.shape))
+
+    fn = _shard_map(
+        body,
+        mesh=mesh_ref.val,
+        in_specs=(P("kl", "pr", "pc"), P("kl", "pr", "pc")),
+        out_specs=(P("kl", "pr", "pc"), P("kl", "pr", "pc")),
+    )
+    return fn(a_panels, b_panels)
+
+
+@functools.partial(jax.jit, static_argnames=("acc_name", "mesh_ref"))
+def _mesh_finish_program(c_acc, c_init, alpha, beta_fac, *,
+                         acc_name, mesh_ref):
+    """Layer reduction + alpha/beta merge (same op order as the fused
+    program's tail): psum over 'kl', then alpha*C + beta_fac*C_in."""
+    acc_dtype = jnp.dtype(acc_name)
+
+    def body(c_p, c_in, alpha, beta_fac):
+        c = c_p.reshape(c_p.shape[3:])
+        c_in = c_in.reshape(c_in.shape[2:])
+        fac = beta_fac.reshape(beta_fac.shape[2:])
+        if fac.ndim == 1:
+            fac = fac[:, None, None]
+        c = jax.lax.psum(c, "kl")
+        c = (alpha * c + fac * c_in.astype(acc_dtype)).astype(c_in.dtype)
+        return c.reshape((1, 1) + c.shape)
+
+    fn = _shard_map(
+        body,
+        mesh=mesh_ref.val,
+        in_specs=(
+            P("kl", "pr", "pc"),
+            P("pr", "pc"),
+            P(),
+            P("pr", "pc"),
+        ),
+        out_specs=P("pr", "pc"),
+    )
+    return fn(c_acc, c_init, alpha, beta_fac)
+
+
+def _mesh_ticks(plan: "_MeshPlan", mesh, a_panels, b_panels, c_init,
+                alpha_dev, beta_fac, mode: str, measure: bool,
+                timings: list):
+    """Host-driven tick loop behind the double-buffered (and
+    measured-serial) sparse mesh Cannon — bitwise identical to
+    `_run_sparse_mesh` with ``gather=False``.  Appends the measured
+    (shift_exposed_s, compute_s) split to ``timings`` — published by
+    the caller only when the pipeline delivered the result
+    (overlap.run_split_pipeline)."""
+    from dbcsr_tpu.acc.smm import record_dispatch
+
+    mref = _HashableMesh(mesh)
+    s = plan.s
+    c_acc = _overlap.zeros_program(
+        mref, (plan.kl, s, plan.pc, plan.cap_c, plan.bm, plan.bn),
+        plan.acc_name, P("kl", "pr", "pc"),
+    )()
+    record_dispatch(_overlap.DRIVER)  # the zeros program
+
+    def shift(aa, bb):
+        return _mesh_shift_program(aa, bb, s=s, mesh_ref=mref)
+
+    def tick(aa, bb, cc, t):
+        return _mesh_tick_program(
+            aa, bb, plan.stacks_dev, cc, jnp.asarray(t, jnp.int32),
+            cap_c=plan.cap_c, acc_name=plan.acc_name, mesh_ref=mref,
+            r0=plan.r0,
+        )
+
+    c_acc, shift_s, comp_s = _overlap.run_ticks(
+        plan.nticks, a_panels, b_panels, c_acc, shift, tick,
+        mode=mode, engine="mesh", measure=measure,
+    )
+    # tick/shift dispatches were counted as issued (run_ticks — so a
+    # mid-pipeline failure still shows the round-trips it really paid,
+    # the PR-4 failed-launches-count convention); the finish program
+    # books its own below
+    if measure:
+        timings.append((shift_s, comp_s))
+    res = _mesh_finish_program(
+        c_acc, c_init, alpha_dev, beta_fac,
+        acc_name=plan.acc_name, mesh_ref=mref,
+    )
+    record_dispatch(_overlap.DRIVER)
+    return res
+
+
 def sparse_multiply_distributed(
     alpha,
     matrix_a: BlockSparseMatrix,
@@ -480,17 +655,25 @@ def sparse_multiply_distributed(
     (`dbcsr_mm_cannon.F:1098-1105`), final ||C||>=eps pass unless
     retain_sparsity, which instead locks C's pattern.
     """
-    if _faults.active():
-        # the collective boundary: ring shifts / psum / all_gather run
-        # inside jit, so the injection point is the mesh dispatch edge
-        _faults.maybe_inject("collective")
-    with timed("sparse_cannon"):
-        return _sparse_multiply_impl(
-            alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
-            (first_row, last_row, first_col, last_col, first_k, last_k),
-            retain_sparsity=retain_sparsity, filter_eps=filter_eps,
-            element_limits=element_limits,
-        )
+    # product scope: the mesh engine's overlap decision, faults and
+    # breaker events correlate to this multiply on the bus + flight
+    # ring exactly like the single-chip engine's (`mm.multiply`)
+    with _events.product_scope(
+            "mesh_multiply", name or f"{matrix_a.name}*{matrix_b.name}",
+            a=matrix_a.name, b=matrix_b.name):
+        if _faults.active():
+            # the collective boundary: ring shifts / psum / all_gather
+            # run inside jit, so the injection point is the mesh
+            # dispatch edge (the double-buffered tick pipeline adds the
+            # host-level `mesh_shift` site per tick, parallel/overlap.py)
+            _faults.maybe_inject("collective")
+        with timed("sparse_cannon"):
+            return _sparse_multiply_impl(
+                alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
+                (first_row, last_row, first_col, last_col, first_k, last_k),
+                retain_sparsity=retain_sparsity, filter_eps=filter_eps,
+                element_limits=element_limits,
+            )
 
 
 # --------------------------------------------------------------------------
@@ -1071,13 +1254,48 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     beta_fac = jax.device_put(beta_fac, NamedSharding(mesh, P("pr", "pc")))
 
     # ---- run on the mesh ----
-    c_out = _run_sparse_mesh(
-        a_panels, b_panels, plan.stacks_dev, c_init,
-        jnp.asarray(alpha, dtype), beta_fac,
-        s=pr, nticks=plan.nticks, gather=not cannon, cap_c=cap_c,
-        acc_name=plan.acc_name, mesh_ref=_HashableMesh(mesh), r0=r0,
-    )
-    _record_mesh_dispatch(plan.stacks_dev, r0)
+    grid = f"{kl}x{pr}x{pc}"
+    if cannon and pr > 1:
+        # modeled per-tick comm/compute attribution, same gauge family
+        # as the dense Cannon's but labeled engine="mesh" (panel
+        # capacities stand in for the dense panel dims)
+        tickm = _costmodel.mesh_tick_model(
+            cap_a + xtr, cap_b + xtr, bm, bk, bn, plan.n_cand,
+            plan.nticks, kl * pr * pc, np.dtype(dtype).itemsize,
+            np.dtype(dtype).name,
+        )
+        _overlap.publish_modeled("mesh", grid, tickm)
+    mode, why = _overlap.resolve_mode(
+        "mesh", grid, pr if cannon else 1, plan.nticks)
+    _overlap.publish_decision("mesh", grid, mode, why)
+    alpha_dev = jnp.asarray(alpha, dtype)
+    mref = _HashableMesh(mesh)
+
+    def serial_fn():
+        out = _run_sparse_mesh(
+            a_panels, b_panels, plan.stacks_dev, c_init,
+            alpha_dev, beta_fac,
+            s=pr, nticks=plan.nticks, gather=not cannon, cap_c=cap_c,
+            acc_name=plan.acc_name, mesh_ref=mref, r0=r0,
+        )
+        _record_mesh_dispatch(plan.stacks_dev, r0)
+        return out
+
+    measure = cannon and pr > 1 and _overlap.measuring()
+    if _overlap.use_split_pipeline(mode, why, measure):
+        # double-buffered ticks, or the measured serial reference (same
+        # per-tick op sequence, one dispatch per region — the
+        # DBCSR_TPU_SYNC_TIMING seam); both guarded: an open cannon_db
+        # breaker or a split-pipeline failure falls back to serial_fn
+        c_out = _overlap.run_split_pipeline(
+            "mesh", grid, mode,
+            lambda timings: _mesh_ticks(
+                plan, mesh, a_panels, b_panels, c_init, alpha_dev,
+                beta_fac, mode, measure, timings),
+            serial_fn, measure,
+        )
+    else:
+        c_out = serial_fn()
 
     # ---- device-side collect into shape bins (C stays resident) ----
     out = BlockSparseMatrix(
@@ -1107,8 +1325,6 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         from dbcsr_tpu.ops.operations import filter_matrix
 
         filter_matrix(out, filter_eps)
-
-    from dbcsr_tpu.obs import costmodel as _costmodel
 
     stats.record_stack(
         bm, bn, bk, plan.n_cand, driver="mesh",
@@ -1211,8 +1427,6 @@ def _dense_multiply_mesh(alpha, a, b, beta, matrix_c, mesh, name, dtype,
     bm = int(a.row_blk_sizes.max()) if a.nblkrows else 1
     bk = int(a.col_blk_sizes.max()) if a.nblkcols else 1
     bn = int(b.col_blk_sizes.max()) if b.nblkcols else 1
-    from dbcsr_tpu.obs import costmodel as _costmodel
-
     stats.record_stack(bm, bn, bk, a.nblkrows * b.nblkcols * a.nblkcols,
                        driver="dense",
                        seconds=time.perf_counter() - t_start,
@@ -1314,11 +1528,14 @@ def tas_grouped_multiply(
     same metronome.  A column-long C is handled by the caller via
     transposition (C^T row-grouped).
     """
-    with timed("tas_grouped_cannon"):
-        return _tas_grouped_impl(
-            alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name, filter_eps,
-            nsplit=nsplit,
-        )
+    with _events.product_scope(
+            "tas_mesh_multiply", name or f"{matrix_a.name}*{matrix_b.name}",
+            a=matrix_a.name, b=matrix_b.name):
+        with timed("tas_grouped_cannon"):
+            return _tas_grouped_impl(
+                alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
+                filter_eps, nsplit=nsplit,
+            )
 
 
 def _build_grouped_plan(a, b, matrix_c, mesh, g, s, dtype, bm, bk, bn, r0,
@@ -1522,6 +1739,12 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         NamedSharding(mesh, P("kl", "pr", "pc")),
     )
 
+    # the grouped TAS route keeps the fused serial metronome: its
+    # per-group Cannons advance in lockstep inside ONE program, and
+    # pipelining lockstepped groups is future work — the decision is
+    # still recorded so flight records/traces show which path ran
+    _overlap.publish_decision("tas", f"{g}x{s}x{s}", "serial",
+                              "tas-grouped-route")
     c_out = _run_grouped_cannon(
         a_panels, b_panels, plan.stacks_dev, c_init,
         jnp.asarray(alpha, dtype), jnp.asarray(beta, dtype),
@@ -1557,8 +1780,6 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
 
         filter_matrix(out, filter_eps)
 
-    from dbcsr_tpu.obs import costmodel as _costmodel
-
     stats.record_stack(
         bm, bn, bk, plan.n_cand, driver="mesh",
         seconds=time.perf_counter() - t_start,
@@ -1584,21 +1805,6 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
     return out
 
 
-class _HashableMesh:
-    """Static jit argument wrapper, keyed by mesh structure (axis
-    names/sizes + device ids) so recreating an identical mesh reuses the
-    compiled program and a recycled object id can never alias."""
-
-    def __init__(self, mesh):
-        self.val = mesh
-        self._key = (
-            tuple(mesh.axis_names),
-            tuple(int(x) for x in np.asarray(mesh.devices.shape)),
-            tuple(d.id for d in mesh.devices.flat),
-        )
-
-    def __hash__(self):
-        return hash(self._key)
-
-    def __eq__(self, other):
-        return isinstance(other, _HashableMesh) and other._key == self._key
+# _HashableMesh (the static jit argument wrapper keyed by mesh
+# structure) lives in `parallel/overlap.py` now, shared with the dense
+# Cannon's split programs; imported at the top for compatibility.

@@ -20,6 +20,11 @@ site                      boundary
 ``dense``                 the dense paths in `mm.multiply`
 ``multihost_init``        `parallel.multihost.init_multihost`
 ``collective``            `parallel.sparse_dist` mesh dispatch boundary
+``mesh_shift``            the double-buffered Cannon tick/shift
+                          boundary (`parallel.overlap.run_ticks`, one
+                          per ring shift; labels: ``engine``,
+                          ``tick``) — a fault here degrades the
+                          multiply to the serial fused program
 ``probe``                 `bench._probe_tpu`
 ========================  ====================================================
 

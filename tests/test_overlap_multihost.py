@@ -1,0 +1,122 @@
+"""Overlapped-vs-serial Cannon ticks in a REAL 2-process world: two OS
+processes (4 virtual CPU devices each) join via `jax.distributed`,
+then each rank runs the block-sparse Cannon AND the dense Cannon on
+its local (1,2,2) mesh with ``cannon_overlap=serial`` then
+``double_buffer`` — every rank must see **bitwise identical**
+checksums between the two modes, and the checksums must agree across
+ranks (the reference's `dbcsr_checksum` cross-rank determinism
+contract): the per-tick dispatch pipeline behaves identically under
+an initialized multihost runtime, where `jax.process_count() > 1`
+steers every process-dependent code path.
+
+Per-rank local meshes, not one cross-process mesh: this container's
+CPU backend refuses multiprocess XLA computations (the pre-existing
+`test_multihost_2proc.py` world hits the same wall), and
+`test_trace_multihost.py` — the tier-1 pattern this file follows —
+keeps rank work local for exactly that reason.
+
+Kept deliberately light (tiny matrices, one grid) so it stays inside
+the tier-1 budget.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r'''
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+port, pid = sys.argv[1], int(sys.argv[2])
+import numpy as np
+from dbcsr_tpu.core.config import set_config
+from dbcsr_tpu.ops.test_methods import checksum, make_random_matrix
+from dbcsr_tpu.parallel import make_grid, multihost, \
+    sparse_multiply_distributed
+from dbcsr_tpu.parallel.cannon import cannon_multiply_dense
+from dbcsr_tpu.parallel.sparse_dist import clear_mesh_plans
+
+ok = multihost.init_multihost(f"localhost:{{port}}", 2, pid)
+assert ok and multihost.process_count() == 2
+mesh = make_grid(devices=jax.local_devices())  # local (1,2,2)
+assert mesh.shape["pr"] == mesh.shape["pc"] == 2, dict(mesh.shape)
+
+sizes = [3] * 8
+a = make_random_matrix("A", sizes, sizes, occupation=0.5,
+                       rng=np.random.default_rng(9))
+b = make_random_matrix("B", sizes, sizes, occupation=0.5,
+                       rng=np.random.default_rng(10))
+cks = {{}}
+for mode in ("serial", "double_buffer"):
+    set_config(cannon_overlap=mode)
+    clear_mesh_plans()
+    c = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh)
+    cks[mode] = checksum(c)
+assert cks["serial"] == cks["double_buffer"], cks
+
+ad = np.random.default_rng(1).standard_normal((8, 8))
+bd = np.random.default_rng(2).standard_normal((8, 8))
+dense = {{}}
+for mode in ("serial", "double_buffer"):
+    set_config(cannon_overlap=mode)
+    cd = np.asarray(cannon_multiply_dense(mesh, ad, bd))
+    dense[mode] = cd
+assert (dense["serial"] == dense["double_buffer"]).all()
+
+print(f"WORKER{{pid}} OK sparse={{cks['double_buffer']!r}} "
+      f"dense={{float(np.abs(dense['double_buffer']).sum())!r}}")
+multihost.shutdown_multihost()
+'''
+
+
+def _run_world(worker, attempt_timeout):
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    env.pop("JAX_PLATFORMS", None)  # worker sets the platform itself
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(port), str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=attempt_timeout)[0])
+    except subprocess.TimeoutExpired:
+        outs = None  # port race / hung join: caller may retry
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            try:
+                p.communicate(timeout=10)
+            except Exception:
+                pass
+    return procs, outs
+
+
+def test_two_process_overlap_bitwise_identity(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER.format(repo=_REPO))
+    procs, outs = _run_world(worker, attempt_timeout=180)
+    if outs is None:
+        procs, outs = _run_world(worker, attempt_timeout=360)
+    assert outs is not None, "world never formed (twice)"
+    for i, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{o[-3000:]}"
+    oks = [l for o in outs for l in o.splitlines() if " OK sparse=" in l]
+    assert len(oks) == 2, outs
+    # cross-rank determinism: both ranks computed identical checksums
+    assert len({l.split(" OK ", 1)[1] for l in oks}) == 1, oks

@@ -1,0 +1,19 @@
+"""Shared bootstrap for CPU-runnable tools that need a small virtual
+device world (`tools/chaos_suite.py`, `tools/overlap_bench.py`): the
+XLA flag must land in the environment BEFORE jax is imported anywhere
+in the process, so call this at script top, pre-import."""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_virtual_devices(n: int = 4) -> None:
+    """Append ``--xla_force_host_platform_device_count=n`` to XLA_FLAGS
+    unless a device-count flag is already present (an operator's
+    explicit world size always wins)."""
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}")

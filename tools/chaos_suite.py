@@ -42,9 +42,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # CPU-only by design: chaos runs must be schedulable in CI without
 # hardware (and must never be pointed at a live tunnel).
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the mesh_overlap corpus case needs a real 2x2 grid: give the CPU
+# backend 4 virtual devices (no-op when XLA_FLAGS already set them)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _hostdev  # noqa: E402
+
+_hostdev.ensure_virtual_devices(4)
 
 SITES = ("execute_stack", "prepare_stack", "dense", "xla", "xla_group",
-         "host", "pallas")
+         "host", "pallas", "mesh_shift")
 KINDS = ("raise", "oom", "nan")
 
 
@@ -67,6 +73,13 @@ def corpus():
         # caveat extended to recycled device storage)
         ("mcweeny_chain", dict(bs=[4] * 6, dtype=np.float64, occ=0.4,
                                chain_steps=3)),
+        # distributed case: the block-sparse Cannon on a 2x2 mesh with
+        # the double-buffered tick pipeline forced on — a mesh_shift
+        # fault firing mid-shift must degrade the multiply to the
+        # serial fused program with the checksum intact
+        # (breaker-integrated like the fused superstack's decompose)
+        ("mesh_overlap", dict(bs=[4] * 8, dtype=np.float64, occ=0.5,
+                              mesh=4, cannon_overlap="double_buffer")),
     ]
 
 
@@ -108,6 +121,31 @@ def _one_product(entry: dict, seed: int):
     from dbcsr_tpu.mm.multiply import multiply
     from dbcsr_tpu.ops.test_methods import checksum, make_random_matrix
 
+    if entry.get("mesh"):
+        from dbcsr_tpu.core.config import set_config
+        from dbcsr_tpu.parallel import make_grid, sparse_multiply_distributed
+        from dbcsr_tpu.parallel.sparse_dist import clear_mesh_plans
+
+        rng = np.random.default_rng(seed)
+        bs = entry["bs"]
+        a = make_random_matrix("A", bs, bs, dtype=entry["dtype"],
+                               occupation=entry["occ"], rng=rng)
+        b = make_random_matrix("B", bs, bs, dtype=entry["dtype"],
+                               occupation=entry["occ"], rng=rng)
+        prev = None
+        if entry.get("cannon_overlap"):
+            from dbcsr_tpu.core.config import get_config
+
+            prev = get_config().cannon_overlap
+            set_config(cannon_overlap=entry["cannon_overlap"])
+        try:
+            clear_mesh_plans()
+            c = sparse_multiply_distributed(1.0, a, b, 0.0, None,
+                                            make_grid(4))
+        finally:
+            if prev is not None:
+                set_config(cannon_overlap=prev)
+        return checksum(c)
     if entry.get("chain_steps"):
         from dbcsr_tpu.core import mempool
         from dbcsr_tpu.models.purify import make_test_density, mcweeny_step

@@ -79,8 +79,22 @@ def run(nrep: int = 6, nblk: int = 50):
         "vs_single_chip": round(resident / min(sc_times), 2),
         "nrep": nrep,
         "device": "cpu-mesh-8",
+        # evidence stamp: which Cannon tick scheduling actually RAN
+        # (the resolved — possibly degraded — mode from the stats
+        # rollup, not the config knob, which may say "auto")
+        "cannon_mode": _resolved_cannon_mode(dt),
     }
     return out
+
+
+def _resolved_cannon_mode(dt) -> str:
+    from dbcsr_tpu.core import stats
+
+    roll = stats.cannon_overlap_rollup().get("mesh", {})
+    for cell in roll.values():
+        if cell.get("mode"):
+            return cell["mode"]
+    return dt.get_config().cannon_overlap
 
 
 def main():
