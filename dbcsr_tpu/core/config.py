@@ -76,6 +76,25 @@ class Config:
     # any value >= 1 is honored exactly (1 forces a 2D grid and raises
     # when the device count is not a square)
     num_layers_3d: int = 0
+    # ---- serving plane (dbcsr_tpu.serve; env DBCSR_TPU_SERVE_*) ----
+    # bound on queued requests; beyond it submissions shed queue_full
+    serve_queue_max: int = 256
+    # cross-request batching window: how long the worker waits for
+    # more same-structure requests after popping one (0 disables the
+    # wait; coalescing then only groups requests already queued)
+    serve_window_ms: float = 5.0
+    # master switch for block-diagonal composite execution; off =
+    # every request runs serialized (the A/B control leg)
+    serve_coalesce: bool = True
+    # largest request group one composite multiply may carry
+    serve_coalesce_max: int = 8
+    # per-tenant quota: queued + running requests
+    serve_tenant_inflight: int = 8
+    # per-tenant quota: operand bytes queued (a+b+c device bytes)
+    serve_tenant_bytes: int = 256 * 1024 * 1024
+    # deadline assigned under a DEGRADED health verdict when the
+    # request didn't bring its own (seconds)
+    serve_degraded_deadline_s: float = 10.0
     # platform-injection seam (VERDICT r4 item 5): "" = the real JAX
     # backend platform; "tpu"/"cpu" makes every dispatch DECISION
     # (_pallas_supported, _dense_mode_wanted, emulated-dtype R-tiling)
@@ -112,6 +131,18 @@ class Config:
             raise ValueError("tas_split_factor must be positive")
         if self.num_layers_3d < 0:
             raise ValueError("num_layers_3d must be >= 0")
+        if self.serve_queue_max <= 0:
+            raise ValueError("serve_queue_max must be positive")
+        if self.serve_window_ms < 0:
+            raise ValueError("serve_window_ms must be >= 0")
+        if self.serve_coalesce_max < 1:
+            raise ValueError("serve_coalesce_max must be >= 1")
+        if self.serve_tenant_inflight <= 0:
+            raise ValueError("serve_tenant_inflight must be positive")
+        if self.serve_tenant_bytes <= 0:
+            raise ValueError("serve_tenant_bytes must be positive")
+        if self.serve_degraded_deadline_s <= 0:
+            raise ValueError("serve_degraded_deadline_s must be positive")
 
 
 _cfg = Config()

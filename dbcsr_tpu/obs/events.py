@@ -65,10 +65,22 @@ _ring: collections.deque = collections.deque(
     maxlen=max(1, _env_capacity()))
 _seq = 0
 
-# product-id correlation stack (nested TAS multiplies); engine-thread
-# discipline matches flight._current
-_product_stack: list = []
+# product-id correlation stack (nested TAS multiplies), kept PER
+# THREAD: the serving plane publishes submission/shed events from
+# client threads while the worker thread has a multiply open — a
+# global stack would stamp those events with the worker's product id
+# (same rationale as core.mempool's thread-local chain stack)
+_product_tls = threading.local()
 _product_seq = 0
+
+
+def _pstack() -> list:
+    st = getattr(_product_tls, "stack", None)
+    if st is None:
+        st = _product_tls.stack = []
+    return st
+
+
 # process-unique token so ids from N multihost shards never collide
 _TOKEN = f"{os.getpid():x}-{uuid.uuid4().hex[:6]}"
 
@@ -106,16 +118,20 @@ def begin_product(**fields) -> str:
     """Open a correlation id for the multiply that is starting; every
     event published until the matching `end_product` carries it."""
     global _product_seq
-    _product_seq += 1
-    pid = f"{_TOKEN}-{_product_seq}"
-    _product_stack.append(pid)
+    with _lock:
+        _product_seq += 1
+        seq = _product_seq
+    pid = f"{_TOKEN}-{seq}"
+    _pstack().append(pid)
     publish("multiply_begin", dict(fields, product_id=pid))
     return pid
 
 
 def current_product() -> str | None:
-    """The innermost open product id (None outside a multiply)."""
-    return _product_stack[-1] if _product_stack else None
+    """The innermost open product id on THIS thread (None outside a
+    multiply)."""
+    st = _pstack()
+    return st[-1] if st else None
 
 
 def end_product(rec: dict | None = None, error: str | None = None,
@@ -125,9 +141,10 @@ def end_product(rec: dict | None = None, error: str | None = None,
     feed the health model's rolling windows.  The product stays on the
     correlation stack until the health detectors ran, so an anomaly
     THIS multiply trips is stamped with its product_id."""
-    if not _product_stack:
+    st = _pstack()
+    if not st:
         return
-    pid = _product_stack[-1]
+    pid = st[-1]
     args = dict(fields, product_id=pid)
     dur_ms = None
     if rec is not None:
@@ -151,8 +168,8 @@ def end_product(rec: dict | None = None, error: str | None = None,
     except Exception:
         pass  # health sampling must never fail a multiply
     finally:
-        if _product_stack and _product_stack[-1] == pid:
-            _product_stack.pop()
+        if st and st[-1] == pid:
+            st.pop()
 
 
 import contextlib as _contextlib

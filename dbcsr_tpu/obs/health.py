@@ -21,8 +21,9 @@ prints.
   world join or an active fallback/recompile storm degrades.
 * ``perf`` — an active roofline-collapse anomaly degrades, as does
   memory-pool thrash (budget evictions while checkouts still miss —
-  the pool's byte budget is below the chain's working set); the
-  per-driver roofline fractions and pool counters ride along.
+  the pool's byte budget is below the chain's working set) and an
+  active serving-plane shed storm; the per-driver roofline fractions
+  and pool counters ride along.
 
 **Anomaly detectors** (rolling windows over the last
 ``DBCSR_TPU_HEALTH_WINDOW`` = 64 multiplies, fed by
@@ -39,6 +40,10 @@ median/MAD):
 * ``roofline_collapse`` — a driver's per-multiply roofline fraction
   drops below half the window median (device silently throttled,
   tunnel latency regime change).
+* ``shed_storm`` — the serving plane (`dbcsr_tpu.serve`) shed more
+  than ``DBCSR_TPU_HEALTH_SHED_RATE`` (0.25) of the last admission
+  window (fed per decision by `observe_serve`; surfaces as a
+  DEGRADED reason on the ``perf`` component).
 
 Each detector fires on the RISING edge only (publishing an ``anomaly``
 bus event + ``dbcsr_tpu_anomalies_total{kind}``) and re-arms when the
@@ -64,7 +69,8 @@ CRITICAL = "CRITICAL"
 _RANK = {OK: 0, DEGRADED: 1, CRITICAL: 2}
 
 ANOMALY_KINDS = ("recompile_storm", "fallback_storm",
-                 "dispatch_latency_spike", "roofline_collapse")
+                 "dispatch_latency_spike", "roofline_collapse",
+                 "shed_storm")
 
 _lock = threading.Lock()
 
@@ -115,6 +121,11 @@ _peak_cache: dict = {}
 _th_cache: dict = {}
 # rising-edge state per anomaly kind (roofline keyed per driver)
 _active: dict = {}
+# serving-plane admission window: 1.0 per shed decision, 0.0 per
+# admit (fed by serve.queue via observe_serve) — the shed-storm
+# detector's rolling window, keyed by admission count like the
+# multiply detectors are keyed by multiply count (clock-free)
+_serve_window: collections.deque = collections.deque(maxlen=_window_n())
 
 
 def _threshold(name: str, default: float) -> float:
@@ -153,6 +164,7 @@ def reset() -> None:
         _last_rollup.clear()
         _peak_cache.clear()
         _th_cache.clear()
+        _serve_window.clear()
 
 
 def _counter_total(name: str) -> float:
@@ -335,6 +347,28 @@ def _observe_roofline() -> None:
             hist.append(frac)
 
 
+def observe_serve(shed: bool) -> None:
+    """Feed one serving-plane admission decision into the shed-storm
+    window (`serve.queue` calls this for every admit/shed).  Rising
+    edge fires when the shed fraction of the last window exceeds
+    ``DBCSR_TPU_HEALTH_SHED_RATE`` (default 0.25) with at least
+    `_MIN_SAMPLES` decisions observed — the same rolling-window,
+    rising-edge convention as the four multiply detectors."""
+    with _lock:
+        _serve_window.append(1.0 if shed else 0.0)
+        n = len(_serve_window)
+        rate = sum(_serve_window) / n if n else 0.0
+    if n < _MIN_SAMPLES:
+        return
+    th = _threshold("DBCSR_TPU_HEALTH_SHED_RATE", 0.25)
+    if rate > th:
+        _fire("shed_storm", "shed_storm",
+              {"shed_fraction": round(rate, 3), "threshold": th,
+               "window": n})
+    else:
+        _clear_state("shed_storm")
+
+
 def active_anomalies() -> dict:
     """{kind: [detail…]} of detectors currently in the anomalous
     state (rising-edge flags, not historical counts)."""
@@ -451,11 +485,22 @@ def _eval_perf() -> dict:
                 dtype=dtype)["roofline_fraction"], 5)
     except Exception:
         pass
-    collapsed = active_anomalies().get("roofline_collapse")
+    anomalies = active_anomalies()
+    collapsed = anomalies.get("roofline_collapse")
     if collapsed:
         status = DEGRADED
         reasons.append("active roofline collapse: "
                        + ", ".join(str(d) for d in collapsed))
+    if "shed_storm" in anomalies:
+        # the serving plane is rejecting a large fraction of recent
+        # submissions (admission control, quotas, or injected faults):
+        # DEGRADED — capacity or quota tuning, not engine corruption
+        status = DEGRADED
+        reasons.append(
+            "active shed storm: the serving plane shed more than "
+            f"{_threshold('DBCSR_TPU_HEALTH_SHED_RATE', 0.25):.0%} of "
+            "the last admission window — raise quotas/queue bound or "
+            "add capacity (docs/serving.md#shed-storms)")
     pool = {}
     try:
         from dbcsr_tpu.core import mempool

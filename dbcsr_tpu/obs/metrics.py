@@ -106,6 +106,18 @@ def counter(name: str, help: str = "") -> Counter:
         return c
 
 
+def counter_items(name: str) -> list:
+    """Public enumeration of one counter's ``(labels_dict, value)``
+    pairs — the supported way to read a labelled counter back out
+    without binding to the registry's internal label-key encoding.
+    Empty when the counter never incremented."""
+    with _lock:
+        c = _counters.get(name)
+        if c is None:
+            return []
+        return [(dict(k), float(v)) for k, v in c.values.items()]
+
+
 def gauge(name: str, help: str = "") -> Gauge:
     with _lock:
         g = _gauges.get(name)

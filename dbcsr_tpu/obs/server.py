@@ -17,6 +17,18 @@ route                 payload
 ``/flight``           the flight-recorder ring (`flight.records()`)
 ``/events``           the event-bus ring; filters ``?product_id=…``,
                       ``?kind=…``, ``?limit=N``
+``/serve/submit``     POST one serving-plane request (JSON body:
+                      ``session``, ``a``/``b``/``c`` matrix names,
+                      ``alpha``/``beta``/``op``/``priority``/
+                      ``deadline_s``; optional ``wait`` +
+                      ``timeout_s``); 503 when no engine runs, 429
+                      with the structured rejection when shed
+``/serve/status``     serving-plane snapshot (queue depth, in-flight,
+                      coalescing/quota config); ``?request_id=…``
+                      returns one request's ticket
+``/serve/tenants``    per-tenant serving metrics: admitted/shed/
+                      deadline-missed counters, queue load, rolling
+                      p50/p95 latency
 ``/``                 route index JSON
 ====================  ==================================================
 
@@ -100,16 +112,108 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(events.records(
                     product_id=q.get("product_id", [None])[0],
                     kind=q.get("kind", [None])[0], limit=limit))
+            elif route == "/serve/status":
+                q = parse_qs(url.query)
+                self._serve_status(q.get("request_id", [None])[0])
+            elif route == "/serve/tenants":
+                eng = self._serve_engine()
+                if eng is None:
+                    return
+                self._send_json(eng.tenants())
             elif route == "/":
                 self._send_json({
                     "routes": ["/metrics", "/healthz", "/flight",
-                               "/events?product_id=&kind=&limit="],
+                               "/events?product_id=&kind=&limit=",
+                               "/serve/submit (POST)",
+                               "/serve/status?request_id=",
+                               "/serve/tenants"],
                     "process_index": _server.process_index
                     if _server else None,
                 })
             else:
                 self._send_json({"error": f"no route {route}"}, code=404)
         except Exception as exc:  # introspection must never kill the job
+            try:
+                self._send_json(
+                    {"error": f"{type(exc).__name__}: {exc}"}, code=500)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------ serving plane
+
+    def _serve_engine(self):
+        """The live serving engine, or None (a 503 was sent).  The
+        endpoint never CREATES an engine — serving is opt-in."""
+        from dbcsr_tpu.serve import engine as _serve
+
+        eng = _serve.current_engine()
+        if eng is None:
+            self._send_json(
+                {"error": "serving plane not running "
+                          "(dbcsr_tpu.serve.get_engine() starts it)"},
+                code=503)
+        return eng
+
+    def _serve_status(self, request_id):
+        eng = self._serve_engine()
+        if eng is None:
+            return
+        if request_id:
+            req = eng.get_request(request_id)
+            if req is None:
+                self._send_json(
+                    {"error": f"unknown request {request_id}"}, code=404)
+                return
+            self._send_json(req.info())
+            return
+        self._send_json(eng.status())
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        try:
+            url = urlparse(self.path)
+            route = url.path.rstrip("/")
+            if route != "/serve/submit":
+                self._send_json({"error": f"no POST route {route}"},
+                                code=404)
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except ValueError:
+                self._send_json({"error": "bad JSON body"}, code=400)
+                return
+            eng = self._serve_engine()
+            if eng is None:
+                return
+            from dbcsr_tpu.serve import session as _session
+
+            sess = _session.get_session(str(body.get("session", "")))
+            if sess is None:
+                self._send_json(
+                    {"error": f"unknown session {body.get('session')!r}"},
+                    code=404)
+                return
+            params = {k: body[k] for k in
+                      ("a", "b", "c", "p", "alpha", "beta", "transa",
+                       "transb", "filter_eps", "retain_sparsity", "steps",
+                       "out")
+                      if k in body}
+            try:
+                req = eng.submit(
+                    sess, op=str(body.get("op", "multiply")),
+                    priority=int(body.get("priority", 10)),
+                    deadline_s=body.get("deadline_s"), **params)
+            except KeyError as exc:  # unregistered matrix name
+                self._send_json({"error": str(exc.args[0])}, code=404)
+                return
+            except ValueError as exc:  # unknown op
+                self._send_json({"error": str(exc)}, code=400)
+                return
+            if body.get("wait"):
+                req.wait(timeout=float(body.get("timeout_s", 30.0)))
+            info = req.info()
+            self._send_json(info, code=429 if req.state == "shed" else 200)
+        except Exception as exc:  # the submit path must never kill the job
             try:
                 self._send_json(
                     {"error": f"{type(exc).__name__}: {exc}"}, code=500)

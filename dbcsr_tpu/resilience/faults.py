@@ -26,6 +26,16 @@ site                      boundary
                           ``tick``) — a fault here degrades the
                           multiply to the serial fused program
 ``probe``                 `bench._probe_tpu`
+``serve_admit``           `serve.queue.AdmissionQueue.admit` — a fault
+                          here sheds the submission with a structured
+                          rejection (labels: ``tenant``,
+                          ``request_id``)
+``serve_execute``         the serving worker's group-execution
+                          boundary (`serve.engine`) — a fault on a
+                          coalesced group degrades it to serialized
+                          per-request execution; on a lone request it
+                          fails that request TRANSIENT (labels:
+                          ``request_id``, ``n``)
 ========================  ====================================================
 
 A spec's *target* matches either the site name or a label value (the

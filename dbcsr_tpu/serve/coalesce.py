@@ -1,0 +1,213 @@
+"""Cross-request coalescing: N same-structure products, one dispatch set.
+
+The fused-superstack plan cache (PR 4) keys stack plans by pattern
+fingerprints — two tenants multiplying the same sparsity pattern
+already share the PLAN.  This module makes them share the LAUNCHES:
+requests whose `coalesce_key` matches (identical A/B/C pattern
+fingerprints + dtypes, scalars, trans flags, options) and that arrive
+within the batching window are assembled into ONE block-diagonal
+composite product
+
+    diag(A_1..A_N) @ diag(B_1..B_N) = diag(C_1..C_N)
+
+and executed as a single engine multiply: the composite has exactly
+the same C shape-bins as one request, so the whole group pays ONE
+fused superstack dispatch set (`dbcsr_tpu_dispatches_total` drops from
+N sets to ~1), then each tenant's C is carved back out on device.
+
+**Bitwise identity** (pinned by `tests/test_serve.py`): the composite
+keys sort product-major, so each C block's accumulation sequence —
+the sort by (C block, A entry) inside `mm.multiply._run_stacks` — is
+exactly the standalone request's sequence; chunking at a different
+``mm_stack_size`` boundary only splits the same ordered sequence of
+scatter-adds.  The carve is a pure `jnp.take` row copy.  See
+docs/serving.md for the caveat on what is NOT coalescable.
+
+Coalescable = ``multiply`` requests on non-symmetric operands with no
+filter_eps (the norm filter is value-dependent), no block/element
+limits, matching alpha/beta, and every operand finalized.  Everything
+else runs serialized — correctness never depends on the window.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from dbcsr_tpu.core.matrix import (
+    NO_SYMMETRY,
+    BlockSparseMatrix,
+    _Bin,
+    _bin_entries,
+)
+from dbcsr_tpu.utils.rounding import bucket_size
+
+
+class Unrecoverable(RuntimeError):
+    """A coalesced group failed AFTER the carve started writing target
+    C matrices with beta != 0: the serialized failover replay is no
+    longer exact (beta would re-scale an already-written C), so the
+    engine must fail the group instead of degrading it."""
+
+
+def coalesce_key(op: str, params: dict) -> Optional[tuple]:
+    """The cross-request batching key, or None when the request must
+    run serialized.  Two requests with equal keys are guaranteed
+    assemblable into one block-diagonal composite."""
+    if op != "multiply":
+        return None
+    if params.get("filter_eps") is not None:
+        return None
+    if params.get("retain_sparsity"):
+        return None
+    for lim in ("first_row", "last_row", "first_col", "last_col",
+                "first_k", "last_k", "element_limits"):
+        if params.get(lim) is not None:
+            return None
+    a, b, c = params["a"], params["b"], params["c"]
+    for m in (a, b, c):
+        if not isinstance(m, BlockSparseMatrix) or not m.valid:
+            return None
+        if m.matrix_type != NO_SYMMETRY:
+            return None  # desymmetrize is per-request, not block-diag
+    try:
+        alpha = complex(params.get("alpha", 1.0))
+        beta = complex(params.get("beta", 0.0))
+    except TypeError:
+        return None
+    return (
+        str(params.get("transa", "N")).upper(),
+        str(params.get("transb", "N")).upper(),
+        alpha, beta,
+        a.pattern_fingerprint(), b.pattern_fingerprint(),
+        c.pattern_fingerprint(),
+        str(np.dtype(a.dtype)), str(np.dtype(b.dtype)),
+        str(np.dtype(c.dtype)),
+    )
+
+
+def _composite(mats: List[BlockSparseMatrix],
+               name: str) -> BlockSparseMatrix:
+    """Block-diagonal composite of N same-pattern matrices, assembled
+    on device: per shape-bin, the composite's data is the p-ordered
+    concatenation of each source bin's live rows (composite slot of
+    source entry e of product p is ``p * count + slot(e)`` because
+    composite keys sort product-major and `_bin_entries` assigns slots
+    in key order)."""
+    import jax.numpy as jnp
+
+    m0 = mats[0]
+    n = len(mats)
+    nbr, nbc = m0.nblkrows, m0.nblkcols
+    rs = np.tile(m0.row_blk_sizes, n)
+    cs = np.tile(m0.col_blk_sizes, n)
+    comp = BlockSparseMatrix(name, rs, cs, m0.dtype)
+    if m0.nblks == 0:
+        comp.valid = True
+        return comp
+    rows0, cols0 = m0.entry_coords()
+    nnbc = n * nbc
+    keys = np.concatenate([
+        (p * nbr + rows0) * nnbc + (p * nbc + cols0) for p in range(n)
+    ])
+    rows = (keys // nnbc).astype(np.int64)
+    cols = (keys % nnbc).astype(np.int64)
+    nb, nsl, shapes = _bin_entries(rs, cs, rows, cols)
+    bins = []
+    for bm, bn in shapes:
+        ob = m0._shape_to_bin[(int(bm), int(bn))]
+        cnt = m0.bins[ob].count
+        total = cnt * n
+        parts = [m.bins[m._shape_to_bin[(int(bm), int(bn))]].data[:cnt]
+                 for m in mats]
+        cap = bucket_size(total)
+        if cap > total:
+            parts.append(jnp.zeros((cap - total, int(bm), int(bn)),
+                                   m0.dtype))
+        data = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        bins.append(_Bin((int(bm), int(bn)), data, total))
+    comp.set_structure_from_device(keys, bins, binning=(nb, nsl, shapes))
+    return comp
+
+
+def _split_composite(comp: BlockSparseMatrix,
+                     targets: List[BlockSparseMatrix]) -> None:
+    """Carve the composite product back into each request's C matrix
+    (pure on-device row copies).  The block-diagonal structure is an
+    invariant of the product — A's p-stripe rows only meet B's p-stripe
+    columns — asserted here, never assumed."""
+    import jax.numpy as jnp
+
+    from dbcsr_tpu.core import mempool
+
+    n = len(targets)
+    t0 = targets[0]
+    nbr, nbc = t0.nblkrows, t0.nblkcols
+    nnbc = n * nbc
+    rows = (comp.keys // nnbc).astype(np.int64)
+    cols = (comp.keys % nnbc).astype(np.int64)
+    p_row = rows // nbr
+    p_col = cols // nbc
+    if not np.array_equal(p_row, p_col):  # pragma: no cover - invariant
+        raise RuntimeError("coalesced product left the block diagonal")
+    for p, c in enumerate(targets):
+        sel = np.nonzero(p_row == p)[0]
+        local_keys = (rows[sel] - p * nbr) * nbc + (cols[sel] - p * nbc)
+        lrows = (local_keys // nbc).astype(np.int64)
+        lcols = (local_keys % nbc).astype(np.int64)
+        nb, nsl, shapes = _bin_entries(c.row_blk_sizes, c.col_blk_sizes,
+                                       lrows, lcols)
+        bins = []
+        for b_id, (bm, bn) in enumerate(shapes):
+            esel = sel[nb == b_id]
+            cnt = len(esel)
+            src_bin = comp.bins[comp.ent_bin[esel[0]]]
+            idx = np.empty(cnt, np.int64)
+            idx[nsl[nb == b_id]] = comp.ent_slot[esel]
+            data = jnp.take(src_bin.data,
+                            mempool.upload_index("serve_split", idx),
+                            axis=0)
+            cap = bucket_size(cnt)
+            if cap > cnt:
+                data = jnp.concatenate([
+                    data, jnp.zeros((cap - cnt, int(bm), int(bn)),
+                                    data.dtype)])
+            bins.append(_Bin((int(bm), int(bn)), data, cnt))
+        c.set_structure_from_device(local_keys, bins,
+                                    binning=(nb, nsl, shapes))
+
+
+def execute_coalesced(requests: list) -> List[int]:
+    """Execute a group of coalesce-key-equal multiply requests as one
+    block-diagonal composite multiply; returns per-request true flops
+    (the composite's, split evenly — each request's product is the
+    same structure).  Raising before the final carve leaves every
+    request's C untouched (the engine's failover-to-serialized
+    contract)."""
+    from dbcsr_tpu.core import mempool
+    from dbcsr_tpu.mm.multiply import multiply
+
+    p0 = requests[0].params
+    with mempool.chain() as ch:
+        ca = _composite([r.params["a"] for r in requests], "serve:A")
+        cb = _composite([r.params["b"] for r in requests], "serve:B")
+        cc = _composite([r.params["c"] for r in requests], "serve:C")
+        flops = multiply(
+            p0.get("transa", "N"), p0.get("transb", "N"),
+            p0.get("alpha", 1.0), ca, cb, p0.get("beta", 0.0), cc,
+        )
+        try:
+            _split_composite(cc, [r.params["c"] for r in requests])
+        except Exception as exc:
+            if complex(p0.get("beta", 0.0)) != 0:
+                raise Unrecoverable(
+                    f"carve failed mid-group with beta != 0: "
+                    f"{type(exc).__name__}: {exc}") from exc
+            raise
+        # composite temporaries retire explicitly so their (large)
+        # bins feed the next window's checkouts immediately
+        for m in (ca, cb, cc):
+            ch.retire(m)
+    share = flops // len(requests)
+    return [share] * len(requests)

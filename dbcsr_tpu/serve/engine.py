@@ -1,0 +1,456 @@
+"""The serving-plane worker: single-writer execution over the engine.
+
+Sessions (client threads) are producers; ONE worker thread drains the
+admission queue and drives `mm.multiply` — the engine stays
+single-writer, so none of the multiply machinery (plan caches, memory
+pool chains, flight records) needs to become re-entrant.
+
+Per popped request the worker gathers the batching window: queued
+requests with the same `coalesce.coalesce_key` arriving within
+``serve_window_ms`` (up to ``serve_coalesce_max``) join the group and
+execute as one block-diagonal composite multiply.  A coalesced
+failure — injected at the ``serve_execute`` fault site or real —
+fails over to serialized per-request execution (the group's C
+matrices are untouched until the final carve, so the replay is safe),
+publishing ``serve_degrade``; a serialized failure fails only its own
+request (``serve_failed``, watchdog-classified TRANSIENT).
+
+Correlation: every serve event carries the ``request_id``; the
+multiply itself opens its usual ``product_id`` scope, and the worker
+publishes ``serve_execute`` records binding request ids to the group
+so the doctor/chaos tooling can join both planes.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dbcsr_tpu.resilience import faults as _faults
+from dbcsr_tpu.resilience.watchdog import WEDGED
+from dbcsr_tpu.serve import coalesce as _coalesce
+from dbcsr_tpu.serve.queue import AdmissionQueue, Rejected, Request, classify
+from dbcsr_tpu.serve.session import Session
+
+_lock = threading.Lock()
+_engine: "ServeEngine | None" = None
+
+# request ops the engine executes; "multiply" is the only coalescable
+# one — the iterative model chains run serialized inside the worker
+OPS = ("multiply", "purify", "sign", "invsqrt")
+
+
+class ServeEngine:
+    """One serving plane: admission queue + worker thread + stats."""
+
+    def __init__(self, start: bool = True):
+        self.queue = AdmissionQueue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._inflight = 0
+        self._slock = threading.Lock()
+        # finished-request lookup for /serve/status (bounded)
+        self._requests: "collections.OrderedDict[str, Request]" = \
+            collections.OrderedDict()
+        # per-tenant rolling latencies (exact p50/p95 for /serve/tenants)
+        self._lat: Dict[str, collections.deque] = {}
+        self._counts: Dict[str, collections.Counter] = {}
+        self.t_start = time.time()
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="dbcsr-tpu-serve-worker", daemon=True)
+        self._thread.start()
+
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def shutdown(self, timeout: float = 10.0, drain: bool = True) -> None:
+        """Stop the worker; with ``drain`` (default) queued requests
+        are executed first, otherwise they fail WEDGED."""
+        if drain:
+            t0 = time.time()
+            while self.queue.depth() and time.time() - t0 < timeout \
+                    and self.running():
+                time.sleep(0.01)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        while True:
+            req = self.queue.pop(timeout=0)
+            if req is None:
+                break
+            self.queue.release(req)
+            req._finish("failed", outcome=WEDGED,
+                        error="serving plane shut down")
+
+    # --------------------------------------------------------------- submit
+
+    def open_session(self, tenant: str, name: Optional[str] = None) -> Session:
+        return Session(tenant, name=name)
+
+    def submit(self, session: Session, op: str = "multiply",
+               priority: int = 10, deadline_s: Optional[float] = None,
+               **params) -> Request:
+        """Submit one request.  Matrix params (``a``/``b``/``c``) may
+        be `BlockSparseMatrix` objects or names registered in the
+        session.  Returns the `Request` ticket — on admission
+        rejection the ticket comes back already terminal
+        (``state == "shed"``) instead of raising, so many-client
+        drivers handle shedding uniformly.  Malformed submissions
+        (unknown ``op`` -> ValueError, unregistered matrix name ->
+        KeyError) raise before a ticket exists — client errors, not
+        admission decisions (the HTTP route maps them to 400/404)."""
+        if op not in OPS:
+            raise ValueError(f"unknown serve op {op!r} (one of {OPS})")
+        params = dict(params)
+        for key in ("a", "b", "c", "p"):
+            if isinstance(params.get(key), str):
+                params[key] = session.get(params[key])
+        req = Request(session, op, params, priority=priority,
+                      deadline_s=deadline_s)
+        req.nbytes = self._operand_bytes(params)
+        req.ckey = _coalesce.coalesce_key(op, params)
+        from dbcsr_tpu.obs import events as _events
+
+        _events.publish("serve_submitted", {
+            "request_id": req.request_id, "tenant": req.tenant,
+            "op": op, "priority": req.priority,
+            "coalescable": req.ckey is not None})
+        self._remember(req)
+        try:
+            self.queue.admit(req)
+        except Rejected:
+            pass  # the ticket carries the structured rejection
+        return req
+
+    def _operand_bytes(self, params: dict) -> int:
+        total = 0
+        for key in ("a", "b", "c", "p"):
+            m = params.get(key)
+            if m is not None and hasattr(m, "get_data_size"):
+                total += (m.get_data_size()
+                          * np.dtype(m.dtype).itemsize)
+        return total
+
+    def _remember(self, req: Request) -> None:
+        with self._slock:
+            self._requests[req.request_id] = req
+            while len(self._requests) > 1024:
+                self._requests.popitem(last=False)
+
+    def get_request(self, request_id: str) -> Optional[Request]:
+        with self._slock:
+            return self._requests.get(request_id)
+
+    # ---------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        from dbcsr_tpu.core.config import get_config
+
+        while not self._stop.is_set():
+            req = self.queue.pop(timeout=0.1)
+            if req is None:
+                continue
+            cfg = get_config()
+            group = [req]
+            if (cfg.serve_coalesce and req.ckey is not None
+                    and cfg.serve_coalesce_max > 1):
+                deadline = time.time() + cfg.serve_window_ms / 1e3
+                while len(group) < cfg.serve_coalesce_max:
+                    nxt = self.queue.pop_matching(
+                        req.ckey, timeout=deadline - time.time())
+                    if nxt is None:
+                        break
+                    group.append(nxt)
+            with self._slock:
+                self._inflight += len(group)
+            try:
+                self._execute_group(group)
+            finally:
+                with self._slock:
+                    self._inflight -= len(group)
+                for r in group:
+                    self.queue.release(r)
+
+    def _execute_group(self, group: List[Request]) -> None:
+        from dbcsr_tpu.obs import events as _events
+        from dbcsr_tpu.obs import metrics as _metrics
+
+        ids = [r.request_id for r in group]
+        coalesced = len(group) > 1 and self._group_coalescable(group)
+        _events.publish("serve_execute", {
+            "request_ids": ",".join(ids), "n": len(group),
+            "tenants": ",".join(sorted({r.tenant for r in group})),
+            "mode": "coalesced" if coalesced else "serialized"})
+        if _faults.active():
+            try:
+                _faults.maybe_inject("serve_execute",
+                                     request_id=ids[0], n=str(len(group)))
+            except Exception as exc:
+                # group-level fault: with a group this is the coalesced
+                # launch failing -> degrade to serialized below; a lone
+                # request fails TRANSIENT like any execution error
+                if coalesced:
+                    self._degrade(group, exc)
+                    coalesced = False
+                else:
+                    self._fail(group[0], exc)
+                    if len(group) == 1:
+                        return
+                    # the rest of a serialized group still runs — a
+                    # request must never be left non-terminal
+        if coalesced:
+            try:
+                flops = _coalesce.execute_coalesced(group)
+            except _coalesce.Unrecoverable as exc:
+                # the carve already wrote some target Cs and beta != 0:
+                # a serialized replay would re-apply beta to a C that
+                # is no longer the submitted one — fail, never corrupt
+                for r in group:
+                    self._fail(r, exc)
+                return
+            except Exception as exc:
+                # the composite never touched the per-request Cs (the
+                # carve is the last step, and a partial carve raises
+                # Unrecoverable above), so the serialized replay is
+                # exact — mid-request failover, not request death
+                self._degrade(group, exc)
+            else:
+                _metrics.counter(
+                    "dbcsr_tpu_serve_coalesced_total",
+                    "request groups executed as one block-diagonal "
+                    "composite multiply, by group size",
+                ).inc(group_size=str(len(group)))
+                for r, f in zip(group, flops):
+                    self._finish_ok(r, {"flops": int(f),
+                                        "coalesced": len(group)})
+                return
+        for r in group:
+            if r.done:
+                continue  # already failed by a group-level fault
+            try:
+                result = self._execute_one(r)
+                self._finish_ok(r, result)
+            except Exception as exc:
+                self._fail(r, exc)
+
+    def _group_coalescable(self, group: List[Request]) -> bool:
+        """A group is only safe to assemble when no request's C object
+        appears anywhere else in the group — as another request's C
+        (two products racing into one destination) or as any A/B
+        operand (a later request reading a C the composite is about to
+        overwrite would see the pre-multiply values).  Serialized
+        execution in submit order is the reference semantics."""
+        cs = [id(r.params.get("c")) for r in group]
+        if len(set(cs)) < len(group):
+            return False
+        c_ids = set(cs)
+        for r in group:
+            for key in ("a", "b"):
+                if id(r.params.get(key)) in c_ids:
+                    return False
+        return True
+
+    def _degrade(self, group: List[Request], exc: Exception) -> None:
+        from dbcsr_tpu.obs import events as _events
+        from dbcsr_tpu.obs import metrics as _metrics
+
+        _metrics.counter(
+            "dbcsr_tpu_serve_degrade_total",
+            "coalesced groups that failed and were re-executed "
+            "serialized (mid-request failover)",
+        ).inc()
+        _events.publish("serve_degrade", {
+            "request_ids": ",".join(r.request_id for r in group),
+            "n": len(group), "reason": "coalesce_failover",
+            "error": f"{type(exc).__name__}: {exc}"[:200]})
+
+    def _execute_one(self, req: Request) -> dict:
+        from dbcsr_tpu.core import mempool
+        from dbcsr_tpu.mm.multiply import multiply
+
+        p = req.params
+        if req.op == "multiply":
+            flops = multiply(
+                p.get("transa", "N"), p.get("transb", "N"),
+                p.get("alpha", 1.0), p["a"], p["b"],
+                p.get("beta", 0.0), p["c"],
+                retain_sparsity=bool(p.get("retain_sparsity", False)),
+                filter_eps=p.get("filter_eps"),
+            )
+            return {"flops": int(flops), "coalesced": 0}
+        # iterative model chains: the per-step temporaries recycle
+        # through the models' own mempool chains; the result lands in
+        # the session under params["out"]
+        steps = int(p.get("steps", 1))
+        src = p["a"] if "a" in p else p["p"]
+        filter_eps = p.get("filter_eps")
+        if req.op == "purify":
+            from dbcsr_tpu.models.purify import mcweeny_step
+
+            with mempool.chain() as ch:
+                cur = src
+                for _ in range(steps):
+                    nxt = mcweeny_step(cur, filter_eps=filter_eps)
+                    if cur is not src:
+                        ch.retire(cur)
+                    cur = nxt
+                ch.detach(cur)
+            out, extra = cur, {"steps": steps}
+        elif req.op == "sign":
+            from dbcsr_tpu.models.sign import sign_iteration
+
+            out, hist = sign_iteration(src, steps=steps,
+                                       filter_eps=filter_eps)
+            extra = {"steps": len(hist)}
+        else:  # invsqrt
+            from dbcsr_tpu.models.invsqrt import invsqrt_iteration
+
+            out, sf, iters = invsqrt_iteration(src, max_iter=steps,
+                                               filter_eps=filter_eps)
+            extra = {"iterations": iters, "scale_factor": sf}
+        out_name = p.get("out", f"{req.op}_out")
+        req.session.put(out_name, out)
+        return dict(extra, out=out_name, coalesced=0)
+
+    # ---------------------------------------------------------- accounting
+
+    def _finish_ok(self, req: Request, result: dict) -> None:
+        from dbcsr_tpu.obs import events as _events
+
+        req.error = None
+        outcome = classify(req)
+        req._finish("done", outcome=outcome, result=result)
+        self._record(req, "done")
+        _events.publish("serve_done", {
+            "request_id": req.request_id, "tenant": req.tenant,
+            "outcome": outcome,
+            "latency_ms": req.info()["latency_ms"],
+            "coalesced": result.get("coalesced", 0)})
+
+    def _fail(self, req: Request, exc: Exception) -> None:
+        from dbcsr_tpu.obs import events as _events
+
+        err = f"{type(exc).__name__}: {exc}"[:300]
+        req.error = err
+        req._finish("failed", outcome=classify(req), error=err)
+        self._record(req, "failed")
+        _events.publish("serve_failed", {
+            "request_id": req.request_id, "tenant": req.tenant,
+            "error": err})
+
+    def _record(self, req: Request, outcome: str) -> None:
+        from dbcsr_tpu.obs import metrics as _metrics
+
+        lat_ms = (req.t_done - req.t_submit) * 1e3
+        with self._slock:
+            self._lat.setdefault(
+                req.tenant, collections.deque(maxlen=512)).append(lat_ms)
+            self._counts.setdefault(
+                req.tenant, collections.Counter())[outcome] += 1
+        _metrics.counter(
+            "dbcsr_tpu_serve_requests_total",
+            "serving-plane requests by tenant and admission/terminal "
+            "outcome",
+        ).inc(tenant=req.tenant, outcome=outcome)
+        _metrics.histogram(
+            "dbcsr_tpu_serve_latency_ms",
+            "request latency (submit to terminal state) per tenant",
+            buckets=(1, 5, 10, 50, 100, 500, 1000, 5000, 30000),
+        ).observe(lat_ms, tenant=req.tenant)
+
+    # -------------------------------------------------------------- surface
+
+    def status(self) -> dict:
+        from dbcsr_tpu.core.config import get_config
+        from dbcsr_tpu.serve import session as _session
+
+        cfg = get_config()
+        with self._slock:
+            inflight = self._inflight
+        return {
+            "running": self.running(),
+            "queue_depth": self.queue.depth(),
+            "inflight": inflight,
+            "sessions": len(_session.sessions()),
+            "uptime_s": round(time.time() - self.t_start, 3),
+            "coalesce": {
+                "enabled": bool(cfg.serve_coalesce),
+                "window_ms": cfg.serve_window_ms,
+                "max_group": cfg.serve_coalesce_max,
+            },
+            "quotas": {
+                "queue_max": cfg.serve_queue_max,
+                "tenant_inflight": cfg.serve_tenant_inflight,
+                "tenant_bytes": cfg.serve_tenant_bytes,
+            },
+        }
+
+    def tenants(self) -> dict:
+        """Per-tenant serving metrics: admission/terminal counters off
+        the metrics registry (shared with /metrics scrapes), queue
+        load, and exact rolling p50/p95 latency."""
+        from dbcsr_tpu.obs import metrics as _metrics
+
+        out: dict = {}
+        for lab, v in _metrics.counter_items(
+                "dbcsr_tpu_serve_requests_total"):
+            t = lab.get("tenant", "?")
+            out.setdefault(t, {})[lab.get("outcome", "?")] = int(v)
+        for lab, v in _metrics.counter_items("dbcsr_tpu_serve_shed_total"):
+            ent = out.setdefault(lab.get("tenant", "?"), {})
+            ent.setdefault("shed_by_reason", {})[
+                lab.get("reason", "?")] = int(v)
+        for lab, v in _metrics.counter_items(
+                "dbcsr_tpu_serve_deadline_missed_total"):
+            out.setdefault(lab.get("tenant", "?"), {})[
+                "deadline_missed"] = int(v)
+        load = self.queue.tenant_load()
+        with self._slock:
+            lats = {t: sorted(d) for t, d in self._lat.items() if d}
+        for t, ent in out.items():
+            ent.update(load.get(t, {}))
+            xs = lats.get(t)
+            if xs:
+                ent["p50_ms"] = round(xs[len(xs) // 2], 3)
+                ent["p95_ms"] = round(
+                    xs[min(len(xs) - 1, int(len(xs) * 0.95))], 3)
+        return out
+
+
+# ----------------------------------------------------------- module API
+
+def get_engine(start: bool = True) -> ServeEngine:
+    """The process's default serving plane (created on first use)."""
+    global _engine
+    with _lock:
+        if _engine is None:
+            _engine = ServeEngine(start=start)
+        elif start and not _engine.running():
+            _engine.start()
+        return _engine
+
+
+def current_engine() -> Optional[ServeEngine]:
+    return _engine
+
+
+def shutdown(timeout: float = 10.0) -> None:
+    global _engine
+    with _lock:
+        eng = _engine
+        _engine = None
+    if eng is not None:
+        eng.shutdown(timeout=timeout)

@@ -1,0 +1,161 @@
+"""Serving sessions: tenant identity + a chain-backed matrix scope.
+
+A `Session` is what a tenant holds between requests: a registry of
+named `BlockSparseMatrix` objects whose device storage is owned by a
+`core.mempool.chain` scope private to the session.  The chain is used
+OBJECT-style (explicit `adopt`), never entered on the thread-local
+chain stack — so a session built on one client thread can never adopt
+matrices another tenant's thread is constructing (the cross-tenant
+isolation the thread-local chain stack of PR 6 was built for), and
+`close()` frees exactly this session's buffers back to the pool.
+
+Matrices created through `Session.create`/`Session.random` are adopted
+automatically; matrices built elsewhere join via `put(..., adopt=True)`
+(default) or stay caller-owned with ``adopt=False``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from dbcsr_tpu.core import mempool
+from dbcsr_tpu.core.matrix import NO_SYMMETRY, BlockSparseMatrix
+
+_lock = threading.Lock()
+_sessions: Dict[str, "Session"] = {}
+_seq = itertools.count(1)
+
+
+class Session:
+    """One tenant's serving scope (see module docstring)."""
+
+    def __init__(self, tenant: str, name: Optional[str] = None,
+                 register: bool = True):
+        self.tenant = str(tenant)
+        self.session_id = name or f"{self.tenant}-{next(_seq)}"
+        self.t_open = time.time()
+        self.closed = False
+        self._matrices: Dict[str, BlockSparseMatrix] = {}
+        # explicit-adopt chain: NEVER entered as a context manager here
+        # (entering pushes it on the calling thread's chain stack and
+        # it would adopt every matrix any code on that thread creates)
+        self._chain = mempool.chain()
+        self._mlock = threading.Lock()
+        if register:
+            with _lock:
+                _sessions[self.session_id] = self
+
+    # ------------------------------------------------------------ matrices
+
+    def put(self, name: str, matrix: BlockSparseMatrix,
+            adopt: bool = True) -> BlockSparseMatrix:
+        """Register ``matrix`` under ``name``; with ``adopt`` (default)
+        the session's chain takes pool ownership (freed at `close`)."""
+        self._check_open()
+        with self._mlock:
+            if adopt:
+                self._chain.adopt(matrix)
+            self._matrices[name] = matrix
+        return matrix
+
+    def get(self, name: str) -> BlockSparseMatrix:
+        with self._mlock:
+            m = self._matrices.get(name)
+        if m is None:
+            raise KeyError(
+                f"session {self.session_id!r} has no matrix {name!r}")
+        return m
+
+    def matrices(self) -> Dict[str, BlockSparseMatrix]:
+        with self._mlock:
+            return dict(self._matrices)
+
+    def create(self, name: str, row_blk_sizes, col_blk_sizes,
+               dtype=np.float64,
+               matrix_type: str = NO_SYMMETRY) -> BlockSparseMatrix:
+        """A fresh empty matrix registered under ``name`` and adopted
+        by this session's chain."""
+        self._check_open()
+        m = BlockSparseMatrix(f"{self.session_id}:{name}", row_blk_sizes,
+                              col_blk_sizes, dtype,
+                              matrix_type=matrix_type)
+        return self.put(name, m)
+
+    def random(self, name: str, row_blk_sizes, col_blk_sizes,
+               dtype=np.float64, occupation: float = 0.5,
+               seed: int = 0) -> BlockSparseMatrix:
+        """A random finalized matrix (test/bench convenience; the
+        deterministic per-(session, seed) generator many-client drivers
+        use to build same-pattern different-value workloads)."""
+        from dbcsr_tpu.ops.test_methods import make_random_matrix
+
+        self._check_open()
+        m = make_random_matrix(
+            f"{self.session_id}:{name}", row_blk_sizes, col_blk_sizes,
+            dtype=dtype, occupation=occupation,
+            rng=np.random.default_rng(seed))
+        return self.put(name, m)
+
+    def drop(self, name: str) -> None:
+        """Free one matrix now (its buffers return to the pool)."""
+        with self._mlock:
+            m = self._matrices.pop(name, None)
+        if m is not None:
+            self._chain.retire(m)
+
+    def bytes_held(self) -> int:
+        """Device bytes of this session's registered matrices."""
+        itemsize_of = np.dtype
+        with self._mlock:
+            return int(sum(
+                m.get_data_size() * itemsize_of(m.dtype).itemsize
+                for m in self._matrices.values()))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _check_open(self) -> None:
+        if self.closed:
+            raise RuntimeError(f"session {self.session_id!r} is closed")
+
+    def close(self) -> None:
+        """Free every session-owned matrix back to the pool and
+        unregister.  Idempotent; caller-owned (``adopt=False``)
+        matrices are left untouched."""
+        if self.closed:
+            return
+        self.closed = True
+        with self._mlock:
+            self._matrices.clear()
+        # the chain was never __enter__'d: free its adoptees directly
+        self._chain.__exit__(None, None, None)
+        with _lock:
+            _sessions.pop(self.session_id, None)
+
+    def __enter__(self) -> "Session":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False
+
+    def __repr__(self) -> str:
+        return (f"Session({self.session_id!r}, tenant={self.tenant!r}, "
+                f"{len(self._matrices)} matrices"
+                f"{', closed' if self.closed else ''})")
+
+
+def get_session(session_id: str) -> Optional[Session]:
+    """Registry lookup (the HTTP submit route resolves sessions by
+    id); None when unknown or closed."""
+    with _lock:
+        return _sessions.get(session_id)
+
+
+def sessions() -> Dict[str, Session]:
+    with _lock:
+        return dict(_sessions)

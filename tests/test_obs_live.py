@@ -349,21 +349,34 @@ def test_doctor_artifact_mode_from_sink(tmp_path):
 
 def test_doctor_runbook_anchors_exist():
     """Every hint's runbook anchor must resolve to a real heading in
-    docs/resilience.md (GitHub anchor convention)."""
+    its runbook doc (GitHub anchor convention): docs/resilience.md by
+    default, docs/serving.md for the serving-plane hints (whose
+    anchors carry the full "docs/…" path)."""
     import re
 
-    md = open(os.path.join(_REPO, "docs", "resilience.md")).read()
-    anchors = set()
-    for line in md.splitlines():
-        m = re.match(r"^(#+)\s+(.*)$", line)
-        if m:
-            a = m.group(2).lower().strip()
-            a = re.sub(r"[^\w\s-]", "", a)
-            # GitHub maps EACH space to a hyphen (no collapsing):
-            # "failover + breakers" -> "failover--breakers"
-            anchors.add("#" + a.replace(" ", "-"))
+    def anchors_of(doc):
+        md = open(os.path.join(_REPO, "docs", doc)).read()
+        anchors = set()
+        for line in md.splitlines():
+            m = re.match(r"^(#+)\s+(.*)$", line)
+            if m:
+                a = m.group(2).lower().strip()
+                a = re.sub(r"[^\w\s-]", "", a)
+                # GitHub maps EACH space to a hyphen (no collapsing):
+                # "failover + breakers" -> "failover--breakers"
+                anchors.add("#" + a.replace(" ", "-"))
+        return anchors
+
+    docs = {"resilience.md": anchors_of("resilience.md"),
+            "serving.md": anchors_of("serving.md")}
     for kind, (_, anchor) in doctor.HINTS.items():
-        assert anchor in anchors, (kind, anchor, sorted(anchors))
+        if anchor.startswith("docs/"):
+            doc, frag = anchor[len("docs/"):].split("#", 1)
+            assert "#" + frag in docs[doc], (kind, anchor,
+                                             sorted(docs[doc]))
+        else:
+            assert anchor in docs["resilience.md"], (
+                kind, anchor, sorted(docs["resilience.md"]))
 
 
 # -------------------------------------------- multihost sink sharding

@@ -9,6 +9,8 @@ window produce a committed artifact, in tiers of increasing cost:
           ~60 s budget each) -> PERF_CAPTURES.jsonl, one line per
           kernel, written the moment each subprocess returns
   tier 2  single north-star rep (nrep=1)          -> BENCH_CAPTURES.jsonl
+          (2.5 carve/profile A/Bs, 2.7 chain A/B, 2.8 Cannon overlap
+          A/B, 2.9 many-client serve A/B — each perf_gate-checked)
   tier 3  full bench.py f64 + bf16 + f32 variants -> BENCH_CAPTURES.jsonl
   tier 4  autotuner sweep at S=100k over the priority shapes/dtypes
           (each run persists rows into the parameter table the moment
@@ -424,6 +426,66 @@ def run_overlap_tier(done: dict) -> None:
         log(f"tier2.8 gate step failed: {exc}")
 
 
+def run_serve_tier(done: dict) -> None:
+    """Tier 2.9: the many-client serving throughput A/B
+    (`tools/serve_bench.py`) — N tenant threads submitting
+    same-structure multiplies through the serving plane with
+    cross-request coalescing off (serialized control) vs on
+    (block-diagonal composite groups), results asserted bitwise
+    identical and the committed row's ``ab`` legs gated against each
+    other with tools/perf_gate.py on requests/dispatch (higher =
+    better).  CPU rows count as done: the A/B gates how many engine
+    dispatches a request costs, which the CPU world exercises for
+    real."""
+    if done.get("tier29_serve"):
+        log("tier2.9: serve A/B already captured; skipping")
+        return
+    log("tier2.9: many-client serve A/B (coalesced vs serialized)")
+    res = _guarded_run(
+        "tier2.9_serve",
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py")],
+        900, capture_output=True, text=True, cwd=REPO,
+    )
+    if res.value is None:
+        log(f"tier2.9: {res.outcome} after {res.elapsed_s:.0f}s "
+            f"({res.error})")
+        return
+    r = res.value
+    line = (r.stdout.strip().splitlines() or [""])[-1]
+    try:
+        row = json.loads(line)
+    except json.JSONDecodeError:
+        log(f"tier2.9: rc={r.returncode}, no JSON "
+            f"({(r.stderr or '')[-300:]})")
+        return
+    if r.returncode != 0:
+        log(f"tier2.9: bench failed rc={r.returncode} "
+            f"(bitwise={row.get('checksum_bitwise_match')})")
+        return
+    ab = row.get("ab") or {}
+    if not (ab.get("coalesced", {}).get("value", 0.0)
+            > ab.get("serialized", {}).get("value", 1e30)):
+        # committed rows are permanent evidence the gate test pins
+        # (strict improvement in requests/dispatch); a run that failed
+        # to show it is logged and retried next window, never banked
+        log(f"tier2.9: coalesced leg not strictly better "
+            f"({ab.get('serialized', {}).get('value')} -> "
+            f"{ab.get('coalesced', {}).get('value')}); not committing")
+        return
+    _append(BENCH_CAPTURES, dict(row, tier=2.9))
+    try:
+        g = _gate_ab(row, "serialized", "coalesced")
+        if g is None:
+            log("tier2.9 perf_gate: row has no serialized/coalesced legs")
+            return
+        log(f"tier2.9 perf_gate (coalesced vs serialized control): "
+            f"rc={g.returncode} requests/dispatch "
+            f"{ab['serialized'].get('value')}->{ab['coalesced'].get('value')}"
+            f" bitwise={row.get('checksum_bitwise_match')}")
+    except Exception as exc:  # the capture row is already banked
+        log(f"tier2.9 gate step failed: {exc}")
+
+
 def _rerun_tier3_on_new_evidence() -> None:
     """Tier 3 runs BEFORE the tier-2.5 A/Bs, so the first committed
     tier-3 artifacts use the pre-A/B defaults.  If the A/B evidence
@@ -633,6 +695,10 @@ def _artifacts_done() -> dict:
                     # CPU rows count: the overlap A/B gates dispatch
                     # scheduling, real on the virtual-device CPU world
                     done["tier28_overlap"] = True
+                if r.get("tier") == 2.9 and r.get("ab"):
+                    # CPU rows count for the same reason: the serve A/B
+                    # gates dispatches/request, a scheduling property
+                    done["tier29_serve"] = True
                 if r.get("device_fallback"):
                     continue
                 if r.get("tier") == 2:
@@ -742,6 +808,8 @@ def _attempt_tiers(st: dict) -> dict:
         run_chain_tier(done)
     if ok3 and not _past_deadline():
         run_overlap_tier(done)
+    if ok3 and not _past_deadline():
+        run_serve_tier(done)
     if ok3 and not done["tier3_f32"] and not _past_deadline():
         run_bench({"DBCSR_TPU_BENCH_DTYPE": "1"}, 1800, 3)
     st["tier3"] = ok3

@@ -50,7 +50,7 @@ import _hostdev  # noqa: E402
 _hostdev.ensure_virtual_devices(4)
 
 SITES = ("execute_stack", "prepare_stack", "dense", "xla", "xla_group",
-         "host", "pallas", "mesh_shift")
+         "host", "pallas", "mesh_shift", "serve_admit", "serve_execute")
 KINDS = ("raise", "oom", "nan")
 
 
@@ -80,6 +80,15 @@ def corpus():
         # (breaker-integrated like the fused superstack's decompose)
         ("mesh_overlap", dict(bs=[4] * 8, dtype=np.float64, occ=0.5,
                               mesh=4, cannon_overlap="double_buffer")),
+        # serving-plane case: many concurrent clients through
+        # dbcsr_tpu.serve with injected serve_admit/serve_execute
+        # faults — shed submissions are retried until admitted, a
+        # faulted coalesced group must degrade to serialized with
+        # results intact, and every shed/degrade/failure must land on
+        # the event bus with a correlated request id (asserted inside
+        # the case, plus --events for fault correlation)
+        ("serve_storm", dict(bs=[4] * 6, dtype=np.float64, occ=0.5,
+                             serve_tenants=3, serve_requests=2)),
     ]
 
 
@@ -103,9 +112,15 @@ def random_schedule(rng: random.Random) -> str:
             if have_sitewide:
                 continue
             have_sitewide = True
+        if site.startswith("serve_") and kind == "nan":
+            kind = "raise"  # serve sites have no corruptible output
         opts = [f"seed={rng.randint(0, 2**16)}"]
         if site == "execute_stack":
             opts.append(f"times={rng.randint(1, 2)}")
+        elif site.startswith("serve_"):
+            # bounded like execute_stack: an every-call admission/
+            # execution fault starves the storm case's retry loop
+            opts.append(f"times={rng.randint(1, 3)}")
         elif rng.random() < 0.5:
             opts.append(f"times={rng.randint(1, 3)}")
         if rng.random() < 0.3:
@@ -115,12 +130,105 @@ def random_schedule(rng: random.Random) -> str:
     return ";".join(specs)
 
 
+def _serve_storm(entry: dict, seed: int) -> float:
+    """Many concurrent clients through the serving plane.  Shed or
+    failed requests are RESUBMITTED (bounded retries) — the resilience
+    contract under test is that admission faults reject loudly and
+    recover, never that work silently disappears — and the checksum
+    over every request's C must match the clean run.  Every
+    serve_shed/serve_degrade/serve_failed/serve_deadline_missed bus
+    event must carry a request id (asserted here even without
+    --events)."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from dbcsr_tpu import serve
+    from dbcsr_tpu.core.config import set_config
+    from dbcsr_tpu.obs import events as obs_events
+    from dbcsr_tpu.ops.test_methods import checksum, make_random_matrix
+
+    set_config(serve_coalesce=True, serve_window_ms=20.0)
+    bs = entry["bs"]
+    n_tenants = entry["serve_tenants"]
+    n_req = entry["serve_requests"]
+    eng = serve.ServeEngine(start=True)
+    results: dict = {}
+    failures: list = []
+    sessions: list = []
+    lock = threading.Lock()
+
+    def client(i: int) -> None:
+        try:
+            sess = eng.open_session(f"chaos-tenant{i}")
+            with lock:
+                sessions.append(sess)
+            for rep in range(n_req):
+                a = make_random_matrix(
+                    "A", bs, bs, dtype=entry["dtype"],
+                    occupation=entry["occ"],
+                    rng=np.random.default_rng(seed + 7 * rep))
+                b = make_random_matrix(
+                    "B", bs, bs, dtype=entry["dtype"],
+                    occupation=entry["occ"],
+                    rng=np.random.default_rng(seed + 7 * rep + 1))
+                c = make_random_matrix(
+                    "C", bs, bs, dtype=entry["dtype"], occupation=0.3,
+                    rng=np.random.default_rng(seed + 7 * rep + 2))
+                a.map_bin_data(lambda d: d * (1.0 + i))
+                b.map_bin_data(lambda d: d * (1.0 + 0.5 * i))
+                sess.put(f"A{rep}", a)
+                sess.put(f"B{rep}", b)
+                sess.put(f"C{rep}", c)
+                for _attempt in range(60):
+                    t = eng.submit(sess, a=f"A{rep}", b=f"B{rep}",
+                                   c=f"C{rep}", alpha=1.0, beta=0.0)
+                    if t.wait(timeout=120) and t.state == "done":
+                        break
+                    _time.sleep(0.02)  # shed/failed: retry
+                else:
+                    raise RuntimeError(
+                        f"request never served after retries: {t.info()}")
+                with lock:
+                    results[(i, rep)] = checksum(c)
+        except Exception as exc:
+            with lock:
+                failures.append(exc)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_tenants)]
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+    finally:
+        eng.shutdown()
+        for s in sessions:
+            s.close()
+    if failures:
+        raise failures[0]
+    # correlation contract: no serving-plane rejection/degrade may be
+    # anonymous on the bus
+    if obs_events.enabled():
+        for kind in ("serve_shed", "serve_degrade", "serve_failed",
+                     "serve_deadline_missed"):
+            for e in obs_events.records(kind=kind):
+                if not e.get("request_id") and not e.get("request_ids"):
+                    raise RuntimeError(
+                        f"uncorrelated {kind} event on the bus: {e}")
+    return float(sum(results[k] for k in sorted(results)))
+
+
 def _one_product(entry: dict, seed: int):
     import numpy as np
 
     from dbcsr_tpu.mm.multiply import multiply
     from dbcsr_tpu.ops.test_methods import checksum, make_random_matrix
 
+    if entry.get("serve_tenants"):
+        return _serve_storm(entry, seed)
     if entry.get("mesh"):
         from dbcsr_tpu.core.config import set_config
         from dbcsr_tpu.parallel import make_grid, sparse_multiply_distributed
@@ -182,9 +290,11 @@ def run_chaos(seed: int, rounds: int, verbose: bool = False,
     ``check_events`` additionally asserts the ops-plane correlation
     contract per faulted product: every fault the schedule actually
     fired must appear on the event bus (`dbcsr_tpu.obs.events`) as a
-    ``fault_injected`` record carrying the multiply's ``product_id`` —
-    a fault that fires invisibly, or outside its product's correlation
-    scope, is a failure even when the checksum survives."""
+    ``fault_injected`` record carrying the multiply's ``product_id``
+    (or, for serving-plane sites that fire before a product scope
+    opens, the ``request_id``) — a fault that fires invisibly, or
+    outside its correlation scope, is a failure even when the checksum
+    survives."""
     import jax
 
     jax.config.update("jax_enable_x64", True)
@@ -233,8 +343,12 @@ def run_chaos(seed: int, rounds: int, verbose: bool = False,
             if check_events:
                 fired = sum(spec.fired for spec in installed)
                 on_bus = obs_events.records(kind="fault_injected")
+                # a fault is correlated when it carries a product id
+                # (engine sites) OR a request id (serving-plane sites:
+                # admission runs before any product scope opens)
                 uncorrelated = [e for e in on_bus
-                                if not e.get("product_id")]
+                                if not e.get("product_id")
+                                and not e.get("request_id")]
                 events_checked += fired
                 if len(on_bus) != fired or uncorrelated:
                     failures.append({

@@ -38,8 +38,11 @@ import re
 import sys
 
 RUNBOOK = "docs/resilience.md"
+SERVE_RUNBOOK = "docs/serving.md"
 
-# anomaly kind -> (one-line action, runbook anchor)
+# anomaly kind -> (one-line action, runbook anchor); anchors starting
+# with "docs/" are full runbook paths (the serving plane's hints live
+# in docs/serving.md, everything else in docs/resilience.md)
 HINTS = {
     "recompile_storm": (
         "new shapes are arriving every multiply and XLA is recompiling "
@@ -68,6 +71,19 @@ HINTS = {
         "a checksum retry classified deterministic/unstable: proven "
         "numeric corruption — quarantine the driver and capture the "
         "flight dump", "#checksum-gate-one-shot-safe-driver-retry"),
+    "shed_storm": (
+        "the serving plane is rejecting a large fraction of "
+        "submissions; raise quotas/queue bound, add capacity, or check "
+        "the health verdict driving admission",
+        SERVE_RUNBOOK + "#shed-storms"),
+    "serve_shed": (
+        "submissions are being shed; the per-tenant reasons below say "
+        "whether it is health-driven (critical), quota pressure, or a "
+        "full queue", SERVE_RUNBOOK + "#admission-control"),
+    "serve_deadline": (
+        "queued requests are expiring before execution; shorten the "
+        "coalescing window, raise worker capacity, or relax deadlines",
+        SERVE_RUNBOOK + "#deadlines--the-watchdog-taxonomy"),
 }
 
 
@@ -251,6 +267,58 @@ def analyze(health: dict | None, prom: dict, events: list,
             .get("pool") or {}
     report["pool"] = pool
 
+    # serving plane: live counters/gauge first (prometheus), else the
+    # serve_* bus events — queue depth, per-tenant shed/admit, and the
+    # top deadline-miss offenders by tenant
+    serving: dict = {"tenants": {}}
+    depth = prom.get("dbcsr_tpu_serve_queue_depth")
+    if depth:
+        serving["queue_depth"] = int(depth[-1][1])
+    for labels, v in prom.get("dbcsr_tpu_serve_requests_total", []):
+        t = labels.get("tenant", "?")
+        serving["tenants"].setdefault(t, collections.Counter())[
+            labels.get("outcome", "?")] += int(v)
+    for labels, v in prom.get("dbcsr_tpu_serve_shed_total", []):
+        serving.setdefault("shed_reasons", collections.Counter())[
+            labels.get("reason", "?")] += int(v)
+    for labels, v in prom.get("dbcsr_tpu_serve_deadline_missed_total", []):
+        serving["tenants"].setdefault(
+            labels.get("tenant", "?"),
+            collections.Counter())["deadline_missed"] += int(v)
+    if not serving["tenants"]:
+        ev_outcome = {"serve_admitted": "admitted", "serve_shed": "shed",
+                      "serve_deadline_missed": "deadline_missed",
+                      "serve_done": "done", "serve_failed": "failed"}
+        for e in events:
+            outcome = ev_outcome.get(e.get("event"))
+            if outcome is None:
+                continue
+            t = e.get("tenant", "?")
+            serving["tenants"].setdefault(t, collections.Counter())[
+                outcome] += 1
+            if outcome == "shed":
+                serving.setdefault("shed_reasons", collections.Counter())[
+                    e.get("reason", "?")] += 1
+    serving["tenants"] = {t: dict(c) for t, c in
+                          serving["tenants"].items() if c}
+    if "shed_reasons" in serving:
+        serving["shed_reasons"] = dict(serving["shed_reasons"])
+    serving["deadline_offenders"] = sorted(
+        ((t, c["deadline_missed"]) for t, c in serving["tenants"].items()
+         if c.get("deadline_missed")),
+        key=lambda kv: -kv[1])[:top]
+    if serving["tenants"] or "queue_depth" in serving:
+        report["serving"] = serving
+        total_shed = sum(c.get("shed", 0)
+                         for c in serving["tenants"].values())
+        if total_shed:
+            report["hints"].append(_hint("serve_shed", detail=", ".join(
+                f"{k}={v}" for k, v in sorted(
+                    (serving.get("shed_reasons") or {}).items()))))
+        if serving["deadline_offenders"]:
+            report["hints"].append(_hint("serve_deadline", detail=", ".join(
+                f"{t} ({n})" for t, n in serving["deadline_offenders"])))
+
     # anomalies: live health verdict first, else anomaly events
     anomalies: dict = collections.Counter()
     if health:
@@ -290,8 +358,9 @@ def analyze(health: dict | None, prom: dict, events: list,
 
 def _hint(kind: str, detail: str = "") -> dict:
     action, anchor = HINTS[kind]
+    runbook = anchor if anchor.startswith("docs/") else RUNBOOK + anchor
     return {"kind": kind, "detail": detail, "action": action,
-            "runbook": RUNBOOK + anchor}
+            "runbook": runbook}
 
 
 # ----------------------------------------------------------- renderer
@@ -346,6 +415,22 @@ def render(report: dict, out=print) -> None:
             if k in p:
                 parts.append(f"{k.split('_')[0]}={p[k] / 1e6:.1f}MB")
         out(" memory pool: " + ", ".join(parts))
+    if report.get("serving"):
+        sv = report["serving"]
+        head = " serving:"
+        if "queue_depth" in sv:
+            head += f" queue_depth={sv['queue_depth']}"
+        if sv.get("shed_reasons"):
+            head += " shed[" + ", ".join(
+                f"{k}={v}" for k, v in sorted(sv["shed_reasons"].items())
+            ) + "]"
+        out(head if head != " serving:" else " serving: (per-tenant)")
+        for t, c in sorted(sv.get("tenants", {}).items()):
+            out(f"   {t:<20} " + ", ".join(
+                f"{k}={v}" for k, v in sorted(c.items())))
+        if sv.get("deadline_offenders"):
+            out("   top deadline-miss offenders: " + ", ".join(
+                f"{t} ({n})" for t, n in sv["deadline_offenders"]))
     if report.get("anomalies"):
         out(" anomalies: " + ", ".join(
             f"{k}={v}" for k, v in sorted(report["anomalies"].items())))
@@ -387,6 +472,16 @@ def _selftest(repo_root: str) -> int:
          "rate_per_multiply": 1.0, "product_id": None},
         {"event": "multiply_end", "product_id": pid, "dur_ms": 12.5,
          "algorithm": "stack"},
+        # serving-plane artifacts: one tenant being shed on quota, one
+        # missing deadlines — both rows + hints must materialize
+        {"event": "serve_admitted", "request_id": "req-1",
+         "tenant": "alice", "op": "multiply", "outcome": "admitted"},
+        {"event": "serve_done", "request_id": "req-1", "tenant": "alice",
+         "outcome": "OK", "latency_ms": 40.0},
+        {"event": "serve_shed", "request_id": "req-2", "tenant": "bob",
+         "op": "multiply", "reason": "quota_inflight"},
+        {"event": "serve_deadline_missed", "request_id": "req-3",
+         "tenant": "bob", "op": "multiply", "waited_ms": 900.0},
     ]
     probe = [{"ts": "2026-01-01T00:00:00", "name": "tpu_probe",
               "outcome": "WEDGED", "streak": 4, "wedge_streak": 2,
@@ -411,6 +506,10 @@ def _selftest(repo_root: str) -> int:
         and report["anomalies"].get("fallback_storm") == 1
         and any(h["kind"] == "wedge_streak" for h in report["hints"])
         and any(h["kind"] == "breaker_open" for h in report["hints"])
+        and report["serving"]["tenants"]["bob"]["shed"] == 1
+        and report["serving"]["deadline_offenders"] == [("bob", 1)]
+        and any(h["kind"] == "serve_shed" for h in report["hints"])
+        and any(h["kind"] == "serve_deadline" for h in report["hints"])
     )
     print(f" selftest: {'OK' if ok else 'FAILED'} "
           f"(captures read: {len(captures)})")
