@@ -1347,7 +1347,10 @@ def process_stack(c_data, a_data, b_data, a_idx, b_idx, c_idx, alpha=1.0,
 _DISPATCHES_NAME = "dbcsr_tpu_dispatches_total"
 _DISPATCHES_HELP = (
     "engine dispatch round-trips by mode: one per executed span in "
-    "per_span mode, one per fused C-bin (or mesh) launch in fused mode")
+    "per_span mode, one per fused C-bin (or mesh) launch in fused "
+    "mode, one per tick/shift region under the pipelined distributed "
+    "drivers (cannon_db ring metronome, gather_pipe chunked "
+    "all-gather)")
 _FUSED_SPANS_NAME = "dbcsr_tpu_fused_spans"
 _FUSED_SPANS_HELP = (
     "spans (or mesh tick-chunks) carried by each single fused launch")

@@ -419,6 +419,32 @@ class chain:
         if tracked is not None:
             tracked.free()
 
+    def scope(self):
+        """Context manager for one split/iteration of a loop running
+        inside this chain: matrices ADOPTED while the scope is open
+        (engine temporaries — desymmetrized operands, transposes,
+        remapped tensors) are retired at its exit, feeding the next
+        split's checkouts, unless they were already retired or
+        detached.  Matrices created before the scope (the caller's
+        operands and C) are untouched — the ownership check in
+        `retire` makes over-retiring impossible."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            before = set(self._adopted)
+            try:
+                yield self
+            finally:
+                for key in [k for k in self._adopted if k not in before]:
+                    m = self._adopted.pop(key, None)
+                    if m is not None:
+                        try:
+                            m.free()
+                        except Exception:
+                            pass  # a half-built temporary mid-fault
+        return _scope()
+
     def detach(self, m) -> object:
         """Release ``m`` from this chain's end-of-scope free.  With an
         enclosing chain active the matrix transfers to it (nested

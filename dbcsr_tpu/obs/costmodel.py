@@ -277,6 +277,22 @@ def mesh_tick_model(cap_a: int, cap_b: int, bm: int, bk: int, bn: int,
     return _tick_balance(flops, comm_bytes, dtype, kind)
 
 
+def gather_chunk_model(cap_a: int, cap_b: int, bm: int, bk: int, bn: int,
+                       entries: int, nticks: int, ndev: int,
+                       itemsize: int, dtype: str,
+                       kind: str | None = None) -> dict:
+    """Per-device, per-chunk comm/compute balance of the CHUNKED
+    all-gather pipeline on rectangular grids: each of the ``nticks``
+    ring steps moves one padded A shard (``cap_a`` blocks of (bm, bk))
+    and one B shard over ICI while the tick contracts its
+    shard-arrival share of the product's ``entries`` (the same shard
+    pair per step a Cannon tick ring-shifts — `mesh_tick_model`'s
+    balance applied to the gather schedule, so the two routes share
+    one gauge family)."""
+    return mesh_tick_model(cap_a, cap_b, bm, bk, bn, entries, nticks,
+                           ndev, itemsize, dtype, kind)
+
+
 # ------------------------------------------------------- XLA cross-check
 
 _xla_costs: dict = {}  # fn -> {key_str: {model + xla numbers}}

@@ -61,8 +61,18 @@ from dbcsr_tpu.resilience import faults as _faults
 # breaker pseudo-driver of the double-buffered tick pipeline, keyed by
 # (engine, grid): its failures route the multiply back to the serial
 # fused program (where nothing is pipelined), never condemn the mesh/
-# dense drivers themselves — the FUSED_DRIVER convention of acc/smm
+# dense drivers themselves — the FUSED_DRIVER convention of acc/smm.
+# The grouped-TAS metronome registers under the same pseudo-driver
+# (keyed engine="tas") — it IS this tick pipeline over the group
+# ensemble.
 DRIVER = "cannon_db"
+
+# breaker pseudo-driver of the chunked all-gather pipeline on
+# rectangular grids (the route with no ring-shift metronome: the
+# per-source-shard gather chunks are what overlap the stack chunks).
+# Same contract as `cannon_db`: failures route the multiply back to
+# the fused one-collective program, bitwise identically.
+GATHER_DRIVER = "gather_pipe"
 
 MEASURED_GAUGE = "dbcsr_tpu_cannon_overlap_measured"
 _MEASURED_HELP = (
@@ -107,12 +117,16 @@ def zeros_program(mesh_ref: _HashableMesh, shape: tuple, dtype_name: str,
 
 
 def resolve_mode(engine: str, grid: str, s: int,
-                 nticks: int | None = None) -> tuple:
+                 nticks: int | None = None, driver: str = DRIVER) -> tuple:
     """(mode, why) for one distributed multiply.
 
     ``mode`` is "double_buffer" or "serial"; ``why`` says who decided
     (config force, auto policy, grid shape, breaker state) — recorded
-    on the flight record and the trace span by `publish_decision`."""
+    on the flight record and the trace span by `publish_decision`.
+    ``driver`` selects the pipeline's breaker pseudo-driver (the ring
+    metronome's ``cannon_db`` or the all-gather route's
+    ``gather_pipe``); both fold into the one
+    ``DBCSR_TPU_CANNON_OVERLAP`` knob."""
     from dbcsr_tpu.core.config import get_config
 
     knob = get_config().cannon_overlap
@@ -126,7 +140,7 @@ def resolve_mode(engine: str, grid: str, s: int,
     # execute_stack convention — never probe-and-walk-away)
     from dbcsr_tpu.resilience import breaker as _breaker
 
-    if not _breaker.get_board().allow(DRIVER, (engine, grid)):
+    if not _breaker.get_board().allow(driver, (engine, grid)):
         return "serial", "breaker-open"
     return "double_buffer", ("config" if knob == "double_buffer" else "auto")
 
@@ -147,7 +161,8 @@ def use_split_pipeline(mode: str, why: str, measure: bool) -> bool:
 
 
 def run_ticks(nticks: int, a, b, c, shift_fn, tick_fn, *,
-              mode: str, engine: str, measure: bool = False):
+              mode: str, engine: str, measure: bool = False,
+              driver: str = DRIVER, site: str = "mesh_shift"):
     """Drive the Cannon metronome tick-by-tick at host level.
 
     ``tick_fn(a, b, c, t) -> c`` dispatches tick t's contraction;
@@ -158,6 +173,13 @@ def run_ticks(nticks: int, a, b, c, shift_fn, tick_fn, *,
     measured reference ordering) each region is dispatched and drained
     before the next.  Per-tick op order matches the fused serial
     program exactly, so the result is bitwise identical either way.
+
+    ``c`` may be any pytree of device arrays (the chunked all-gather
+    route carries its growing operand concatenations alongside the C
+    accumulator).  ``driver`` labels the dispatch/breaker pseudo-driver
+    and ``site`` the fault-injection edge (``mesh_shift`` for the ring
+    metronome, ``gather_chunk`` for the all-gather pipeline,
+    ``tas_tick`` for the grouped-TAS metronome).
 
     Returns ``(c, shift_exposed_s, compute_s)`` — the timing fields
     are 0.0 unless ``measure``.
@@ -189,28 +211,28 @@ def run_ticks(nticks: int, a, b, c, shift_fn, tick_fn, *,
                 # the host-level tick/shift boundary: the one place a
                 # mid-shift fault can fire outside the SPMD program
                 if inject:
-                    _faults.maybe_inject("mesh_shift", engine=engine, tick=t)
+                    _faults.maybe_inject(site, engine=engine, tick=t)
                 a_nxt, b_nxt = shift_fn(a, b)
-                record_dispatch(DRIVER)
+                record_dispatch(driver)
                 if inject:
-                    a_nxt = _faults.corrupt("mesh_shift", a_nxt,
+                    a_nxt = _faults.corrupt(site, a_nxt,
                                             engine=engine, tick=t)
             c = tick_fn(a, b, c, t)
-            record_dispatch(DRIVER)
+            record_dispatch(driver)
             if measure:
                 t0 = time.perf_counter()
                 jax.block_until_ready(c)
                 compute_s += time.perf_counter() - t0
         else:
             c = tick_fn(a, b, c, t)
-            record_dispatch(DRIVER)
+            record_dispatch(driver)
             if measure:
                 t0 = time.perf_counter()
                 jax.block_until_ready(c)
                 compute_s += time.perf_counter() - t0
             if not last:
                 a_nxt, b_nxt = shift_fn(a, b)
-                record_dispatch(DRIVER)
+                record_dispatch(driver)
                 if measure:
                     # serial reference: nothing else is in flight, the
                     # whole shift wait is exposed by construction
@@ -242,15 +264,17 @@ def output_corrupted(x) -> bool:
     return _output_corrupted(x)
 
 
-def guarded(engine: str, grid: str, db_fn, serial_fn):
+def guarded(engine: str, grid: str, db_fn, serial_fn,
+            driver: str = DRIVER):
     """Run the double-buffered pipeline with the serial program as the
     bitwise-identical escape hatch.
 
     ``db_fn()`` runs the per-tick pipeline and returns C; any failure
-    (injected ``mesh_shift`` fault, corrupted output, real dispatch
-    error) is classified, recorded against the ``cannon_db`` breaker
-    for this (engine, grid), surfaced on the event bus + flight record,
-    and the multiply re-runs through ``serial_fn()`` from the pristine
+    (injected ``mesh_shift``/``gather_chunk``/``tas_tick`` fault,
+    corrupted output, real dispatch error) is classified, recorded
+    against the pipeline's breaker pseudo-``driver`` for this
+    (engine, grid), surfaced on the event bus + flight record, and the
+    multiply re-runs through ``serial_fn()`` from the pristine
     operands — the decompose contract of the fused superstack, at the
     tick-pipeline level."""
     from dbcsr_tpu.resilience import breaker as _breaker
@@ -271,9 +295,9 @@ def guarded(engine: str, grid: str, db_fn, serial_fn):
         )
 
         kind = _classify_failure(exc)
-        board.record_failure(DRIVER, key, kind=kind)
-        _record_driver_failure(DRIVER, kind, exc, key)
-        _record_fallback(DRIVER, "serial", key)
+        board.record_failure(driver, key, kind=kind)
+        _record_driver_failure(driver, kind, exc, key)
+        _record_fallback(driver, "serial", key)
         _trace.annotate(cannon_mode="serial",
                         cannon_degraded=f"{type(exc).__name__}")
         _flight.note("cannon_mode", "serial")
@@ -283,19 +307,20 @@ def guarded(engine: str, grid: str, db_fn, serial_fn):
         stats.record_cannon_overlap(engine, grid, mode="serial",
                                     drop_measured=True)
         return serial_fn(), True
-    board.record_success(DRIVER, key)
+    board.record_success(driver, key)
     return out, False
 
 
 def run_split_pipeline(engine: str, grid: str, mode: str, split_fn,
-                       serial_fn, measure: bool):
+                       serial_fn, measure: bool, driver: str = DRIVER):
     """Run the split per-tick pipeline guarded, for BOTH modes: the
     double-buffered path and the measured serial reference leg share
     the same programs and failure modes (separate compilations, the
     extra accumulator buffer, per-tick dispatches), so both get the
-    same contract — an open ``cannon_db`` breaker or any pipeline
-    failure falls back to the fused program, with failures recorded so
-    later multiplies stop retrying a condemned pipeline.
+    same contract — an open pipeline breaker (``cannon_db`` /
+    ``gather_pipe``) or any pipeline failure falls back to the fused
+    program, with failures recorded so later multiplies stop retrying
+    a condemned pipeline.
 
     ``split_fn(timings)`` must run the pipeline and append
     ``(shift_exposed_s, compute_s)`` to ``timings``.  The measured
@@ -308,11 +333,11 @@ def run_split_pipeline(engine: str, grid: str, mode: str, split_fn,
         # an open breaker skips the condemned pipeline entirely
         from dbcsr_tpu.resilience import breaker as _breaker
 
-        if not _breaker.get_board().allow(DRIVER, (engine, grid)):
+        if not _breaker.get_board().allow(driver, (engine, grid)):
             return serial_fn()
     timings: list = []
     out, degraded = guarded(engine, grid, lambda: split_fn(timings),
-                            serial_fn)
+                            serial_fn, driver=driver)
     if measure and not degraded and timings:
         publish_measured(engine, grid, mode, *timings[-1])
     return out

@@ -581,6 +581,155 @@ def _mesh_finish_program(c_acc, c_init, alpha, beta_fac, *,
     return fn(c_acc, c_init, alpha, beta_fac)
 
 
+# --------------------------------------------------------------------------
+# Chunked all-gather pipeline (rectangular grids): the fused program's
+# one up-front `all_gather` becomes nticks per-source-shard ring steps
+# driven by the overlap metronome, so the first stack chunks contract
+# while later shards are still in flight.  Tick t writes the shard
+# arriving at ring distance t into the concatenated operand buffer at
+# the position the fused program's tiled `all_gather` puts it, then
+# contracts the plan's tick-t stack (whose entries reference only
+# shards at distances <= t — `_build_mesh_plan`'s shard-arrival
+# binning).  Op code is `_tick_contrib_chunked`, shared with the fused
+# program: bitwise identical by construction.  Failures degrade
+# through the `gather_pipe` pseudo-driver to the fused program.
+# --------------------------------------------------------------------------
+
+
+def _recv_perm(s: int) -> tuple:
+    """Receive-from-successor ring permutation: after t steps position
+    p holds the panel that originated at (p + t) % s — the per-shard
+    chunk schedule of the pipelined all-gather.  The SAME table as the
+    Cannon A-shift (`_ring_perms`): `_build_mesh_plan`'s arrival
+    distances (dist_a/dist_b) are derived for this direction, so the
+    two must never diverge."""
+    return _ring_perms(s)[0]
+
+
+@functools.partial(jax.jit, static_argnames=("pr", "pc", "mesh_ref"))
+def _gather_shift_program(a_panels, b_panels, *, pr, pc, mesh_ref):
+    """One gather chunk: rotate the rolling home A panel along 'pc'
+    and the rolling B panel along 'pr' by one position, as an SPMD
+    program with no data dependence on the concurrent tick program."""
+
+    def body(a_p, b_p):
+        a = a_p.reshape(a_p.shape[3:])
+        b = b_p.reshape(b_p.shape[3:])
+        if pc > 1:
+            a = jax.lax.ppermute(a, ("pc",), _recv_perm(pc))
+        if pr > 1:
+            b = jax.lax.ppermute(b, ("pr",), _recv_perm(pr))
+        return (a.reshape((1, 1, 1) + a.shape),
+                b.reshape((1, 1, 1) + b.shape))
+
+    fn = _shard_map(
+        body,
+        mesh=mesh_ref.val,
+        in_specs=(P("kl", "pr", "pc"), P("kl", "pr", "pc")),
+        out_specs=(P("kl", "pr", "pc"), P("kl", "pr", "pc")),
+    )
+    return fn(a_panels, b_panels)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("pr", "pc", "seg_a", "seg_b", "cap_c", "acc_name",
+                     "mesh_ref", "r0"),
+)
+def _gather_tick_program(a_roll, b_roll, a_cat, b_cat, stacks, c_acc, t, *,
+                         pr, pc, seg_a, seg_b, cap_c, acc_name, mesh_ref,
+                         r0=0):
+    """One gather-pipeline tick: append the shard pair at ring distance
+    ``t`` into the concatenations (A at column (j+t)%pc * seg_a, B at
+    row (i+t)%pr * seg_b — the tiled-all_gather layout), then contract
+    tick t's stack chunk into the per-layer accumulator.  Past an
+    axis's extent the wrapped shard rewrites identical bytes (benign;
+    the other, longer axis still needs the step)."""
+    mesh = mesh_ref.val
+    acc_dtype = jnp.dtype(acc_name)
+
+    def body(a_r, b_r, a_c, b_c, st, c_p, t):
+        a_r = a_r.reshape(a_r.shape[3:])
+        b_r = b_r.reshape(b_r.shape[3:])
+        a_c = a_c.reshape(a_c.shape[3:])  # (pc * seg_a, bm, bk)
+        b_c = b_c.reshape(b_c.shape[3:])  # (pr * seg_b, bk, bn)
+        st = st.reshape(st.shape[3:])     # (nticks, s_cap, w)
+        c = c_p.reshape(c_p.shape[3:])    # (cap_c, bm, bn)
+        src_col = jax.lax.rem(jax.lax.axis_index("pc") + t,
+                              jnp.int32(pc))
+        zero = jnp.zeros((), src_col.dtype)
+        a_c = jax.lax.dynamic_update_slice(
+            a_c, a_r, (src_col * seg_a, zero, zero))
+        src_row = jax.lax.rem(jax.lax.axis_index("pr") + t,
+                              jnp.int32(pr))
+        b_c = jax.lax.dynamic_update_slice(
+            b_c, b_r, (src_row * seg_b, zero, zero))
+        entries = jax.lax.dynamic_index_in_dim(st, t, axis=0, keepdims=False)
+        c = _tick_contrib_chunked(a_c, b_c, c, entries, r0=r0, cap_c=cap_c,
+                                  acc_dtype=acc_dtype)
+        return (a_c.reshape((1, 1, 1) + a_c.shape),
+                b_c.reshape((1, 1, 1) + b_c.shape),
+                c.reshape((1, 1, 1) + c.shape))
+
+    fn = _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("kl", "pr", "pc"),) * 6 + (P(),),
+        out_specs=(P("kl", "pr", "pc"),) * 3,
+    )
+    return fn(a_roll, b_roll, a_cat, b_cat, stacks, c_acc, t)
+
+
+def _gather_ticks(plan: "_MeshPlan", mesh, a_panels, b_panels, c_init,
+                  alpha_dev, beta_fac, mode: str, measure: bool,
+                  timings: list):
+    """Host-driven chunked all-gather pipeline behind the rectangular-
+    grid route — bitwise identical to `_run_sparse_mesh` with
+    ``gather=True``.  The carried state is (a_cat, b_cat, c_acc): the
+    incrementally built operand concatenations plus the accumulator."""
+    from dbcsr_tpu.acc.smm import record_dispatch
+
+    mref = _HashableMesh(mesh)
+    kl, pr, pc = plan.kl, plan.s, plan.pc
+    seg_a, seg_b = plan.cap_a + plan.xtr, plan.cap_b + plan.xtr
+    dt_name = np.dtype(plan.dtype).name
+    a_cat = _overlap.zeros_program(
+        mref, (kl, pr, pc, pc * seg_a, plan.bm, plan.bk), dt_name,
+        P("kl", "pr", "pc"))()
+    b_cat = _overlap.zeros_program(
+        mref, (kl, pr, pc, pr * seg_b, plan.bk, plan.bn), dt_name,
+        P("kl", "pr", "pc"))()
+    c_acc = _overlap.zeros_program(
+        mref, (kl, pr, pc, plan.cap_c, plan.bm, plan.bn), plan.acc_name,
+        P("kl", "pr", "pc"))()
+    record_dispatch(_overlap.GATHER_DRIVER)  # the zeros programs
+
+    def shift(aa, bb):
+        return _gather_shift_program(aa, bb, pr=pr, pc=pc, mesh_ref=mref)
+
+    def tick(aa, bb, carry, t):
+        return _gather_tick_program(
+            aa, bb, carry[0], carry[1], plan.stacks_dev, carry[2],
+            jnp.asarray(t, jnp.int32), pr=pr, pc=pc, seg_a=seg_a,
+            seg_b=seg_b, cap_c=plan.cap_c, acc_name=plan.acc_name,
+            mesh_ref=mref, r0=plan.r0,
+        )
+
+    carry, shift_s, comp_s = _overlap.run_ticks(
+        plan.nticks, a_panels, b_panels, (a_cat, b_cat, c_acc),
+        shift, tick, mode=mode, engine="mesh", measure=measure,
+        driver=_overlap.GATHER_DRIVER, site="gather_chunk",
+    )
+    if measure:
+        timings.append((shift_s, comp_s))
+    res = _mesh_finish_program(
+        carry[2], c_init, alpha_dev, beta_fac,
+        acc_name=plan.acc_name, mesh_ref=mref,
+    )
+    record_dispatch(_overlap.GATHER_DRIVER)
+    return res
+
+
 def _mesh_ticks(plan: "_MeshPlan", mesh, a_panels, b_panels, c_init,
                 alpha_dev, beta_fac, mode: str, measure: bool,
                 timings: list):
@@ -1000,19 +1149,32 @@ def _build_mesh_plan(a, b, matrix_c, mesh, pr, pc, kl, dtype, bm, bk, bn, r0,
         st_a = a_slots[a_ent]
         st_b = b_slots[b_ent]
     else:
-        # all-gather: every k panel is present after the gather; stacks
-        # index the CONCATENATED ('pc'-gathered A / 'pr'-gathered B)
-        # arrays, and ticks are balanced ENTRY-COUNT chunks of each
-        # device's c-sorted stack (chunking by C slot would let one
-        # dominant run collapse into a single tick and size every tick
-        # to it; runs MAY span ticks — the C canvas accumulates)
+        # all-gather: stacks index the CONCATENATED ('pc'-gathered A /
+        # 'pr'-gathered B) arrays, and ticks are SHARD-ARRIVAL chunks:
+        # an entry may not run before the first tick at which both its
+        # A shard (ring distance of its k home column from this
+        # device's column) and its B shard (distance along 'pr') have
+        # arrived — the chunked gather pipeline (`_gather_ticks`)
+        # contracts tick t while shard t+1 is still in flight, and the
+        # fused one-collective program replays the SAME per-tick
+        # stacks so the two execution modes stay bitwise identical.
+        # The arrival distance is only a LOWER bound (a shard stays
+        # present once arrived), so each device's c-sorted stack is
+        # forward-BALANCED across the eligible ticks: tick =
+        # max(arrival, balanced rank-chunk position) keeps per-tick
+        # entry counts ~even — one dominant shard pair must not size
+        # the shared padded tick capacity (s_cap) to itself.
+        dist_a = (ka_col[k_t] - j_dev) % pc
+        dist_b = (kb_row[k_t] - i_dev) % pr
+        arrive = np.maximum(dist_a, dist_b)
         dev_t = (layer * pr + i_dev) * pc + j_dev
         cnt = np.bincount(dev_t, minlength=kl * pr * pc)
-        order_t = np.lexsort((c_slots[ent_c], dev_t))
+        order_t = np.lexsort((c_slots[ent_c], arrive, dev_t))
         starts = np.concatenate([[0], np.cumsum(cnt)])[:-1]
         rank = np.empty(len(dev_t), np.int64)
         rank[order_t] = np.arange(len(dev_t)) - starts[dev_t[order_t]]
-        tick_t = (rank * nticks) // np.maximum(cnt[dev_t], 1)
+        pos = (rank * nticks) // np.maximum(cnt[dev_t], 1)
+        tick_t = np.maximum(arrive, pos)
         st_a = ka_col[k_t] * (cap_a + xtr) + a_slots[a_ent]
         st_b = kb_row[k_t] * (cap_b + xtr) + b_slots[b_ent]
     group = (((layer * pr + i_dev) * pc + j_dev) * nticks) + tick_t
@@ -1255,18 +1417,28 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
 
     # ---- run on the mesh ----
     grid = f"{kl}x{pr}x{pc}"
-    if cannon and pr > 1:
+    # both distributed legs pipeline now: square Cannon grids through
+    # the double-buffered ring metronome (cannon_db), rectangular grids
+    # through the chunked all-gather (gather_pipe) — one knob, two
+    # pseudo-driver breakers
+    pipe_s = pr if cannon else plan.nticks
+    pipe_driver = _overlap.DRIVER if cannon else _overlap.GATHER_DRIVER
+    if pipe_s > 1:
         # modeled per-tick comm/compute attribution, same gauge family
         # as the dense Cannon's but labeled engine="mesh" (panel
-        # capacities stand in for the dense panel dims)
-        tickm = _costmodel.mesh_tick_model(
+        # capacities stand in for the dense panel dims); the gather
+        # route moves the same shard pair per chunk a Cannon tick
+        # ring-shifts
+        model_fn = (_costmodel.mesh_tick_model if cannon
+                    else _costmodel.gather_chunk_model)
+        tickm = model_fn(
             cap_a + xtr, cap_b + xtr, bm, bk, bn, plan.n_cand,
             plan.nticks, kl * pr * pc, np.dtype(dtype).itemsize,
             np.dtype(dtype).name,
         )
         _overlap.publish_modeled("mesh", grid, tickm)
     mode, why = _overlap.resolve_mode(
-        "mesh", grid, pr if cannon else 1, plan.nticks)
+        "mesh", grid, pipe_s, plan.nticks, driver=pipe_driver)
     _overlap.publish_decision("mesh", grid, mode, why)
     alpha_dev = jnp.asarray(alpha, dtype)
     mref = _HashableMesh(mesh)
@@ -1281,18 +1453,20 @@ def _sparse_multiply_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         _record_mesh_dispatch(plan.stacks_dev, r0)
         return out
 
-    measure = cannon and pr > 1 and _overlap.measuring()
+    measure = pipe_s > 1 and _overlap.measuring()
     if _overlap.use_split_pipeline(mode, why, measure):
-        # double-buffered ticks, or the measured serial reference (same
-        # per-tick op sequence, one dispatch per region — the
-        # DBCSR_TPU_SYNC_TIMING seam); both guarded: an open cannon_db
-        # breaker or a split-pipeline failure falls back to serial_fn
+        # double-buffered ticks / chunked gather, or the measured
+        # serial reference (same per-tick op sequence, one dispatch per
+        # region — the DBCSR_TPU_SYNC_TIMING seam); both guarded: an
+        # open pipeline breaker or a split-pipeline failure falls back
+        # to serial_fn
+        ticks_fn = _mesh_ticks if cannon else _gather_ticks
         c_out = _overlap.run_split_pipeline(
             "mesh", grid, mode,
-            lambda timings: _mesh_ticks(
+            lambda timings: ticks_fn(
                 plan, mesh, a_panels, b_panels, c_init, alpha_dev,
                 beta_fac, mode, measure, timings),
-            serial_fn, measure,
+            serial_fn, measure, driver=pipe_driver,
         )
     else:
         c_out = serial_fn()
@@ -1481,6 +1655,149 @@ def _run_grouped_cannon(a_panels, b_panels, stacks, c_init, alpha, beta,
         out_specs=P("kl", "pr", "pc"),
     )
     return fn(a_panels, b_panels, stacks, c_init, alpha, beta)
+
+
+# --------------------------------------------------------------------------
+# Grouped-TAS split per-tick programs: the per-group Cannons advance in
+# lockstep inside one fused program (`_run_grouped_cannon`); staggering
+# them through the double-buffer metronome dispatches the group
+# ensemble's tick-(t+1) ring shift before tick t's contraction is
+# consumed, so every group's shift overlaps every group's compute.  Op
+# code (`_tick_contrib_chunked`) and per-tick order are shared with the
+# fused program — bitwise identical — and failures degrade through the
+# `cannon_db` pseudo-driver (keyed engine="tas") to the fused program.
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("s", "mesh_ref"))
+def _grouped_shift_program(a_panels, b_panels, *, s, mesh_ref):
+    """One grouped-TAS ring shift: every group's A panel moves left
+    along 'pc', the group-replicated B panel up along 'pr' (B stays
+    replicated over 'kl' — the `dbcsr_tas_replicate` analog — so the
+    shift is one collective per (pr, pc) position, not per group)."""
+    shift_a, shift_b = _ring_perms(s)
+
+    def body(a_p, b_p):
+        a = a_p.reshape(a_p.shape[3:])
+        b = b_p.reshape(b_p.shape[2:])
+        a = jax.lax.ppermute(a, ("pc",), shift_a)
+        b = jax.lax.ppermute(b, ("pr",), shift_b)
+        return (a.reshape((1, 1, 1) + a.shape),
+                b.reshape((1, 1) + b.shape))
+
+    fn = _shard_map(
+        body,
+        mesh=mesh_ref.val,
+        in_specs=(P("kl", "pr", "pc"), P("pr", "pc")),
+        out_specs=(P("kl", "pr", "pc"), P("pr", "pc")),
+    )
+    return fn(a_panels, b_panels)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cap_c", "acc_name", "mesh_ref", "r0"),
+)
+def _grouped_tick_program(a_panels, b_panels, stacks, c_acc, t, *,
+                          cap_c, acc_name, mesh_ref, r0=0):
+    """One grouped tick's chunked contribution into the per-group
+    accumulator (global (kl, s, s, q*cap_c, bm, bn); ``cap_c`` here is
+    the chunk-expanded q*cap_c capacity)."""
+    mesh = mesh_ref.val
+    acc_dtype = jnp.dtype(acc_name)
+
+    def body(a_p, b_p, st, c_p, t):
+        from dbcsr_tpu.parallel.cannon import mark_varying
+
+        a = a_p.reshape(a_p.shape[3:])
+        b = b_p.reshape(b_p.shape[2:])
+        b = mark_varying(b, ("kl",))
+        st = st.reshape(st.shape[3:])    # (s, s_cap, w)
+        c = c_p.reshape(c_p.shape[3:])   # (q*cap_c, bm, bn)
+        entries = jax.lax.dynamic_index_in_dim(st, t, axis=0, keepdims=False)
+        c = _tick_contrib_chunked(a, b, c, entries, r0=r0, cap_c=cap_c,
+                                  acc_dtype=acc_dtype)
+        return c.reshape((1, 1, 1) + c.shape)
+
+    fn = _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P("kl", "pr", "pc"),
+            P("pr", "pc"),
+            P("kl", "pr", "pc"),
+            P("kl", "pr", "pc"),
+            P(),
+        ),
+        out_specs=P("kl", "pr", "pc"),
+    )
+    return fn(a_panels, b_panels, stacks, c_acc, t)
+
+
+@functools.partial(jax.jit, static_argnames=("acc_name", "mesh_ref"))
+def _grouped_finish_program(c_acc, c_init, alpha, beta, *,
+                            acc_name, mesh_ref):
+    """Grouped alpha/beta merge (same op order as the fused program's
+    tail); groups write disjoint C slices, so there is no reduction."""
+    acc_dtype = jnp.dtype(acc_name)
+
+    def body(c_p, c_in, alpha, beta):
+        c = c_p.reshape(c_p.shape[3:])
+        c_in = c_in.reshape(c_in.shape[3:])
+        c = (alpha * c + beta * c_in.astype(acc_dtype)).astype(c_in.dtype)
+        return c.reshape((1, 1, 1) + c.shape)
+
+    fn = _shard_map(
+        body,
+        mesh=mesh_ref.val,
+        in_specs=(
+            P("kl", "pr", "pc"),
+            P("kl", "pr", "pc"),
+            P(),
+            P(),
+        ),
+        out_specs=P("kl", "pr", "pc"),
+    )
+    return fn(c_acc, c_init, alpha, beta)
+
+
+def _tas_ticks(plan: "_GroupedPlan", mesh, a_panels, b_panels, c_init,
+               alpha_dev, beta_dev, mode: str, measure: bool,
+               timings: list):
+    """Host-driven staggered grouped-TAS metronome — bitwise identical
+    to `_run_grouped_cannon` (shared per-tick op code, same tail)."""
+    from dbcsr_tpu.acc.smm import record_dispatch
+
+    mref = _HashableMesh(mesh)
+    s, q = plan.s, plan.q
+    c_acc = _overlap.zeros_program(
+        mref, (plan.g, s, s, q * plan.cap_c, plan.bm, plan.bn),
+        plan.acc_name, P("kl", "pr", "pc"),
+    )()
+    record_dispatch(_overlap.DRIVER)  # the zeros program
+
+    def shift(aa, bb):
+        return _grouped_shift_program(aa, bb, s=s, mesh_ref=mref)
+
+    def tick(aa, bb, cc, t):
+        return _grouped_tick_program(
+            aa, bb, plan.stacks_dev, cc, jnp.asarray(t, jnp.int32),
+            cap_c=q * plan.cap_c, acc_name=plan.acc_name, mesh_ref=mref,
+            r0=plan.r0,
+        )
+
+    c_acc, shift_s, comp_s = _overlap.run_ticks(
+        s, a_panels, b_panels, c_acc, shift, tick,
+        mode=mode, engine="tas", measure=measure,
+        driver=_overlap.DRIVER, site="tas_tick",
+    )
+    if measure:
+        timings.append((shift_s, comp_s))
+    res = _grouped_finish_program(
+        c_acc, c_init, alpha_dev, beta_dev,
+        acc_name=plan.acc_name, mesh_ref=mref,
+    )
+    record_dispatch(_overlap.DRIVER)
+    return res
 
 
 def _balanced_groups(weights: np.ndarray, ngroups: int) -> np.ndarray:
@@ -1739,19 +2056,45 @@ def _tas_grouped_impl(alpha, matrix_a, matrix_b, beta, matrix_c, mesh, name,
         NamedSharding(mesh, P("kl", "pr", "pc")),
     )
 
-    # the grouped TAS route keeps the fused serial metronome: its
-    # per-group Cannons advance in lockstep inside ONE program, and
-    # pipelining lockstepped groups is future work — the decision is
-    # still recorded so flight records/traces show which path ran
-    _overlap.publish_decision("tas", f"{g}x{s}x{s}", "serial",
-                              "tas-grouped-route")
-    c_out = _run_grouped_cannon(
-        a_panels, b_panels, plan.stacks_dev, c_init,
-        jnp.asarray(alpha, dtype), jnp.asarray(beta, dtype),
-        s=s, cap_c=q * cap_c, acc_name=plan.acc_name,
-        mesh_ref=_HashableMesh(mesh), r0=r0,
-    )
-    _record_mesh_dispatch(plan.stacks_dev, r0)
+    # the grouped TAS route rides the double-buffer metronome too: the
+    # per-group Cannons advance in lockstep, and the split per-tick
+    # programs stagger the ensemble's tick-(t+1) shift over tick t's
+    # contraction — decision recorded like the other routes, serial
+    # fallback is the fused lockstep program
+    grid = f"{g}x{s}x{s}"
+    if s > 1:
+        tickm = _costmodel.mesh_tick_model(
+            q * cap_a + xtr, cap_b + xtr, bm, bk, bn, plan.n_cand,
+            s, g * s * s, np.dtype(dtype).itemsize, np.dtype(dtype).name,
+        )
+        _overlap.publish_modeled("tas", grid, tickm)
+    mode, why = _overlap.resolve_mode("tas", grid, s)
+    _overlap.publish_decision("tas", grid, mode, why)
+    alpha_dev = jnp.asarray(alpha, dtype)
+    beta_dev = jnp.asarray(beta, dtype)
+    mref = _HashableMesh(mesh)
+
+    def serial_fn():
+        out = _run_grouped_cannon(
+            a_panels, b_panels, plan.stacks_dev, c_init,
+            alpha_dev, beta_dev,
+            s=s, cap_c=q * cap_c, acc_name=plan.acc_name,
+            mesh_ref=mref, r0=r0,
+        )
+        _record_mesh_dispatch(plan.stacks_dev, r0)
+        return out
+
+    measure = s > 1 and _overlap.measuring()
+    if _overlap.use_split_pipeline(mode, why, measure):
+        c_out = _overlap.run_split_pipeline(
+            "tas", grid, mode,
+            lambda timings: _tas_ticks(
+                plan, mesh, a_panels, b_panels, c_init, alpha_dev,
+                beta_dev, mode, measure, timings),
+            serial_fn, measure,
+        )
+    else:
+        c_out = serial_fn()
 
     # ---- device-side collect (groups disjoint: no reduction) ----
     out = BlockSparseMatrix(

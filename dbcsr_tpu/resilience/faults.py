@@ -25,6 +25,16 @@ site                      boundary
                           per ring shift; labels: ``engine``,
                           ``tick``) — a fault here degrades the
                           multiply to the serial fused program
+``gather_chunk``          the chunked all-gather pipeline's per-shard
+                          ring-step boundary on rectangular grids
+                          (same `run_ticks` edge, driver
+                          ``gather_pipe``; labels: ``engine``,
+                          ``tick``) — degrades to the fused
+                          one-collective program
+``tas_tick``              the staggered grouped-TAS metronome's
+                          tick/shift boundary (same `run_ticks` edge,
+                          driver ``cannon_db`` keyed engine="tas") —
+                          degrades to the fused lockstep program
 ``probe``                 `bench._probe_tpu`
 ``serve_admit``           `serve.queue.AdmissionQueue.admit` — a fault
                           here sheds the submission with a structured

@@ -14,14 +14,22 @@ from __future__ import annotations
 
 from typing import Optional, Union
 
+import numpy as _np
 
+from dbcsr_tpu.core import mempool as _mempool
+from dbcsr_tpu.core.config import get_config
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
 from dbcsr_tpu.core.timings import timed
 from dbcsr_tpu.mm.multiply import multiply
 from dbcsr_tpu.obs import tracer as _trace
 from dbcsr_tpu.ops.operations import scale
+from dbcsr_tpu.parallel.mesh import optimize_grid
 from dbcsr_tpu.tas.base import TASMatrix
-from dbcsr_tpu.tas.split import choose_nsplit, estimate_split_factor
+from dbcsr_tpu.tas.split import (
+    choose_nsplit,
+    choose_nsplit_traffic,
+    estimate_split_factor,
+)
 from dbcsr_tpu.utils.rounding import ceil_div
 
 # ref default_nsplit_accept_ratio (`dbcsr_tas_split.F:57`): a cached
@@ -87,12 +95,10 @@ def tas_multiply(
         _trace.annotate(name=c.name, m=m_full, n=n_full, k=k_full,
                         long_dim=long_dim)
 
+        # (the numpy/config/split/mesh imports this region used to make
+        # inline are module-scope now: ~µs each, but they sat inside the
+        # timed("tas_multiply") hot region of EVERY split-loop multiply)
         def _fresh_opt() -> int:
-            import numpy as _np
-
-            from dbcsr_tpu.core.config import get_config
-            from dbcsr_tpu.tas.split import choose_nsplit_traffic
-
             long_blks = max(c.nblkrows, c.nblkcols, nblk_k)
             if mesh is not None and mesh.shape["pr"] == mesh.shape["pc"]:
                 # (rectangular grids: grouping cannot engage — the
@@ -164,8 +170,6 @@ def tas_multiply(
                 # devices to fit the batch's nsplit/long-dim, cached in
                 # the batch state and re-evaluated only when the
                 # (acceptance-ratio-gated) nsplit decision changes
-                from dbcsr_tpu.parallel.mesh import optimize_grid
-
                 key = (id(mesh), max(nsplit, 1), long_dim)
                 if batch.get("pgrid_key") != key:
                     batch["pgrid_key"] = key
@@ -197,13 +201,24 @@ def tas_multiply(
         else:
             nblk, limit_lo, limit_hi = nblk_k, "first_k", "last_k"
         per = ceil_div(nblk, nsplit)
-        for g0 in range(0, nblk, per):
-            g1 = min(g0 + per, nblk)
-            flops += multiply(
-                transa, transb, alpha, a, b, 1.0, c,
-                filter_eps=filter_eps,
-                **{limit_lo: g0, limit_hi: g1 - 1},
-            )
+        # the split loop is a chained workload (core.mempool): each
+        # group's multiply runs in a chain scope so engine temporaries
+        # (op() transposes/desymmetrized copies) retire into the pool
+        # the moment the split is done, feeding the next split's bin
+        # checkouts — split panels stop costing fresh device
+        # allocations, and with the device index mirrors the per-split
+        # H2D collapses after the first same-pattern pass.  C itself is
+        # the caller's (created outside the chain): never adopted,
+        # never freed here.
+        with _mempool.chain() as ch:
+            for g0 in range(0, nblk, per):
+                g1 = min(g0 + per, nblk)
+                with ch.scope():
+                    flops += multiply(
+                        transa, transb, alpha, a, b, 1.0, c,
+                        filter_eps=filter_eps,
+                        **{limit_lo: g0, limit_hi: g1 - 1},
+                    )
         return flops
 
 
@@ -232,42 +247,53 @@ def _tas_multiply_mesh(transa, transb, alpha, a, b, beta, c, filter_eps,
             return m
         return new_transposed(m, conjugate=(t == "C" and is_complex(m.dtype)))
 
-    a_op, b_op = _op(a, transa), _op(b, transb)
-    # the grouped path runs per-group square Cannons: a rectangular
-    # ('pr','pc') grid cannot take it (falls back to the all-gather
-    # engine below, which supports any grid)
-    grouped = (
-        nsplit > 1 and mesh.shape["kl"] > 1
-        and mesh.shape["pr"] == mesh.shape["pc"]
-        and long_dim in ("m", "n")
-    )
-    if grouped and long_dim == "m":
-        acc = tas_grouped_multiply(
-            alpha, a_op, b_op, beta, c, mesh, name=c.name,
-            filter_eps=filter_eps, nsplit=nsplit,
+    # chain scope for the mesh leg's temporaries: op() transposes, the
+    # C^T intermediates and the result shell all retire into the pool
+    # when the product is adopted into the caller's C (which was
+    # created OUTSIDE this chain and is never owned by it)
+    with _mempool.chain():
+        a_op, b_op = _op(a, transa), _op(b, transb)
+        # the grouped path runs per-group square Cannons: a rectangular
+        # ('pr','pc') grid cannot take it (falls back to the all-gather
+        # engine below, which supports any grid)
+        grouped = (
+            nsplit > 1 and mesh.shape["kl"] > 1
+            and mesh.shape["pr"] == mesh.shape["pc"]
+            and long_dim in ("m", "n")
         )
-    elif grouped:
-        # column-long C: C^T = op(B)^T op(A)^T is row-long, group its rows
-        acc_t = tas_grouped_multiply(
-            alpha, new_transposed(b_op), new_transposed(a_op), beta,
-            new_transposed(c), mesh, name=c.name + "^T",
-            filter_eps=filter_eps, nsplit=nsplit,
-        )
-        flops_t = getattr(acc_t, "_last_flops", 0)
-        acc = new_transposed(acc_t)
-        acc._last_flops = flops_t
-    else:
-        acc = sparse_multiply_distributed(
-            alpha, a_op, b_op, beta, c, mesh, name=c.name,
-            filter_eps=filter_eps,
-        )
-    flops = getattr(acc, "_last_flops", 0)
-    # adopt the result structure into the caller's C object, preserving
-    # its Distribution and dtype; the product is plain (the sparse path
-    # desymmetrizes)
-    for field in ("keys", "row_ptr", "ent_bin", "ent_slot", "bins",
-                  "_shape_to_bin", "valid"):
-        setattr(c, field, getattr(acc, field))
-    c.matrix_type = NO_SYMMETRY
-    c._work.clear()
+        if grouped and long_dim == "m":
+            acc = tas_grouped_multiply(
+                alpha, a_op, b_op, beta, c, mesh, name=c.name,
+                filter_eps=filter_eps, nsplit=nsplit,
+            )
+        elif grouped:
+            # column-long C: C^T = op(B)^T op(A)^T is row-long, group
+            # its rows
+            acc_t = tas_grouped_multiply(
+                alpha, new_transposed(b_op), new_transposed(a_op), beta,
+                new_transposed(c), mesh, name=c.name + "^T",
+                filter_eps=filter_eps, nsplit=nsplit,
+            )
+            flops_t = getattr(acc_t, "_last_flops", 0)
+            acc = new_transposed(acc_t)
+            acc._last_flops = flops_t
+        else:
+            acc = sparse_multiply_distributed(
+                alpha, a_op, b_op, beta, c, mesh, name=c.name,
+                filter_eps=filter_eps,
+            )
+        flops = getattr(acc, "_last_flops", 0)
+        # adopt the result structure into the caller's C object,
+        # preserving its Distribution and dtype; the product is plain
+        # (the sparse path desymmetrizes).  C now aliases acc's bins,
+        # so acc — a chain-adopted temporary about to be freed — must
+        # never donate them: the copy() shared-mark convention applied
+        # to this structure adoption.
+        for field in ("keys", "row_ptr", "ent_bin", "ent_slot", "bins",
+                      "_shape_to_bin", "valid"):
+            setattr(c, field, getattr(acc, field))
+        acc._bins_shared = True
+        c._bins_shared = True
+        c.matrix_type = NO_SYMMETRY
+        c._work.clear()
     return flops

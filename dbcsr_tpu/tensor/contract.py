@@ -17,7 +17,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dbcsr_tpu.core import mempool as _mempool
 from dbcsr_tpu.core.timings import timed
+from dbcsr_tpu.obs import events as _events
 from dbcsr_tpu.obs import tracer as _trace
 from dbcsr_tpu.ops.operations import scale
 from dbcsr_tpu.tas.mm import tas_multiply
@@ -250,46 +252,72 @@ def contract(
             batch["filter_eps"] = filter_eps
         filter_eps = None
 
-    with timed("tensor_contract"):
+    # the contraction is a first-class product on the ops plane: one
+    # correlation scope (flight record + product_id on the bus) wraps
+    # the reshape -> multiply -> map pipeline, exactly like mesh/TAS
+    # multiplies — every inner multiply/breaker/fault event nests under
+    # its own product id while this scope is what doctor/bus queries
+    # see for the contraction itself
+    with timed("tensor_contract"), _events.product_scope(
+            "tensor_contract", tensor_c.name,
+            a=tensor_a.name, b=tensor_b.name,
+            ndim_a=tensor_a.ndim, ndim_b=tensor_b.ndim):
         _trace.annotate(
             a=tensor_a.name, b=tensor_b.name, c=tensor_c.name,
             contract_a=list(ca), contract_b=list(cb),
             ndim_a=tensor_a.ndim, ndim_b=tensor_b.ndim,
             bounded=bool(a_bounds or b_bounds),
         )
-        restricted_a = restrict_tensor(tensor_a, a_bounds)
-        restricted_b = restrict_tensor(tensor_b, b_bounds)
-        # remap operands into matrix-compatible layouts (ref :1183)
-        a2 = remap(restricted_a, nca, ca, name=tensor_a.name + "_mm")
-        b2 = remap(restricted_b, cb, ncb, name=tensor_b.name + "_mm")
-        # restrict/remap may have passed an operand through unchanged;
-        # if the caller aliased C to an operand, multiply would then
-        # read A/B while overwriting them — copy to break the alias
-        from dbcsr_tpu.ops.operations import copy as matrix_copy
+        # device-resident contraction intermediates (core.mempool): the
+        # restriction copies, the remapped operand layouts and the
+        # result-layout shell are all chain-owned — retired the moment
+        # they are dead, so an iterative contraction loop recycles
+        # their device buffers instead of re-allocating (and, with the
+        # index mirrors, stops re-staging index arrays) every call.
+        # The caller's tensors were created OUTSIDE this chain and are
+        # never adopted or freed by it.
+        with _mempool.chain() as ch:
+            restricted_a = restrict_tensor(tensor_a, a_bounds)
+            restricted_b = restrict_tensor(tensor_b, b_bounds)
+            # remap operands into matrix-compatible layouts (ref :1183)
+            a2 = remap(restricted_a, nca, ca, name=tensor_a.name + "_mm")
+            b2 = remap(restricted_b, cb, ncb, name=tensor_b.name + "_mm")
+            # restrict/remap may have passed an operand through
+            # unchanged; if the caller aliased C to an operand,
+            # multiply would then read A/B while overwriting them —
+            # copy to break the alias
+            from dbcsr_tpu.ops.operations import copy as matrix_copy
 
-        if a2.matrix is tensor_c.matrix:
-            a2.matrix = matrix_copy(a2.matrix, name=a2.name)
-        if b2.matrix is tensor_c.matrix:
-            b2.matrix = matrix_copy(b2.matrix, name=b2.name)
-        c_layout = (map_1, map_2)
-        if (tensor_c.row_dims, tensor_c.col_dims) == c_layout:
+            if a2.matrix is tensor_c.matrix:
+                a2.matrix = matrix_copy(a2.matrix, name=a2.name)
+            if b2.matrix is tensor_c.matrix:
+                b2.matrix = matrix_copy(b2.matrix, name=b2.name)
+            c_layout = (map_1, map_2)
+            if (tensor_c.row_dims, tensor_c.col_dims) == c_layout:
+                flops = tas_multiply(
+                    "N", "N", alpha, a2.matrix, b2.matrix, beta,
+                    tensor_c.matrix,
+                    filter_eps=filter_eps, nsplit=nsplit, mesh=mesh,
+                )
+                return flops
+            tmp = BlockSparseTensor(
+                tensor_c.name + "_mm", tensor_c.blk_sizes, map_1, map_2,
+                tensor_c.dtype
+            )
+            tmp.finalize()
             flops = tas_multiply(
-                "N", "N", alpha, a2.matrix, b2.matrix, beta, tensor_c.matrix,
+                "N", "N", alpha, a2.matrix, b2.matrix, 0.0, tmp.matrix,
                 filter_eps=filter_eps, nsplit=nsplit, mesh=mesh,
             )
+            # the remapped operands are dead once the multiply returned:
+            # retire them now so the result-map staging below checks
+            # its buffers out of the pool they just fed
+            ch.retire(a2.matrix)
+            ch.retire(b2.matrix)
+            if beta != 1.0:
+                scale(tensor_c.matrix, beta)
+            tensor_copy(tensor_c, tmp, summation=True)
             return flops
-        tmp = BlockSparseTensor(
-            tensor_c.name + "_mm", tensor_c.blk_sizes, map_1, map_2, tensor_c.dtype
-        )
-        tmp.finalize()
-        flops = tas_multiply(
-            "N", "N", alpha, a2.matrix, b2.matrix, 0.0, tmp.matrix,
-            filter_eps=filter_eps, nsplit=nsplit, mesh=mesh,
-        )
-        if beta != 1.0:
-            scale(tensor_c.matrix, beta)
-        tensor_copy(tensor_c, tmp, summation=True)
-        return flops
 
 
 def contract_test(

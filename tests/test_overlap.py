@@ -129,8 +129,9 @@ def test_mesh_square_r_tiled_bitwise_identity(mesh4):
 
 
 def test_mesh_allgather_route_identity(mesh6):
-    # rectangular grid: nothing to pipeline (one up-front all_gather);
-    # the knob must be a no-op and the decision recorded as serial
+    # rectangular grid: the chunked-gather pipeline (per-source-shard
+    # ring steps overlapping the stack chunks) vs the fused
+    # one-collective program — bitwise identical, decision recorded
     from dbcsr_tpu.obs import flight
 
     a, b = _rand("A"), _rand("B", seed=4)
@@ -139,7 +140,44 @@ def test_mesh_allgather_route_identity(mesh6):
     assert (ser == db).all()
     rec = flight.records()[-1]
     assert rec["op"] == "mesh_multiply"
-    assert rec["cannon_mode"] == "serial"
+    assert rec["cannon_mode"] == "double_buffer"
+
+
+def test_mesh_allgather_beta_filtered_identity(mesh6):
+    # the gather pipeline through the windowed-beta and filtered legs:
+    # beta != 0 merges old C through the shared finish program, and
+    # filtered products (plan rebuilt every multiply) still pipeline
+    a, b, c0 = _rand("A"), _rand("B", seed=4), _rand("C", occ=0.3, seed=5)
+    ser = _mesh_ab(mesh6, "serial", a, b, c0)
+    db = _mesh_ab(mesh6, "double_buffer", a, b, c0)
+    assert (ser == db).all()
+    ser_f = _mesh_ab(mesh6, "serial", a, b, filter_eps=1e-3)
+    db_f = _mesh_ab(mesh6, "double_buffer", a, b, filter_eps=1e-3)
+    assert (ser_f == db_f).all()
+
+
+def test_mesh_allgather_layered_r_tiled_identity(mesh6):
+    # the R-tiled (xla_group) stack layout through the chunked gather
+    # (r0 pads reference guaranteed-zero concatenation rows in both
+    # execution modes), plus a LAYERED rectangular grid (kl=2, 1x2 —
+    # the psum tail shared with the fused program)
+    import jax
+    from jax.sharding import Mesh
+
+    prev = get_config().mm_driver
+    set_config(mm_driver="xla_group")
+    try:
+        a, b = _rand("A", seed=11), _rand("B", seed=12)
+        ser = _mesh_ab(mesh6, "serial", a, b)
+        db = _mesh_ab(mesh6, "double_buffer", a, b)
+        assert (ser == db).all()
+    finally:
+        set_config(mm_driver=prev)
+    mesh_l = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 1, 2),
+                  axis_names=("kl", "pr", "pc"))
+    ser_l = _mesh_ab(mesh_l, "serial", a, b)
+    db_l = _mesh_ab(mesh_l, "double_buffer", a, b)
+    assert (ser_l == db_l).all()
 
 
 def test_tas_route_identity(mesh8):
@@ -158,7 +196,35 @@ def test_tas_route_identity(mesh8):
     assert (outs["serial"] == outs["double_buffer"]).all()
     rec = flight.records()[-1]
     assert rec["op"] == "tas_mesh_multiply"
-    assert rec["cannon_mode"] == "serial"  # grouped route stays fused
+    # the grouped metronome staggers through the double-buffer driver
+    # now: the pipelined decision must be what actually ran
+    assert rec["cannon_mode"] == "double_buffer"
+
+
+def test_tas_route_beta_filtered_identity(mesh8):
+    # grouped-TAS pipeline through beta accumulation (cinit assembled
+    # into the group panels, merged by the shared finish tail) and a
+    # filtered product (plan rebuilt per multiply)
+    bs_tall, bs = [4] * 12, [4] * 5
+    rng = np.random.default_rng(17)
+    at = make_random_matrix("AT", bs_tall, bs, occupation=0.5, rng=rng)
+    b = make_random_matrix("B", bs, bs, occupation=0.6, rng=rng)
+    c0 = make_random_matrix("C0", bs_tall, bs, occupation=0.3, rng=rng)
+    outs, outs_f = {}, {}
+    for mode in ("serial", "double_buffer"):
+        set_config(cannon_overlap=mode)
+        clear_mesh_plans()
+        ci = c0.copy("Ci")
+        outs[mode] = to_dense(tas_grouped_multiply(2.0, at, b, 0.5, ci,
+                                                   mesh8))
+        clear_mesh_plans()
+        outs_f[mode] = to_dense(tas_grouped_multiply(
+            1.0, at, b, 0.0, None, mesh8, filter_eps=1e-3))
+    assert (outs["serial"] == outs["double_buffer"]).all()
+    assert (outs_f["serial"] == outs_f["double_buffer"]).all()
+    ref = 2.0 * (to_dense(at) @ to_dense(b)) + 0.5 * to_dense(c0)
+    np.testing.assert_allclose(np.asarray(outs["double_buffer"]), ref,
+                               rtol=1e-12, atol=1e-12)
 
 
 def test_filtered_product_identity(mesh4):
@@ -313,6 +379,107 @@ def test_decision_on_event_bus(mesh4):
     evs = obs_events.records(kind="cannon_overlap")
     assert evs and evs[-1]["mode"] == "double_buffer"
     assert evs[-1]["product_id"]  # correlated to the mesh multiply
+
+
+def test_measured_overlap_gather_route(mesh6, monkeypatch):
+    # the chunked gather publishes into the SAME measured gauge family
+    # (engine="mesh", rectangular grid string) next to the ring routes
+    monkeypatch.setenv("DBCSR_TPU_SYNC_TIMING", "1")
+    metrics.reset()
+    a, b = _rand("A"), _rand("B", seed=4)
+    db = _mesh_ab(mesh6, "double_buffer", a, b)
+    ser = _mesh_ab(mesh6, "serial", a, b)
+    assert (ser == db).all()
+    g = metrics.gauge(ovl.MEASURED_GAUGE)
+    for mode in ("double_buffer", "serial"):
+        v = g.value(engine="mesh", grid="1x2x3", mode=mode)
+        assert 0.0 <= v <= 1.0, (mode, v)
+    roll = stats.cannon_overlap_rollup()["mesh"]["1x2x3"]
+    assert 0.0 <= roll["measured_exposed"] <= 1.0
+    assert roll["modeled_ratio"] > 0  # gather_chunk_model published
+
+
+def test_measured_overlap_tas_route(mesh8, monkeypatch):
+    monkeypatch.setenv("DBCSR_TPU_SYNC_TIMING", "1")
+    metrics.reset()
+    bs_tall, bs = [4] * 12, [4] * 5
+    rng = np.random.default_rng(7)
+    at = make_random_matrix("AT", bs_tall, bs, occupation=0.5, rng=rng)
+    b = make_random_matrix("B", bs, bs, occupation=0.6, rng=rng)
+    set_config(cannon_overlap="double_buffer")
+    clear_mesh_plans()
+    tas_grouped_multiply(1.0, at, b, 0.0, None, mesh8)
+    v = metrics.gauge(ovl.MEASURED_GAUGE).value(
+        engine="tas", grid="2x2x2", mode="double_buffer")
+    assert 0.0 <= v <= 1.0
+    roll = stats.cannon_overlap_rollup()["tas"]["2x2x2"]
+    assert roll["compute_s"] > 0 and roll["modeled_ratio"] > 0
+
+
+def test_gather_chunk_fault_degrades_to_serial(mesh6):
+    from dbcsr_tpu.obs import flight
+
+    a, b = _rand("A"), _rand("B", seed=4)
+    clean = _mesh_ab(mesh6, "double_buffer", a, b, alpha=1.0)
+    for schedule in ("gather_chunk:raise,times=1",
+                     "gather_chunk:nan,seed=5,times=1",
+                     "gather_chunk:oom,times=1"):
+        breaker.reset_board()
+        clear_mesh_plans()
+        with faults.inject_faults(schedule) as installed:
+            set_config(cannon_overlap="double_buffer")
+            out = to_dense(sparse_multiply_distributed(
+                1.0, a, b, 0.0, None, mesh6))
+        assert sum(s.fired for s in installed) == 1, schedule
+        assert (np.asarray(out) == np.asarray(clean)).all(), schedule
+        rec = flight.records()[-1]
+        assert rec["cannon_mode"] == "serial", schedule  # degraded
+        snap = breaker.get_board().snapshot()
+        assert any(k.startswith("gather_pipe|") for k in snap), schedule
+
+
+def test_tas_tick_fault_degrades_to_serial(mesh8):
+    from dbcsr_tpu.obs import flight
+
+    bs_tall, bs = [4] * 12, [4] * 5
+    rng = np.random.default_rng(7)
+    at = make_random_matrix("AT", bs_tall, bs, occupation=0.5, rng=rng)
+    b = make_random_matrix("B", bs, bs, occupation=0.6, rng=rng)
+    set_config(cannon_overlap="double_buffer")
+    clear_mesh_plans()
+    clean = to_dense(tas_grouped_multiply(1.0, at, b, 0.0, None, mesh8))
+    for schedule in ("tas_tick:raise,times=1",
+                     "tas_tick:nan,seed=11,times=1"):
+        breaker.reset_board()
+        clear_mesh_plans()
+        with faults.inject_faults(schedule) as installed:
+            out = to_dense(tas_grouped_multiply(1.0, at, b, 0.0, None,
+                                                mesh8))
+        assert sum(s.fired for s in installed) == 1, schedule
+        assert (np.asarray(out) == np.asarray(clean)).all(), schedule
+        rec = flight.records()[-1]
+        assert rec["cannon_mode"] == "serial", schedule
+        snap = breaker.get_board().snapshot()
+        assert any(k.startswith("cannon_db|") and "tas" in k
+                   for k in snap), schedule
+
+
+def test_open_gather_breaker_routes_serial_preemptively(mesh6):
+    board = breaker.get_board()
+    board.record_failure(ovl.GATHER_DRIVER, ("mesh", "1x2x3"),
+                         kind="validation")
+    set_config(cannon_overlap="double_buffer")
+    mode, why = ovl.resolve_mode("mesh", "1x2x3", 3,
+                                 driver=ovl.GATHER_DRIVER)
+    assert (mode, why) == ("serial", "breaker-open")
+    a, b = _rand("A"), _rand("B", seed=4)
+    clear_mesh_plans()
+    out = sparse_multiply_distributed(1.0, a, b, 0.0, None, mesh6)
+    from dbcsr_tpu.obs import flight
+
+    assert flight.records()[-1]["cannon_mode"] == "serial"
+    ser = _mesh_ab(mesh6, "serial", a, b, alpha=1.0)
+    assert (to_dense(out) == ser).all()
 
 
 # -------------------------------------------- committed A/B evidence

@@ -160,6 +160,47 @@ def test_fallback_vs_device_run_refused():
     assert _verdict_of(report) == "incomparable"
 
 
+def test_cannon_mode_mismatch_refused():
+    """A workload row timed under serial tick scheduling compared
+    against a double-buffered candidate measures the scheduling
+    change, not the code change: refused like a device-kind swap
+    (mesh/TAS/contraction rows stamp cannon_mode)."""
+    base = [_rec(10.0, metric="mesh resident ms", unit="ms",
+                 cannon_mode="serial")]
+    cand = [_rec(11.0, metric="mesh resident ms", unit="ms",
+                 cannon_mode="double_buffer")]
+    report = perf_gate.gate(base, cand)
+    assert _verdict_of(report) == "incomparable"
+    assert report["exit_code"] == 2
+    # same mode on both sides compares normally
+    cand_same = [_rec(11.0, metric="mesh resident ms", unit="ms",
+                      cannon_mode="serial")]
+    report = perf_gate.gate(base, cand_same)
+    assert _verdict_of(report) == "ok"
+
+
+def test_cannon_mode_prestamp_row_stays_comparable():
+    # a pre-stamp baseline (no cannon_mode) vs a stamped candidate:
+    # absent evidence never refuses (the device-kind prefix rule)
+    base = [_rec(10.0, metric="mesh resident ms", unit="ms")]
+    cand = [_rec(10.5, metric="mesh resident ms", unit="ms",
+                 cannon_mode="double_buffer")]
+    assert _verdict_of(perf_gate.gate(base, cand)) == "ok"
+
+
+def test_overlap_ab_legs_exempt_from_mode_refusal():
+    """The overlap/contract A/B legs' unit IS the cross-mode
+    comparison (hidden-comm fraction): serial-vs-double_buffer legs
+    must still gate against each other (the tier-2.8/2.10 contract)."""
+    base = [_rec(0.65, metric="overlap_ab", unit="hidden-comm fraction",
+                 cannon_mode="serial")]
+    cand = [_rec(0.95, metric="overlap_ab", unit="hidden-comm fraction",
+                 cannon_mode="double_buffer")]
+    report = perf_gate.gate(base, cand)
+    assert _verdict_of(report) == "improved"
+    assert report["exit_code"] == 0
+
+
 # ------------------------------------------------------ CLI smoke test
 
 def test_cli_smoke_on_synthetic_captures(tmp_path):

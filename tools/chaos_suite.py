@@ -42,15 +42,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # CPU-only by design: chaos runs must be schedulable in CI without
 # hardware (and must never be pointed at a live tunnel).
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# the mesh_overlap corpus case needs a real 2x2 grid: give the CPU
-# backend 4 virtual devices (no-op when XLA_FLAGS already set them)
+# the mesh_overlap corpus case needs a real 2x2 grid, the tas_contract
+# case a rectangular 1x2x3 one plus a (2,2,2) grouped world: give the
+# CPU backend 8 virtual devices (no-op when XLA_FLAGS already set them)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import _hostdev  # noqa: E402
 
-_hostdev.ensure_virtual_devices(4)
+_hostdev.ensure_virtual_devices(8)
 
 SITES = ("execute_stack", "prepare_stack", "dense", "xla", "xla_group",
-         "host", "pallas", "mesh_shift", "serve_admit", "serve_execute")
+         "host", "pallas", "mesh_shift", "gather_chunk", "tas_tick",
+         "serve_admit", "serve_execute")
 KINDS = ("raise", "oom", "nan")
 
 
@@ -80,6 +82,18 @@ def corpus():
         # (breaker-integrated like the fused superstack's decompose)
         ("mesh_overlap", dict(bs=[4] * 8, dtype=np.float64, occ=0.5,
                               mesh=4, cannon_overlap="double_buffer")),
+        # upper-layer pipeline case: a rank-3 tensor contraction over
+        # the RECTANGULAR (1x2x3) grid — the chunked all-gather
+        # pipeline, fault site `gather_chunk` at each per-shard ring
+        # step — plus a grouped-TAS multiply on the (2,2,2) world,
+        # fault site `tas_tick` at the staggered group-ensemble
+        # tick/shift edge.  Both pipelines forced on: a fault at
+        # either dispatch edge must degrade that multiply to its
+        # serial fused program with the checksum intact (the
+        # gather_pipe / cannon_db breaker contract)
+        ("tas_contract", dict(bs=[4] * 6, dtype=np.float64, occ=0.6,
+                              contract_mesh=6, tas_mesh=8,
+                              cannon_overlap="double_buffer")),
         # serving-plane case: many concurrent clients through
         # dbcsr_tpu.serve with injected serve_admit/serve_execute
         # faults — shed submissions are retried until admitted, a
@@ -221,6 +235,64 @@ def _serve_storm(entry: dict, seed: int) -> float:
     return float(sum(results[k] for k in sorted(results)))
 
 
+def _tas_contract(entry: dict, seed: int) -> float:
+    """The upper-layer pipelines under fire: a rank-3 contraction over
+    the rectangular grid (chunked all-gather, `gather_chunk` edges)
+    and a grouped-TAS multiply (staggered metronome, `tas_tick`
+    edges), both with the pipeline forced on.  The checksum over both
+    products must match the clean run whatever degrades."""
+    import itertools
+
+    import numpy as np
+
+    from dbcsr_tpu.core.config import get_config, set_config
+    from dbcsr_tpu.ops.test_methods import checksum, make_random_matrix
+    from dbcsr_tpu.parallel import make_grid
+    from dbcsr_tpu.parallel.sparse_dist import (
+        clear_mesh_plans, tas_grouped_multiply,
+    )
+    from dbcsr_tpu.tensor import create_tensor
+    from dbcsr_tpu.tensor.contract import contract
+
+    rng = np.random.default_rng(seed)
+    bs = entry["bs"]
+    prev = get_config().cannon_overlap
+    set_config(cannon_overlap=entry["cannon_overlap"])
+    try:
+        # rank-3 x matrix over the rectangular (1, 2, 3) grid
+        t3 = create_tensor("t3", [bs, bs, bs])
+        for idx in itertools.product(*(range(len(bs)),) * 3):
+            if rng.random() < entry["occ"]:
+                t3.put_block(idx, rng.standard_normal(t3.block_shape(idx)))
+        t3.finalize()
+        m2 = create_tensor("m2", [bs, bs])
+        for idx in itertools.product(*(range(len(bs)),) * 2):
+            if rng.random() < 0.8:
+                m2.put_block(idx, rng.standard_normal(m2.block_shape(idx)))
+        m2.finalize()
+        c3 = create_tensor("c3", [bs, bs, bs])
+        c3.finalize()
+        clear_mesh_plans()
+        contract(1.0, t3, m2, 0.0, c3,
+                 contract_a=(2,), notcontract_a=(0, 1),
+                 contract_b=(0,), notcontract_b=(1,),
+                 map_1=(0, 1), map_2=(2,),
+                 mesh=make_grid(entry["contract_mesh"], layers=1))
+        cs = float(np.sum(np.asarray(c3.to_dense())))
+        # grouped-TAS metronome on the (2, 2, 2) world
+        tall = bs * 2
+        at = make_random_matrix("AT", tall, bs, dtype=entry["dtype"],
+                                occupation=0.5, rng=rng)
+        b2 = make_random_matrix("B2", bs, bs, dtype=entry["dtype"],
+                                occupation=0.6, rng=rng)
+        clear_mesh_plans()
+        ct = tas_grouped_multiply(1.0, at, b2, 0.0, None,
+                                  make_grid(entry["tas_mesh"]))
+        return cs + checksum(ct)
+    finally:
+        set_config(cannon_overlap=prev)
+
+
 def _one_product(entry: dict, seed: int):
     import numpy as np
 
@@ -229,6 +301,8 @@ def _one_product(entry: dict, seed: int):
 
     if entry.get("serve_tenants"):
         return _serve_storm(entry, seed)
+    if entry.get("contract_mesh"):
+        return _tas_contract(entry, seed)
     if entry.get("mesh"):
         from dbcsr_tpu.core.config import set_config
         from dbcsr_tpu.parallel import make_grid, sparse_multiply_distributed

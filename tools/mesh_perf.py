@@ -88,12 +88,20 @@ def run(nrep: int = 6, nblk: int = 50):
 
 
 def _resolved_cannon_mode(dt) -> str:
+    """The tick scheduling that actually RAN, from the stats rollup —
+    covering every pipelined route (square-grid Cannon, chunked
+    all-gather, grouped TAS all publish into the same rollup under
+    their engine label), so TAS/contraction-shaped runs stamp their
+    pipeline decision exactly like the mesh runs do and
+    tools/perf_gate.py can refuse cross-mode comparisons on those
+    routes too."""
     from dbcsr_tpu.core import stats
 
-    roll = stats.cannon_overlap_rollup().get("mesh", {})
-    for cell in roll.values():
-        if cell.get("mode"):
-            return cell["mode"]
+    roll = stats.cannon_overlap_rollup()
+    for engine in ("mesh", "tas", "dense"):
+        for cell in roll.get(engine, {}).values():
+            if cell.get("mode"):
+                return cell["mode"]
     return dt.get_config().cannon_overlap
 
 

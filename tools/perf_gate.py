@@ -111,7 +111,13 @@ def comparability_key(rec: dict) -> str:
     to mean anything: the device kind (stamped by bench.py /
     acc/bench.py; pre-stamp records fall back to the device string
     with instance digits stripped) plus whether the run fell back to
-    the CPU engine."""
+    the CPU engine, plus — for workload rows that stamp it — which
+    distributed tick scheduling (``cannon_mode``) the run used: a
+    serial-mode baseline compared against a double-buffered candidate
+    measures the scheduling change, not the code change under review.
+    Rows whose ``unit`` is ``hidden-comm fraction`` are exempt: they
+    ARE the cross-mode A/B (overlap/contract bench legs), where the
+    mode is the experiment, not the environment."""
     kind = rec.get("device_kind")
     if not kind:
         kind = re.sub(r"[_\s]*\d+$", "", str(rec.get("device", "unknown")))
@@ -121,7 +127,11 @@ def comparability_key(rec: dict) -> str:
         # normalized bucket, so old baselines stay comparable
         kind = "cpu"
     fb = rec.get("device_fallback")
-    return f"{kind}|fallback={bool(fb)}"
+    key = f"{kind}|fallback={bool(fb)}"
+    mode = rec.get("cannon_mode")
+    if mode and rec.get("unit") != "hidden-comm fraction":
+        key += f"|cannon_mode={mode}"
+    return key
 
 
 def environments_compatible(envs) -> bool:
@@ -129,14 +139,26 @@ def environments_compatible(envs) -> bool:
     Device kinds compare by PREFIX: a pre-stamp record whose device
     string only says "TPU" stays comparable with a stamped
     "tpu v5 lite" one, while "tpu v5 lite" vs "tpu v6 lite" (or a
-    fallback-flag mix) stays refused."""
+    fallback-flag mix, or a cannon_mode mix on rows that stamp it)
+    stays refused.  A pre-stamp row (no cannon_mode component) stays
+    comparable with a stamped one — like the device-kind prefix rule,
+    absent evidence never refuses."""
     envs = sorted(set(envs))
     if len(envs) <= 1:
         return True
-    pairs = [e.rsplit("|", 1) for e in envs]
-    if len({fb for _, fb in pairs}) > 1:
-        return False
-    kinds = [k for k, _ in pairs]
+    parts = [e.split("|") for e in envs]
+    attrs = []
+    for p in parts:
+        d = {}
+        for item in p[1:]:
+            k, _, v = item.partition("=")
+            d[k] = v
+        attrs.append(d)
+    for field in ("fallback", "cannon_mode"):
+        seen = {d[field] for d in attrs if field in d}
+        if len(seen) > 1:
+            return False
+    kinds = [p[0] for p in parts]
     return all(
         a.startswith(b) or b.startswith(a)
         for i, a in enumerate(kinds) for b in kinds[i + 1:]
