@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dbcsr_tpu.acc import abft as _abft
 from dbcsr_tpu.core import mempool as _mempool
 from dbcsr_tpu.core.config import get_config
 from dbcsr_tpu.core.kinds import real_dtype_of
@@ -891,6 +892,8 @@ def _classify_failure(exc: BaseException) -> str:
     ``dbcsr_tpu_driver_failures_total{driver,kind}`` counter."""
     if isinstance(exc, KernelValidationError):
         return "validation"
+    if isinstance(exc, _abft.AbftMismatchError):
+        return "sdc"
     if isinstance(exc, CorruptedOutputError):
         return "nan"
     msg = f"{type(exc).__name__}: {exc}"
@@ -992,7 +995,11 @@ def _run_candidate(base, a_data, b_data, fb_plan, alpha, c_zero,
     argument, so a candidate that dispatches and then fails would
     otherwise consume the only pristine buffer and poison every later
     candidate (falsely tripping their breakers).  We are already on
-    the failure path — one C copy per attempt is cheap insurance."""
+    the failure path — one C copy per attempt is cheap insurance.
+
+    Under ``DBCSR_TPU_ABFT=recover`` the candidate's output is itself
+    probe-verified against ``base`` before being accepted — a recovery
+    must never replace one silently-corrupted result with another."""
     trial = jnp.array(base, copy=True)
     if _faults.active():
         _faults.maybe_inject("execute_stack", driver=fb_plan.driver)
@@ -1002,7 +1009,21 @@ def _run_candidate(base, a_data, b_data, fb_plan, alpha, c_zero,
     if checks_on and _output_corrupted(out):
         raise CorruptedOutputError(
             f"driver {fb_plan.driver!r} produced non-finite output blocks")
+    if _abft.recover_enabled():
+        _abft.check_stack(base, out, a_data, b_data, fb_plan, alpha)
     return out
+
+
+def note_deferred_sdc(exc: BaseException) -> None:
+    """Attribute a flush-detected (deferred) ABFT mismatch: feed the
+    per-(driver, shape) breaker and the failure counters exactly as an
+    immediate in-launch detection would have.  ``exc`` carries
+    ``.driver``/``.shape_key`` attached by `abft.flush`."""
+    drv = getattr(exc, "driver", None) or "?"
+    key = getattr(exc, "shape_key", None) or (drv, "deferred")
+    board = _breaker.get_board()
+    board.record_failure(drv, key, kind="sdc")
+    _record_driver_failure(drv, "sdc", exc, key)
 
 
 def _failover_execute(c_data, a_data, b_data, plan: StackPlan, alpha,
@@ -1017,7 +1038,11 @@ def _failover_execute(c_data, a_data, b_data, plan: StackPlan, alpha,
     failed = plan.driver
     shape_key = _stack_shape_key(c_data, a_data, b_data)
     if base is None:
-        base = c_data
+        # c_zero launches never copy their pristine C (it is identically
+        # zero): synthesize it from metadata — valid even after the
+        # failing launch donated c_data's buffer
+        base = (jnp.zeros(c_data.shape, np.dtype(c_data.dtype))
+                if c_zero else c_data)
     checks_on = _output_checks_enabled()
     if plan.src_idx is None or _is_deleted(base):
         # no rebuild payload, or the failing launch consumed (donated)
@@ -1027,6 +1052,34 @@ def _failover_execute(c_data, a_data, b_data, plan: StackPlan, alpha,
         return _execute_plan(base, a_data, b_data, plan, alpha, c_zero)
     ai, bi, ci = plan.src_idx
     pad_a, pad_b = plan.src_pads
+    was_sdc = exc is not None and _classify_failure(exc) == "sdc"
+    # recoveries are recorded once per COUNTED mismatch of this stack
+    # (a retry that itself mismatches counts another), so the
+    # mismatch/recovery counters stay balanced and health never
+    # reports fully-recovered SDC as corruption that escaped
+    sdc_count = 1 if was_sdc else 0
+    if was_sdc:
+        # SDC is transient corruption (the particle-strike model): the
+        # bitwise-faithful recovery is one pristine SAME-DRIVER retry —
+        # same plan, same accumulation order — before walking the chain
+        # onto a driver with different numerics.  The breaker already
+        # recorded the sdc failure above, so REPEATED corruption from
+        # this (driver, shape) still trips quarantine.
+        try:
+            out = _run_candidate(base, a_data, b_data, plan, alpha,
+                                 c_zero, checks_on)
+        except Exception as exc2:  # noqa: BLE001 — classified + recorded
+            kind2 = _classify_failure(exc2)
+            if kind2 == "sdc":
+                sdc_count += 1
+            board.record_failure(failed, shape_key, kind=kind2)
+            _record_driver_failure(failed, kind2, exc2, shape_key)
+        else:
+            board.record_success(failed, shape_key)
+            _record_fallback(failed, failed, shape_key)
+            for _ in range(sdc_count):
+                _abft.record_recovery(failed)
+            return out
     for drv in _chain_candidates(failed, c_data, a_data, b_data):
         if not board.allow(drv, shape_key):
             continue
@@ -1043,11 +1096,15 @@ def _failover_execute(c_data, a_data, b_data, plan: StackPlan, alpha,
                                  c_zero, checks_on)
         except Exception as exc2:  # noqa: BLE001 — classified + recorded
             kind2 = _classify_failure(exc2)
+            if kind2 == "sdc":
+                sdc_count += 1
             board.record_failure(drv, shape_key, kind=kind2)
             _record_driver_failure(drv, kind2, exc2, shape_key)
             continue
         board.record_success(drv, shape_key)
         _record_fallback(failed, drv, shape_key)
+        for _ in range(sdc_count):
+            _abft.record_recovery(drv)
         _flight.note_driver(drv, f"failover:{failed}",
                             mnk=shape_key[:3], entries=len(ai))
         for slot in StackPlan.__slots__:  # heal the cached plan
@@ -1069,12 +1126,14 @@ def _failover_execute(c_data, a_data, b_data, plan: StackPlan, alpha,
             raise exc
         board.record_success(failed, shape_key)
         _record_fallback(failed, failed, shape_key)
+        for _ in range(sdc_count):
+            _abft.record_recovery(failed)
         return out
     raise exc
 
 
 def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
-                  c_zero: bool = False):
+                  c_zero: bool = False, abft_defer: bool = False):
     """Device side: run a prepared plan against (possibly new) data,
     guarded by the resilience layer — injected faults fire here, a
     raising/corrupting driver is recorded against its per-shape circuit
@@ -1092,7 +1151,13 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
     record_dispatch("per_span")
     board = _breaker.get_board()
     faults_on = _faults.active()
-    checks_on = faults_on or _output_checks_enabled()
+    abft_on = _abft.enabled()
+    # the ABFT probe subsumes the finite-output check (NaN/Inf in out
+    # poisons the probe scalars, so isfinite(err) fails) — don't pay a
+    # second full read + sync of C for it unless faults or the explicit
+    # env knob ask for the `nan`-classified path
+    finite_on = faults_on or _output_checks_enabled()
+    checks_on = finite_on or abft_on
     if not checks_on and not board._breakers:
         # production fast path: no faults configured, nothing ever
         # failed — the guard is three attribute checks + this try frame
@@ -1112,17 +1177,34 @@ def execute_stack(c_data, a_data, b_data, plan: Optional[StackPlan], alpha=1.0,
         return _failover_execute(c_data, a_data, b_data, plan, alpha,
                                  c_zero, exc=None)
     # the xla drivers donate C: keep a pristine copy while the output
-    # check may condemn a COMPLETED launch (chaos/opt-in mode only)
-    base = jnp.array(c_data, copy=True) if checks_on else c_data
+    # check may condemn a COMPLETED launch (chaos/opt-in mode only).
+    # A first-touch (beta==0) launch skips the copy — the failure path
+    # re-synthesizes zeros from metadata, and the ABFT probe drops the
+    # base subtraction outright (half its C traffic)
+    if not checks_on:
+        base = c_data
+    elif c_zero:
+        base = None
+    else:
+        base = jnp.array(c_data, copy=True)
     try:
         if faults_on:
             _faults.maybe_inject("execute_stack", driver=plan.driver)
         out = _execute_plan(c_data, a_data, b_data, plan, alpha, c_zero)
         if faults_on:
             out = _faults.corrupt("execute_stack", out, driver=plan.driver)
-        if checks_on and _output_corrupted(out):
+        if finite_on and _output_corrupted(out):
             raise CorruptedOutputError(
                 f"driver {plan.driver!r} produced non-finite output blocks")
+        if abft_on:
+            # rank-1 probe: C·v vs A·(B·v) per product — the finite-SDC
+            # detector; a mismatch classifies `sdc` below and the stack
+            # re-executes (pristine same-driver retry first, then the
+            # chain)
+            _abft.check_stack(base, out, a_data, b_data, plan, alpha,
+                              c_zero=c_zero,
+                              defer=abft_defer and c_zero,
+                              shape_key=shape_key)
     except Exception as exc:  # noqa: BLE001 — classified + recorded
         kind = _classify_failure(exc)
         board.record_failure(plan.driver, shape_key, kind=kind)
@@ -1641,7 +1723,8 @@ def _dispatch_superstack(c_data, a_datas, b_datas, splan: SuperstackPlan,
 
 
 def execute_superstack(c_data, a_datas, b_datas, splan: SuperstackPlan,
-                       alpha=1.0, c_zero: bool = False):
+                       alpha=1.0, c_zero: bool = False,
+                       abft_defer: bool = False):
     """Run all spans of one C bin as a single fused dispatch, guarded
     by the resilience layer: injected ``execute_superstack`` faults
     fire here, a failing fused launch is recorded against the bin's
@@ -1658,7 +1741,9 @@ def execute_superstack(c_data, a_datas, b_datas, splan: SuperstackPlan,
     plans = splan.plans
     board = _breaker.get_board()
     faults_on = _faults.active()
-    checks_on = faults_on or _output_checks_enabled()
+    abft_on = _abft.enabled()
+    finite_on = faults_on or _output_checks_enabled()
+    checks_on = finite_on or abft_on
     bin_key = _superstack_key(c_data, len(plans))
     if board._breakers:
         # a fused program cannot route around a quarantined member
@@ -1693,10 +1778,12 @@ def execute_superstack(c_data, a_datas, b_datas, splan: SuperstackPlan,
     # the decompose path recovers from it.
     base = c_data
     try:
-        if checks_on and splan.family != "host":
+        if checks_on and splan.family != "host" and not c_zero:
             # the host family works on its own numpy copy and never
             # mutates c_data, so the original is always recoverable
-            # there — don't pay a full-bin device copy for it
+            # there — don't pay a full-bin device copy for it; nor for
+            # a first-touch (beta==0) bin, whose pristine C is zeros
+            # the failure path re-synthesizes from metadata
             base = jnp.array(c_data, copy=True)
         if splan.family == "pallas":
             for plan, a_d, b_d in zip(plans, a_datas, b_datas):
@@ -1712,21 +1799,35 @@ def execute_superstack(c_data, a_datas, b_datas, splan: SuperstackPlan,
                                    c_zero)
         if faults_on:
             out = _faults.corrupt("execute_superstack", out)
-        if checks_on and _output_corrupted(out):
+        if finite_on and _output_corrupted(out):
             raise CorruptedOutputError(
                 "fused superstack launch produced non-finite output blocks")
+        if abft_on:
+            # one probe covers the whole fused bin (the right side sums
+            # every span); a mismatch decomposes to per-span execution,
+            # where each span's own ABFT + chain recovery applies
+            _abft.check_superstack(base, out, a_datas, b_datas, splan,
+                                   alpha, c_zero=c_zero,
+                                   defer=abft_defer and c_zero,
+                                   shape_key=bin_key)
     except Exception as exc:  # noqa: BLE001 — classified + recorded
         kind = _classify_failure(exc)
         board.record_failure(FUSED_DRIVER, bin_key, kind=kind)
         _record_driver_failure(FUSED_DRIVER, kind, exc, bin_key)
+        if c_zero and _is_deleted(base):
+            # the copy was skipped (pristine C is zeros): rebuild it
+            base = jnp.zeros(c_data.shape, np.dtype(c_data.dtype))
         if _is_deleted(base):
             # the failing launch consumed (donated) the only copy of
             # the bin's C buffer: per-span recovery is impossible here
             raise
         _record_fallback(FUSED_DRIVER, "per_span", bin_key)
-        return _decompose_superstack(
+        out = _decompose_superstack(
             base, a_datas, b_datas, plans, alpha, c_zero,
-            why=f"{type(exc).__name__}: {exc}"), False
+            why=f"{type(exc).__name__}: {exc}")
+        if kind == "sdc":
+            _abft.record_recovery(FUSED_DRIVER)
+        return out, False
     board.record_success(FUSED_DRIVER, bin_key)
     return out, True
 

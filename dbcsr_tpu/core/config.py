@@ -95,6 +95,16 @@ class Config:
     # deadline assigned under a DEGRADED health verdict when the
     # request didn't bring its own (seconds)
     serve_degraded_deadline_s: float = 10.0
+    # ---- end-to-end data integrity (acc/abft.py; env DBCSR_TPU_ABFT) --
+    # ABFT probe checksums at the stack/superstack boundary: "off" (no
+    # checks — the production default), "verify" (rank-1 C·v vs
+    # A·(B·v) probe per launch; a mismatch classifies `sdc`, feeds the
+    # per-(driver, shape) breaker and re-executes down the failover
+    # chain), "recover" (verify, plus every recovery re-execution is
+    # itself probe-checked before being accepted).  The knob also arms
+    # the chain-invariant rollback in models/ and the serving plane's
+    # per-request probe (docs/resilience.md § ABFT).
+    abft: str = "off"
     # platform-injection seam (VERDICT r4 item 5): "" = the real JAX
     # backend platform; "tpu"/"cpu" makes every dispatch DECISION
     # (_pallas_supported, _dense_mode_wanted, emulated-dtype R-tiling)
@@ -143,6 +153,9 @@ class Config:
             raise ValueError("serve_tenant_bytes must be positive")
         if self.serve_degraded_deadline_s <= 0:
             raise ValueError("serve_degraded_deadline_s must be positive")
+        if self.abft not in ("off", "verify", "recover"):
+            raise ValueError(
+                f"abft must be 'off'/'verify'/'recover', got {self.abft!r}")
 
 
 _cfg = Config()

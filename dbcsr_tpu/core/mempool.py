@@ -339,6 +339,82 @@ def upload_index(tag: str, arr) -> object:
     return dev
 
 
+# ----------------------------------------------------------- snapshots
+
+class SnapshotError(RuntimeError):
+    """Structured checkpoint/rollback contract violation (e.g. restoring
+    a snapshot whose matrix was already retired to the pool)."""
+
+
+class MatrixSnapshot:
+    """A pooled, device-resident point-in-time checkpoint of one
+    matrix: host index arrays plus fresh device copies of every bin
+    buffer.  Built by `snapshot_matrix` / `chain.snapshot`, applied by
+    `restore_matrix` / `chain.restore`.  The snapshot owns its copies
+    exclusively (never aliased into the matrix), so it stays valid
+    across any later mutation, donation, or failure of the source —
+    and one snapshot can be restored more than once (each restore
+    installs fresh copies)."""
+
+    __slots__ = ("matrix", "keys", "row_ptr", "ent_bin", "ent_slot",
+                 "bins", "valid", "chain_owner")
+
+    def __init__(self, m, chain_owner: Optional["chain"] = None):
+        import jax.numpy as _jnp
+
+        self.matrix = m
+        self.keys = m.keys.copy()
+        self.row_ptr = m.row_ptr.copy()
+        self.ent_bin = m.ent_bin.copy()
+        self.ent_slot = m.ent_slot.copy()
+        self.bins = [(b.shape, _jnp.array(b.data, copy=True), b.count)
+                     for b in m.bins]
+        self.valid = m.valid
+        self.chain_owner = chain_owner
+
+    def nbytes(self) -> int:
+        return sum(_arr_bytes(d) for _, d, _ in self.bins)
+
+
+def snapshot_matrix(m, chain_owner: Optional["chain"] = None
+                    ) -> MatrixSnapshot:
+    """Checkpoint ``m``'s structure and device data (see
+    `MatrixSnapshot`)."""
+    return MatrixSnapshot(m, chain_owner=chain_owner)
+
+
+def restore_matrix(snap: MatrixSnapshot):
+    """Roll ``snap.matrix`` back to the snapshotted state: structure
+    fields replaced, bins rebuilt from FRESH copies of the snapshot's
+    device data (the snapshot stays reusable).  The replaced bin
+    buffers are donated back to the pool only when the matrix owns
+    them exclusively — `copy()`-shared bins are NEVER restored via
+    donation (the other side still reads them).  Returns the matrix."""
+    from dbcsr_tpu.core.matrix import _Bin
+
+    import jax.numpy as _jnp
+
+    m = snap.matrix
+    donatable = m._donatable  # decided on the PRE-restore aliasing
+    old_data = [b.data for b in m.bins] if donatable else None
+    m.keys = snap.keys.copy()
+    m.row_ptr = snap.row_ptr.copy()
+    m.ent_bin = snap.ent_bin.copy()
+    m.ent_slot = snap.ent_slot.copy()
+    m.bins = [_Bin(shape, _jnp.array(data, copy=True), count)
+              for shape, data, count in snap.bins]
+    m._shape_to_bin = {b.shape: i for i, b in enumerate(m.bins)}
+    m._work.clear()
+    m._work_batches.clear()
+    m.invalidate_dense_cache()
+    m._bins_shared = False  # restored bins are exclusively owned again
+    m.valid = snap.valid
+    if old_data is not None:
+        for d in old_data:
+            release(d)
+    return m
+
+
 # -------------------------------------------------------------- chains
 
 # per-THREAD chain stack: the obs server (and the roadmap's concurrent
@@ -387,6 +463,13 @@ class chain:
 
     def __init__(self):
         self._adopted: dict = {}  # id(matrix) -> matrix
+        # retirement is stamped ON the matrix object (_chain_retired),
+        # never tracked as a raw id: a retired matrix's id is eligible
+        # for CPython reuse the moment the last reference drops, and a
+        # stale id in a set would make `restore` spuriously reject a
+        # LEGITIMATE rollback of a later same-address matrix.  Every
+        # restorable snapshot holds a strong reference to its matrix,
+        # so the attribute is always authoritative.
 
     def __enter__(self) -> "chain":
         _stack().append(self)
@@ -417,7 +500,35 @@ class chain:
         input is never freed)."""
         tracked = self._adopted.pop(id(m), None)
         if tracked is not None:
+            tracked._chain_retired = True
             tracked.free()
+
+    def snapshot(self, m) -> MatrixSnapshot:
+        """Pooled, device-resident checkpoint of ``m`` (any matrix —
+        chain-owned or a caller input), restorable through
+        `chain.restore`.  The rollback half of the chain-integrity
+        contract: models checkpoint the accepted iterate before a step
+        and roll back instead of iterating on a corrupted one
+        (docs/resilience.md § Chain checkpoint/rollback)."""
+        return snapshot_matrix(m, chain_owner=self)
+
+    def restore(self, snap: MatrixSnapshot):
+        """Roll the snapshotted matrix back to its checkpoint.
+
+        Structured errors instead of silent corruption: restoring a
+        matrix that was `retire`d after the snapshot raises
+        `SnapshotError` (its buffers are pool property now).  Ownership
+        is NEVER changed by a restore — a matrix adopted by an outer
+        chain stays the outer chain's to free, whichever (nested) chain
+        performs the restore; `copy()`-shared bins are never donated by
+        the restore (see `restore_matrix`)."""
+        if getattr(snap.matrix, "_chain_retired", False):
+            raise SnapshotError(
+                f"cannot restore {snap.matrix.name!r}: the matrix was "
+                f"retired after the snapshot (its buffers belong to "
+                f"the pool; take the snapshot before retiring, or "
+                f"defer the retire until the iterate is validated)")
+        return restore_matrix(snap)
 
     def scope(self):
         """Context manager for one split/iteration of a loop running
@@ -439,6 +550,7 @@ class chain:
                 for key in [k for k in self._adopted if k not in before]:
                     m = self._adopted.pop(key, None)
                     if m is not None:
+                        m._chain_retired = True
                         try:
                             m.free()
                         except Exception:

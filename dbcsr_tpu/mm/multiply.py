@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dbcsr_tpu.core import mempool, stats
+from dbcsr_tpu.acc import abft as _abft
 from dbcsr_tpu.core.kinds import is_complex
 from dbcsr_tpu.core.matrix import (
     NO_SYMMETRY,
@@ -456,6 +457,10 @@ def _note_dense_fallback(exc: BaseException) -> None:
     kind = _smm._classify_failure(exc)
     _smm._record_driver_failure("dense", kind, exc, ())
     _smm._record_fallback("dense", "stack", ())
+    if kind == "sdc":
+        # C was untouched (held-identity check) and the stack engine
+        # recomputes the product: the detected dense SDC is healed
+        _abft.record_recovery("dense")
     _flight.note("dense_fallback", f"{type(exc).__name__}: {exc}"[:200])
 
 
@@ -738,9 +743,17 @@ def _dense_multiply_general(a, b, c, alpha, beta) -> int:
         beta_dev = _dense_const(("scalar", complex(beta), dt_name),
                                 lambda: jnp.asarray(beta, dtype=c.dtype))
         cd = alpha_dev * cd
-        if beta != 0 and c.nblks:
-            cd = cd + beta_dev * _to_dense_device(c)
+        c_old_dense = (_to_dense_device(c)
+                       if beta != 0 and c.nblks else None)
+        if c_old_dense is not None:
+            cd = cd + beta_dev * c_old_dense
         cd = _dense_guard(cd)
+        if _abft.enabled():
+            _abft.check_dense_canvas(cd, ad, bd, c_old_dense, alpha,
+                                     beta, dtype=c.dtype)
+        # the old-C canvas (possibly hundreds of MB) must not stay
+        # alive through carve/finalize: its uses end here
+        del c_old_dense
         if profile:
             _ff(cd)
     with timed("dense_carve"):
@@ -941,6 +954,18 @@ def _dense_multiply(a, b, c, alpha, beta) -> int:
             carve=_carve_choice(),
         )
     out = _dense_guard(out)
+    if _abft.enabled():
+        # the carved block pattern IS a layout permutation of the
+        # result canvas: un-permute and probe-verify against the
+        # operand canvases (+ the old-C canvas when beta != 0)
+        res_canvas = (out.reshape(nbr, nbc, bm, bn)
+                      .transpose(0, 2, 1, 3).reshape(nbr * bm, nbc * bn))
+        c_old_canvas = (_build(c, nbr, nbc, bm, bn)
+                        if beta != 0 and c.nblks else None)
+        _abft.check_dense_canvas(res_canvas, ad, bd, c_old_canvas,
+                                 alpha, beta, dtype=c.dtype)
+        # probe canvases are full-N^2 buffers: release before finalize
+        del res_canvas, c_old_canvas
     with timed("dense_finalize"):
         new_keys = np.arange(nbr * nbc, dtype=np.int64)  # full pattern, row-major
         cap = bucket_size(len(new_keys))
@@ -1489,96 +1514,149 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
     # (the default records dispatch-side seconds — the device may still
     # be draining; stats.record_driver documents the contract)
     sync = stats.sync_timing_enabled()
-    flops = 0
-    # beta == 0 (no window): _rebuild_c left every bin as untouched
-    # jnp.zeros — the host driver can then synthesize its writable host
-    # buffer as np.zeros instead of fetching ~hundreds of MB of zeros
-    # off the device (first touch per bin only: later spans accumulate
-    # onto real contributions; a fused launch counts as the whole bin's
-    # first touch)
-    zero_bins = set(range(len(c.bins))) if c_zero else set()
     itemsize = np.dtype(c.dtype).itemsize
     dt_name = str(np.dtype(c.dtype))
     # drivers that do not donate C (host family) leave the replaced
     # buffer alive: pool-owned Cs hand it back for the next checkout
     c_releasable = c._donatable
+    # Deferred ABFT: a beta==0 product's pristine C is all zeros, so
+    # the whole product is re-executable from metadata alone.  The
+    # per-launch probes then queue their device-side scalars WITHOUT a
+    # host sync (preserving host/device pipelining) and one flush at
+    # the end of the product drains them; a flush-detected mismatch
+    # rolls every bin back to zeros and re-executes with immediate
+    # per-launch verification (where the smm failover chain localizes
+    # and recovers).  beta != 0 launches keep immediate checks — their
+    # pristine C exists only as the per-launch copy.
+    abft_defer = bool(c_zero) and _abft.enabled()
 
     def _swap_cbin(cbin, out):
         old = c.bins[cbin].data
         c.bins[cbin].data = out
         if c_releasable and out is not old:
             mempool.release(old)  # no-op for donated (deleted) buffers
-    fused_bins = 0
-    i = 0
-    n_spans = len(spans_meta)
-    while i < n_spans:
-        # spans sharing a C bin are adjacent (the group key sorts by
-        # (cbin, abin, bbin)) — one slice per destination bin
-        j = i
-        cbin = spans_meta[i][0]
-        while j < n_spans and spans_meta[j][0] == cbin:
-            j += 1
-        group = spans_meta[i:j]
-        splan = None
-        if mode != "per_span" and j - i > 1:
-            splan = cached.superstack_for(
-                cbin, [sm[7] for sm in group], prepare_superstack)
-        if splan is not None:
-            a_datas = [a.bins[sm[1]].data for sm in group]
-            b_datas = [b.bins[sm[2]].data for sm in group]
-            t0 = time.perf_counter()
-            out, was_fused = execute_superstack(
-                c.bins[cbin].data, a_datas, b_datas, splan, alpha,
-                c_zero=cbin in zero_bins,
-            )
-            if sync:
-                jax.block_until_ready(out)
-            dt_s = time.perf_counter() - t0
-            _swap_cbin(cbin, out)
-            zero_bins.discard(cbin)
-            fused_bins += was_fused
-            nseg = out.shape[0]
-            span_flops = [2 * m * n * k * cnt
-                          for (_, _, _, m, n, k, cnt, _) in group]
-            tot_flops = float(sum(span_flops)) or 1.0
-            for gi, (_cb, _ab, _bb, m, n, k, cnt, plan) in enumerate(group):
-                # the launch's seconds split across its spans by flop
-                # share; a FUSED launch reads+writes the bin's C buffer
-                # ONCE, so only the first span is charged that round
-                # trip (costmodel.superstack_bytes convention) — but a
-                # bin the resilience layer decomposed really paid the
-                # per-span round-trips, and records them as such
+
+    def _exec_spans(defer):
+        # beta == 0 (no window): _rebuild_c left every bin as untouched
+        # jnp.zeros — the host driver can then synthesize its writable
+        # host buffer as np.zeros instead of fetching ~hundreds of MB
+        # of zeros off the device (first touch per bin only: later
+        # spans accumulate onto real contributions; a fused launch
+        # counts as the whole bin's first touch)
+        zero_bins = set(range(len(c.bins))) if c_zero else set()
+        flops = 0
+        fused_bins = 0
+        i = 0
+        n_spans = len(spans_meta)
+        while i < n_spans:
+            # spans sharing a C bin are adjacent (the group key sorts
+            # by (cbin, abin, bbin)) — one slice per destination bin
+            j = i
+            cbin = spans_meta[i][0]
+            while j < n_spans and spans_meta[j][0] == cbin:
+                j += 1
+            group = spans_meta[i:j]
+            splan = None
+            if mode != "per_span" and j - i > 1:
+                splan = cached.superstack_for(
+                    cbin, [sm[7] for sm in group], prepare_superstack)
+            if splan is not None:
+                a_datas = [a.bins[sm[1]].data for sm in group]
+                b_datas = [b.bins[sm[2]].data for sm in group]
+                t0 = time.perf_counter()
+                out, was_fused = execute_superstack(
+                    c.bins[cbin].data, a_datas, b_datas, splan, alpha,
+                    c_zero=cbin in zero_bins, abft_defer=defer,
+                )
+                if sync:
+                    jax.block_until_ready(out)
+                dt_s = time.perf_counter() - t0
+                _swap_cbin(cbin, out)
+                zero_bins.discard(cbin)
+                fused_bins += was_fused
+                nseg = out.shape[0]
+                span_flops = [2 * m * n * k * cnt
+                              for (_, _, _, m, n, k, cnt, _) in group]
+                tot_flops = float(sum(span_flops)) or 1.0
+                for gi, (_cb, _ab, _bb, m, n, k, cnt, plan) \
+                        in enumerate(group):
+                    # the launch's seconds split across its spans by
+                    # flop share; a FUSED launch reads+writes the bin's
+                    # C buffer ONCE, so only the first span is charged
+                    # that round trip (costmodel.superstack_bytes
+                    # convention) — but a bin the resilience layer
+                    # decomposed really paid the per-span round-trips,
+                    # and records them as such
+                    stats.record_stack(
+                        m, n, k, cnt, driver=plan.driver,
+                        seconds=dt_s * (span_flops[gi] / tot_flops),
+                        nbytes=_costmodel.stack_bytes(
+                            m, n, k, cnt,
+                            nseg=(nseg if (gi == 0 or not was_fused)
+                                  else 0),
+                            itemsize=itemsize),
+                        dtype=dt_name, sync=sync,
+                    )
+                    flops += span_flops[gi]
+                i = j
+                continue
+            for _cb, abin, bbin, m, n, k, cnt, plan in group:
+                t0 = time.perf_counter()
+                out = execute_stack(
+                    c.bins[cbin].data, a.bins[abin].data,
+                    b.bins[bbin].data, plan, alpha,
+                    c_zero=cbin in zero_bins, abft_defer=defer,
+                )
+                if sync:
+                    jax.block_until_ready(out)
+                dt_s = time.perf_counter() - t0
+                _swap_cbin(cbin, out)
+                zero_bins.discard(cbin)
                 stats.record_stack(
-                    m, n, k, cnt, driver=plan.driver,
-                    seconds=dt_s * (span_flops[gi] / tot_flops),
+                    m, n, k, cnt, driver=plan.driver, seconds=dt_s,
                     nbytes=_costmodel.stack_bytes(
-                        m, n, k, cnt,
-                        nseg=(nseg if (gi == 0 or not was_fused) else 0),
+                        m, n, k, cnt, nseg=out.shape[0],
                         itemsize=itemsize),
                     dtype=dt_name, sync=sync,
                 )
-                flops += span_flops[gi]
+                flops += 2 * m * n * k * cnt
             i = j
-            continue
-        for _cb, abin, bbin, m, n, k, cnt, plan in group:
-            t0 = time.perf_counter()
-            out = execute_stack(
-                c.bins[cbin].data, a.bins[abin].data, b.bins[bbin].data,
-                plan, alpha, c_zero=cbin in zero_bins,
-            )
-            if sync:
-                jax.block_until_ready(out)
-            dt_s = time.perf_counter() - t0
-            _swap_cbin(cbin, out)
-            zero_bins.discard(cbin)
-            stats.record_stack(
-                m, n, k, cnt, driver=plan.driver, seconds=dt_s,
-                nbytes=_costmodel.stack_bytes(
-                    m, n, k, cnt, nseg=out.shape[0], itemsize=itemsize),
-                dtype=dt_name, sync=sync,
-            )
-            flops += 2 * m * n * k * cnt
-        i = j
+        return flops, fused_bins
+
+    recovered_from = None
+    for attempt in (0, 1):
+        defer = abft_defer and attempt == 0
+        if defer:
+            _abft.discard_pending()
+        try:
+            flops, fused_bins = _exec_spans(defer)
+        except BaseException:
+            if defer:
+                # an unrelated failure aborted the product: its queued
+                # probes must never be attributed to a later one
+                _abft.discard_pending()
+            raise
+        if not defer:
+            break
+        try:
+            _abft.flush()
+            break
+        except _abft.AbftMismatchError as exc:
+            from dbcsr_tpu.acc import smm as _smm
+
+            _smm.note_deferred_sdc(exc)
+            recovered_from = getattr(exc, "mismatch_drivers", None) \
+                or [getattr(exc, "driver", "?")]
+            # roll every bin back to its pristine (all-zero) pre-run
+            # state and redo the product with immediate verification
+            for bin_ in c.bins:
+                old = bin_.data
+                bin_.data = mempool.zeros(old.shape, c.dtype)
+                if c_releasable:
+                    mempool.release(old)
+    if recovered_from is not None:
+        for drv in recovered_from:
+            _abft.record_recovery(drv)
     if fused_bins:
         _flight.note("fused_bins", fused_bins)
     if plan_key is not None and plan_key in _plan_cache:

@@ -18,6 +18,7 @@ also the stress case for the stack-plan cache.
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple
 
 import numpy as np
@@ -25,6 +26,7 @@ import numpy as np
 from dbcsr_tpu.core import mempool
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
 from dbcsr_tpu.mm.multiply import multiply
+from dbcsr_tpu.models import integrity as _integrity
 from dbcsr_tpu.ops.operations import add_on_diag, frobenius_norm, gershgorin_norm, scale
 
 
@@ -82,10 +84,20 @@ def invsqrt_iteration(
     z = _identity_like(s)
     # one residency chain for the whole coupled iteration: each
     # replaced iterate and residual returns its bins to the pool; the
-    # converged Z escapes via detach
+    # converged Z escapes via detach.
+    # Integrity guard (models/integrity.py): the residual norm must
+    # stay contraction-monotone and the fresh iterates' Frobenius
+    # norms inside the Newton–Schulz growth bound (||T|| <= 1.5 near
+    # convergence) — BOTH checked before the previous iterates retire,
+    # so a violating step recomputes from the still-live y/z/t on the
+    # safe engine instead of iterating on a corrupted pair
+    guard = _integrity.guard_enabled()
+    prev_res = None
     with mempool.chain() as ch:
         ch.adopt(y)
         ch.adopt(z)
+        ny = frobenius_norm(y) if guard else None
+        nz = frobenius_norm(z) if guard else None
         for it in range(max_iter):
             # residual R = I - Z Y — doubles as the step's T = I + R/2
             # (T = (3I - Z Y)/2), so each iteration is 3 multiplies total
@@ -93,9 +105,48 @@ def invsqrt_iteration(
                                   s.dtype, s.dist)
             multiply("N", "N", -1.0, z, y, 0.0, r, filter_eps=filter_eps)
             add_on_diag(r, 1.0)
-            if frobenius_norm(r) < tol:
+            res = frobenius_norm(r)
+            # ||I - Z Y||_F <= sqrt(N) + ||Z||·||Y|| (submultiplicative
+            # — valid on ANY input, so even the FIRST residual, which
+            # has no previous value to compare against, is bounded)
+            res_limit = (s.nfullrows ** 0.5 + nz * ny) if guard else None
+
+            def _res_ok(val, res_limit=res_limit, prev=prev_res):
+                return (math.isfinite(val)
+                        and _integrity.norm_ok(val, res_limit)
+                        and (prev is None
+                             or val <= max(4.0 * prev, prev + 1.0)))
+
+            if guard and not _res_ok(res):
+                # the residual multiply itself produced a corrupted
+                # residual: recompute it from the still-live
+                # (invariant-accepted) y/z
+                _integrity.record_rollback(
+                    "invsqrt", it, "residual",
+                    detail=f"res {res:.3e} prev {prev_res!r}")
+                ch.retire(r)
+                seen = {}
+
+                def _build_r(y=y, z=z):
+                    r2 = BlockSparseMatrix("R", s.row_blk_sizes,
+                                           s.col_blk_sizes, s.dtype,
+                                           s.dist)
+                    multiply("N", "N", -1.0, z, y, 0.0, r2,
+                             filter_eps=filter_eps)
+                    add_on_diag(r2, 1.0)
+                    return r2
+
+                def _validate_r(cand):
+                    seen["res"] = frobenius_norm(cand)
+                    return _res_ok(seen["res"])
+
+                r = _integrity.recompute_step(
+                    ch, _build_r, _validate_r, "invsqrt", it, "residual")
+                res = seen["res"]
+            if res < tol:
                 ch.detach(z)
                 return z, sf, it
+            prev_res = res
             t = r
             scale(t, 0.5)
             add_on_diag(t, 1.0)
@@ -105,6 +156,46 @@ def invsqrt_iteration(
             z2 = BlockSparseMatrix("Z'", s.row_blk_sizes, s.col_blk_sizes,
                                    s.dtype, s.dist)
             multiply("N", "N", 1.0, t, z, 0.0, z2, filter_eps=filter_eps)
+            if guard:
+                # ||Y T||_F <= ||Y||_F * ||T||_F (submultiplicativity:
+                # valid on any input, cannot false-positive)
+                nt = frobenius_norm(t)
+                ny2, nz2 = frobenius_norm(y2), frobenius_norm(z2)
+                if not (_integrity.norm_ok(ny2, ny * nt)
+                        and _integrity.norm_ok(nz2, nt * nz)):
+                    _integrity.record_rollback(
+                        "invsqrt", it, "invariant",
+                        detail=f"|Y'| {ny2:.3e} |Z'| {nz2:.3e}")
+                    ch.retire(y2)
+                    ch.retire(z2)
+                    seen = {}
+
+                    def _build_yz(y=y, z=z, t=t):
+                        ya = BlockSparseMatrix("Y'", s.row_blk_sizes,
+                                               s.col_blk_sizes, s.dtype,
+                                               s.dist)
+                        multiply("N", "N", 1.0, y, t, 0.0, ya,
+                                 filter_eps=filter_eps)
+                        za = BlockSparseMatrix("Z'", s.row_blk_sizes,
+                                               s.col_blk_sizes, s.dtype,
+                                               s.dist)
+                        multiply("N", "N", 1.0, t, z, 0.0, za,
+                                 filter_eps=filter_eps)
+                        return ya, za
+
+                    def _validate_yz(cand, nt=nt):
+                        ya, za = cand
+                        seen["ny"] = frobenius_norm(ya)
+                        seen["nz"] = frobenius_norm(za)
+                        return (_integrity.norm_ok(seen["ny"], ny * nt)
+                                and _integrity.norm_ok(seen["nz"],
+                                                       nt * nz))
+
+                    y2, z2 = _integrity.recompute_step(
+                        ch, _build_yz, _validate_yz, "invsqrt", it,
+                        "invariant")
+                    ny2, nz2 = seen["ny"], seen["nz"]
+                ny, nz = ny2, nz2
             ch.retire(t)
             ch.retire(y)
             ch.retire(z)

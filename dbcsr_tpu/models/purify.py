@@ -8,6 +8,7 @@ for benchmarking and the multi-chip dry run.
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import numpy as np
@@ -15,7 +16,8 @@ import numpy as np
 from dbcsr_tpu.core import mempool
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
 from dbcsr_tpu.mm.multiply import multiply
-from dbcsr_tpu.ops.operations import add, trace
+from dbcsr_tpu.models import integrity as _integrity
+from dbcsr_tpu.ops.operations import add, frobenius_norm, trace
 from dbcsr_tpu.parallel.dist_matrix import DistMatrix, multiply_distributed
 
 
@@ -45,6 +47,37 @@ def mcweeny_step(
     return out
 
 
+def _purify_invariant(new: BlockSparseMatrix, norm_ref: float,
+                      history) -> tuple:
+    """Per-iteration integrity invariant of a purification iterate:
+    the trace stays inside the eigenvalue-mapped bounds (McWeeny maps
+    [-0.5, 1.5] spectra into [0, 1], so tr(P') in [0, N] up to
+    rounding), the Frobenius norm obeys the contraction growth bound,
+    and the trace-delta convergence measure stays monotone (quadratic
+    convergence; x4 slack).  Returns (ok, trace, norm)."""
+    tr = trace(new)
+    nn = frobenius_norm(new)
+    n = new.nfullrows
+    slack = 0.5 + 1e-6 * n
+    # ||3P²-2P³||_F <= 3||P||² + 2||P||³ (Frobenius submultiplicativity:
+    # valid on ANY input, so the check cannot false-positive)
+    limit = 3.0 * norm_ref ** 2 + 2.0 * norm_ref ** 3
+    ok = _integrity.norm_ok(nn, limit)
+    # the domain-dependent checks (McWeeny maps [-0.5, 1.5] spectra
+    # into [0, 1], so tr(P') in [0, N] and the trace-delta convergence
+    # measure contracts) apply only while the iterate plausibly IS a
+    # density matrix — spectra in that interval imply
+    # ||P||_F <= 1.5*sqrt(N)
+    in_domain = norm_ref <= 1.5 * n ** 0.5 + 1.0
+    if ok and in_domain:
+        ok = math.isfinite(tr) and -slack <= tr <= n + slack
+        if ok and len(history) >= 2:
+            d_prev = abs(history[-1] - history[-2])
+            d_new = abs(tr - history[-1])
+            ok = d_new <= max(4.0 * d_prev, d_prev + 1.0)
+    return ok, tr, nn
+
+
 def mcweeny_purify(
     p: BlockSparseMatrix,
     steps: int = 5,
@@ -57,16 +90,58 @@ def mcweeny_purify(
     The whole loop shares one `chain`: each iterate is retired (its
     device bins donated back to the pool) the moment its successor
     exists — the caller's input is never touched, and the final P
-    escapes the chain."""
+    escapes the chain.
+
+    Integrity guard (`models/integrity.py`, armed when the ABFT knob is
+    on or faults are active): the accepted iterate is checkpointed
+    (`chain.snapshot`) before each step, the fresh iterate is verified
+    against trace bounds / norm growth / trace-delta monotonicity, and
+    a violating step ROLLS BACK — the corrupted iterate retires to the
+    pool, the checkpoint restores, and the step recomputes on the safe
+    engine — instead of purifying a silently-corrupted P into confident
+    convergence."""
+    guard = _integrity.guard_enabled()
     history = []
     with mempool.chain() as ch:
         cur = p
-        for _ in range(steps):
+        cur_norm = frobenius_norm(cur) if guard else None
+        for step_i in range(steps):
+            snap = ch.snapshot(cur) if guard else None
             new = mcweeny_step(cur, filter_eps=filter_eps)
+            tr_new = None
+            if guard:
+                ok, tr_new, nn = _purify_invariant(new, cur_norm,
+                                                   history)
+                if not ok:
+                    _integrity.record_rollback(
+                        "purify", step_i, "invariant",
+                        detail=f"norm {nn:.3e} ref {cur_norm:.3e}")
+                    ch.retire(new)
+                    if cur is not p:
+                        cur = ch.restore(snap)
+                    seen = {}
+
+                    def _build(cur=cur):
+                        return mcweeny_step(cur, filter_eps=filter_eps)
+
+                    def _validate(cand):
+                        ok2, tr2, nn2 = _purify_invariant(cand, cur_norm,
+                                                          history)
+                        seen["nn"] = nn2
+                        seen["tr"] = tr2
+                        return ok2
+
+                    new = _integrity.recompute_step(
+                        ch, _build, _validate, "purify", step_i,
+                        "invariant")
+                    nn = seen["nn"]
+                    tr_new = seen["tr"]
+                cur_norm = nn
             if cur is not p:
                 ch.retire(cur)
             cur = new
-            history.append(trace(cur))
+            # the guarded invariant already paid trace(new): reuse it
+            history.append(trace(cur) if tr_new is None else tr_new)
             if tol is not None and len(history) > 1:
                 if abs(history[-1] - history[-2]) < tol:
                     break

@@ -10,11 +10,13 @@ exactly the way `dbcsr_tests`' chained multiplies do.
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 from dbcsr_tpu.core import mempool
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
 from dbcsr_tpu.mm.multiply import multiply
+from dbcsr_tpu.models import integrity as _integrity
 from dbcsr_tpu.ops.operations import (
     add_on_diag,
     added,
@@ -66,14 +68,55 @@ def sign_iteration(
         a = desymmetrize(a)  # iterates mix with plain multiply results
     g = gershgorin_norm(a)
     x0 = x = scale(copy(a, name="X"), 1.0 / g if g > 0 else 1.0)
+    # integrity guard (models/integrity.py): checkpoint the accepted
+    # iterate before each step, verify the fresh iterate's norm growth
+    # bound (Newton–Schulz is a contraction for the Gershgorin-scaled
+    # input, so a finite SDC flip explodes ||X'||_F), and roll back +
+    # recompute on the safe engine on violation
+    guard = _integrity.guard_enabled()
     history = []
     with mempool.chain() as ch:
-        for _ in range(steps):
+        x_norm = frobenius_norm(x) if guard else None
+        for step_i in range(steps):
+            snap = ch.snapshot(x) if guard else None
             x_new = sign_step(x, filter_eps=filter_eps)
             # out-of-place diff: no copy, so neither iterate is ever
             # marked shared and both keep donating to the pool
             diff = added(x_new, x, 1.0, -1.0, name="diff")
-            history.append(frobenius_norm(diff))
+            metric = frobenius_norm(diff)
+            if guard:
+                nn = frobenius_norm(x_new)
+                # ||X(3I - X²)/2||_F <= (3*sqrt(N)*||X|| + ||X||³)/2
+                # (Frobenius submultiplicativity — valid on any input)
+                limit = 0.5 * (3.0 * x.nfullrows ** 0.5 * x_norm
+                               + x_norm ** 3)
+                if not (_integrity.norm_ok(nn, limit)
+                        and math.isfinite(metric)):
+                    _integrity.record_rollback(
+                        "sign", step_i, "invariant",
+                        detail=f"norm {nn:.3e} ref {x_norm:.3e}")
+                    ch.retire(diff)
+                    ch.retire(x_new)
+                    x = ch.restore(snap)
+                    seen = {}
+
+                    def _build(x=x):
+                        xn = sign_step(x, filter_eps=filter_eps)
+                        return xn, added(xn, x, 1.0, -1.0, name="diff")
+
+                    def _validate(cand, limit=limit):
+                        xn, df = cand
+                        seen["metric"] = frobenius_norm(df)
+                        seen["nn"] = frobenius_norm(xn)
+                        return (_integrity.norm_ok(seen["nn"], limit)
+                                and math.isfinite(seen["metric"]))
+
+                    x_new, diff = _integrity.recompute_step(
+                        ch, _build, _validate, "sign", step_i,
+                        "invariant")
+                    metric, nn = seen["metric"], seen["nn"]
+                x_norm = nn
+            history.append(metric)
             ch.retire(diff)
             if x is not x0:
                 ch.retire(x)

@@ -88,6 +88,35 @@ def intensity(flops: float, nbytes: float) -> float:
     return float(flops) / float(nbytes) if nbytes else 0.0
 
 
+# machine epsilon of the ACCUMULATION dtype each engine dtype uses
+# (bf16 accumulates in f32, acc/smm._accum_dtype) — stdlib-only so the
+# tolerance stays computable without jax/numpy imported
+_ACC_EPS = {
+    "float64": 2.220446049250313e-16,
+    "complex128": 2.220446049250313e-16,
+    "float32": 1.1920929e-07,
+    "complex64": 1.1920929e-07,
+    "bfloat16": 1.1920929e-07,  # f32 accumulation
+    "float16": 9.765625e-04,
+}
+
+
+def abft_tolerance(dtype: str, k: int, depth: int) -> float:
+    """Relative tolerance of an ABFT probe-checksum comparison: the
+    rank-1 probe ``C·v`` vs ``A·(B·v)`` evaluates the same bilinear
+    form along two association orders, so the legitimate disagreement
+    is pure rounding — bounded by the accumulation dtype's epsilon
+    times the reduction lengths (``k`` inner-product terms per entry,
+    ``depth`` entries accumulated per C segment).  The constant is an
+    engineering margin (false positives trigger a failover walk, far
+    more expensive than a slightly blunter detector); injected/real SDC
+    perturbs O(1) values, orders of magnitude above this floor."""
+    eps = _ACC_EPS.get(str(dtype), 1.1920929e-07)
+    k = max(int(k), 1)
+    depth = max(int(depth), 1)
+    return 64.0 * eps * (k + 1) * float(depth + 1) ** 0.5
+
+
 # ------------------------------------------------------- roofline table
 
 # Per-device_kind peaks.  Matching is by lowercase substring of

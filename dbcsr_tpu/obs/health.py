@@ -24,6 +24,16 @@ prints.
   the pool's byte budget is below the chain's working set) and an
   active serving-plane shed storm; the per-driver roofline fractions
   and pool counters ride along.
+* ``integrity`` — the end-to-end data-integrity plane (`acc.abft` +
+  `models.integrity`): any ABFT probe mismatch (detected silent data
+  corruption) or chain-invariant rollback degrades — the answer was
+  healed, but the hardware produced a wrong finite result.  CRITICAL
+  is reserved for corruption that ESCAPED recovery (mismatches
+  exceeding recoveries) when repeated — from one driver at
+  ``DBCSR_TPU_HEALTH_SDC_CRITICAL`` = 3 mismatches, or 3 unrecovered
+  in total; fully-recovered SDC storms stay DEGRADED, the breaker
+  owns quarantining the offending driver (docs/resilience.md
+  § Runbook: silent data corruption).
 
 **Anomaly detectors** (rolling windows over the last
 ``DBCSR_TPU_HEALTH_WINDOW`` = 64 multiplies, fed by
@@ -531,6 +541,65 @@ def _eval_perf() -> dict:
                       "bytes_held", "high_water") if k in pool}}
 
 
+def _eval_integrity() -> dict:
+    """The data-integrity component: detected-SDC and recovery
+    counters folded into a verdict.  A recovered mismatch still
+    degrades — the device produced a wrong finite answer and the next
+    one may not be caught; repeated mismatches attributed to one
+    driver are critical (deterministic corruption, quarantine-level
+    evidence)."""
+    status, reasons = OK, []
+    mism: dict = {}
+    for key, v in _counter_by("dbcsr_tpu_abft_mismatches_total").items():
+        d = dict(key).get("driver", "?")
+        mism[d] = mism.get(d, 0) + int(v)
+    total = sum(mism.values())
+    rollbacks = _counter_total("dbcsr_tpu_chain_rollback_total")
+    recoveries = _counter_total("dbcsr_tpu_abft_recoveries_total")
+    # recoveries pair with mismatches EXCEPT the chain labels, which
+    # pair with rollbacks (a chain recompute heals an invariant
+    # violation, not a counted probe mismatch)
+    recov_sdc = sum(
+        float(v) for key, v in _counter_by(
+            "dbcsr_tpu_abft_recoveries_total").items()
+        if not dict(key).get("driver", "").startswith("chain:"))
+    unrecovered = max(0, total - int(recov_sdc))
+    if total:
+        status = DEGRADED
+        reasons.append(
+            f"{total} ABFT probe mismatch(es) — detected silent data "
+            f"corruption: " + ", ".join(
+                f"{d}={n}" for d, n in sorted(mism.items())))
+    if rollbacks:
+        status = DEGRADED if status == OK else status
+        reasons.append(f"{int(rollbacks)} chain-invariant rollback(s) "
+                       f"recomputed on the safe engine")
+    crit_n = _env_int("DBCSR_TPU_HEALTH_SDC_CRITICAL", 3)
+    repeat = {d: n for d, n in mism.items() if n >= crit_n}
+    # fully-recovered SDC — detect → re-execute → verified — leaves the
+    # verdict DEGRADED however often it repeats (the breaker owns
+    # quarantining a driver that keeps corrupting); CRITICAL is
+    # reserved for corruption that ESCAPED recovery: a wrong answer
+    # may have reached a caller
+    if unrecovered and (repeat or unrecovered >= crit_n):
+        status = CRITICAL
+        reasons.append(
+            f"{unrecovered} detected-SDC result(s) NOT recovered"
+            + (" with repeated mismatches from " + ", ".join(
+                f"{d} ({n}x)" for d, n in sorted(repeat.items()))
+               if repeat else "")
+            + f" (critical at {crit_n} — see docs/resilience.md"
+              f"#runbook-silent-data-corruption)")
+    return {"status": status, "reasons": reasons,
+            "abft_checks": _counter_total("dbcsr_tpu_abft_checks_total"),
+            "abft_mismatches": mism,
+            "recoveries": recoveries,
+            "chain_rollbacks": int(rollbacks),
+            "serve_drains": _counter_total("dbcsr_tpu_serve_drain_total"),
+            "journal_replayed": _counter_total(
+                "dbcsr_tpu_serve_journal_replayed_total")}
+
+
 def verdict() -> dict:
     """The full health verdict: worst component status + per-component
     reasons + the active anomaly set (the ``/healthz`` payload)."""
@@ -539,6 +608,7 @@ def verdict() -> dict:
         "watchdog": _eval_watchdog(),
         "engine": _eval_engine(),
         "perf": _eval_perf(),
+        "integrity": _eval_integrity(),
     }
     worst = max((c["status"] for c in components.values()),
                 key=_RANK.get)

@@ -186,10 +186,32 @@ def run_ticks(nticks: int, a, b, c, shift_fn, tick_fn, *,
     """
     import jax
 
+    from dbcsr_tpu.acc import abft as _abft
     from dbcsr_tpu.acc.smm import record_dispatch
 
     db = mode == "double_buffer"
     inject = db and _faults.active()
+    # ABFT shift-conservation probe: a ring shift is a pure data
+    # permutation, so the global probe of the operand panels is
+    # invariant across every shift — finite SDC in a shifted panel
+    # (a ``mesh_shift:flip`` fault, a real interconnect corruption)
+    # breaks the invariant and degrades the multiply to the serial
+    # fused program via `guarded` (classified ``sdc``).  Probes are
+    # DEFERRED: each shift queues one device-side scalar and the loop
+    # evaluates them all at the end — a per-tick host sync would
+    # serialize exactly the comm/compute overlap this mode exists for.
+    check_shift = db and _abft.enabled()
+    probe_ref_dev = probe_dtype = probe_nelem = None
+    probe_pending = []  # (tick, device scalar of the shifted panels)
+    if check_shift:
+        leaves = [x for x in jax.tree_util.tree_leaves((a, b))
+                  if jax.numpy.issubdtype(x.dtype, jax.numpy.inexact)]
+        if leaves:
+            probe_ref_dev = _abft.tree_probe_device((a, b))
+            probe_dtype = leaves[0].dtype
+            probe_nelem = sum(int(x.size) for x in leaves)
+        else:
+            check_shift = False
     shift_exposed = 0.0
     compute_s = 0.0
     a_nxt = b_nxt = None
@@ -217,6 +239,9 @@ def run_ticks(nticks: int, a, b, c, shift_fn, tick_fn, *,
                 if inject:
                     a_nxt = _faults.corrupt(site, a_nxt,
                                             engine=engine, tick=t)
+                if check_shift:
+                    probe_pending.append(
+                        (t, _abft.tree_probe_device((a_nxt, b_nxt))))
             c = tick_fn(a, b, c, t)
             record_dispatch(driver)
             if measure:
@@ -240,6 +265,23 @@ def run_ticks(nticks: int, a, b, c, shift_fn, tick_fn, *,
                     jax.block_until_ready(a_nxt)
                     jax.block_until_ready(b_nxt)
                     shift_exposed += time.perf_counter() - t0
+    if probe_pending:
+        # drain the queued shift probes (ONE sync for the whole loop);
+        # a violation raises here and `guarded` re-runs the serial
+        # program from the pristine operands — bitwise recovery
+        probe_ref = float(probe_ref_dev)
+        for t, after_dev in probe_pending:
+            after = float(after_dev)
+            if not _abft.shift_conserved(
+                    probe_ref, after, probe_dtype, probe_nelem):
+                _abft.record_mismatch(
+                    driver, site, tick=t,
+                    probe_before=probe_ref, probe_after=after)
+                raise _abft.AbftMismatchError(
+                    f"{site} tick {t}: operand-panel probe not "
+                    f"conserved across the ring shift "
+                    f"({probe_ref!r} -> {after!r}) — finite "
+                    f"silent data corruption in a shifted panel")
     return c, shift_exposed, compute_s
 
 
@@ -306,7 +348,14 @@ def guarded(engine: str, grid: str, db_fn, serial_fn,
         # run's measured sample must not stay attached to it
         stats.record_cannon_overlap(engine, grid, mode="serial",
                                     drop_measured=True)
-        return serial_fn(), True
+        out_serial = serial_fn()
+        if kind == "sdc":
+            # the serial program recomputed from the pristine operands:
+            # the detected tick-pipeline SDC is healed
+            from dbcsr_tpu.acc import abft as _abft
+
+            _abft.record_recovery(driver)
+        return out_serial, True
     board.record_success(driver, key)
     return out, False
 

@@ -55,9 +55,13 @@ driver name), so ``pallas:raise`` fires only on pallas launches while
 **Kinds**: ``raise`` (XlaRuntimeError), ``oom`` (RESOURCE_EXHAUSTED —
 the transient classification the demotion handlers key on), ``nan``
 (corrupt the output blocks with NaN — caught by the post-execution
-output check), ``hang`` (sleep past a deadline, default
-``sleep=30``), ``fail`` (generic failure for boolean sites like the
-probe — also what ``raise`` means to the probe).
+output check), ``flip`` (perturb one output element by a large but
+FINITE seed-deterministic delta — the silent-data-corruption model:
+invisible to every finite-output check, detectable only by the ABFT
+probe / chain-invariant layer, ``DBCSR_TPU_ABFT``), ``hang`` (sleep
+past a deadline, default ``sleep=30``), ``fail`` (generic failure for
+boolean sites like the probe — also what ``raise`` means to the
+probe).
 
 **DSL** (``DBCSR_TPU_FAULTS``): specs separated by ``;``::
 
@@ -98,7 +102,7 @@ _lock = threading.Lock()
 _specs: List["FaultSpec"] = []
 _env_parsed = False
 
-KINDS = ("raise", "oom", "nan", "hang", "fail")
+KINDS = ("raise", "oom", "nan", "hang", "fail", "flip")
 
 
 class FaultError(RuntimeError):
@@ -312,12 +316,17 @@ def maybe_inject(site: str, **labels) -> None:
 
 
 def corrupt(site: str, value, **labels):
-    """Apply a configured ``nan`` corruption to a device array (the
-    simulated bad-kernel output).  Returns ``value`` unchanged when no
-    spec fires."""
+    """Apply a configured ``nan``/``flip`` corruption to a device array
+    (the simulated bad-kernel output).  Returns ``value`` unchanged
+    when no spec fires.
+
+    ``nan`` poisons one element with NaN (caught by the finite-output
+    check); ``flip`` adds a large FINITE seed-deterministic delta to
+    one element — the silent-data-corruption model that only the ABFT
+    probe / chain-invariant layer can see."""
     if not _specs:
         return value
-    spec = _firing_spec(site, ("nan",), labels)
+    spec = _firing_spec(site, ("nan", "flip"), labels)
     if spec is None:
         return value
     _note(site, spec, labels)
@@ -328,6 +337,13 @@ def corrupt(site: str, value, **labels):
         return value
     # poison a deterministic element so the corruption is reproducible
     idx = spec.seed % int(flat.size)
+    if spec.kind == "flip":
+        # large-but-finite, exactly representable in every engine dtype
+        # (bf16 included), deterministic per (seed): a bit-flip-scale
+        # perturbation far above any ABFT tolerance floor
+        delta = float(1 << 10) + float(spec.seed % 997)
+        return jnp.reshape(flat.at[idx].add(
+            jnp.asarray(delta, dtype=flat.dtype)), value.shape)
     return jnp.reshape(flat.at[idx].set(jnp.nan), value.shape)
 
 

@@ -31,7 +31,10 @@ record ``{"ts", "name", "outcome", "streak", "wedge_streak",
 is reloaded, so a restarted capture loop resumes its backoff position
 instead of re-probing a dead tunnel on the base cadence.  The same
 file doubles as the structured probe-outcome log the loop commits next
-to ``capture_loop.log``.
+to ``capture_loop.log``.  The file is size-capped: past
+``DBCSR_TPU_WATCHDOG_LOG_MAX_BYTES`` (1 MiB) every persist rotates it
+down to the last record per channel name (the resume state) plus the
+newest half-cap of history (`rotate_jsonl`).
 
 Stdlib-only (bench.py imports this before a JAX backend exists); the
 obs trace/metric emission is lazy and best-effort.  Clock, sleep and
@@ -57,6 +60,65 @@ OUTCOMES = (OK, SLOW, TRANSIENT, WEDGED)
 
 class DeadlineExceeded(TimeoutError):
     """A guarded callable overran its hard deadline."""
+
+
+def rotate_jsonl(path: str, max_bytes: Optional[int] = None) -> bool:
+    """Size-capped rotation of an append-only outcome JSONL (the
+    capture loop's ``capture_probe.jsonl`` grows one row per guarded
+    attempt, without bound under ``--loop``).  When ``path`` exceeds
+    ``max_bytes`` (``DBCSR_TPU_WATCHDOG_LOG_MAX_BYTES``, default
+    1 MiB), rewrite it keeping
+
+    * the LAST record of every ``name`` — `_resume` scans for exactly
+      these, so every channel's live streak/backoff state survives the
+      rotation — plus
+    * the newest tail of rows up to half the cap (recent history for
+      `tools/doctor.py` and humans).
+
+    Atomic (write-temp + rename), torn tail lines tolerated, and never
+    raises: rotation is bookkeeping, not an outcome."""
+    if max_bytes is None:
+        try:
+            max_bytes = int(os.environ.get(
+                "DBCSR_TPU_WATCHDOG_LOG_MAX_BYTES", 1 << 20))
+        except ValueError:
+            max_bytes = 1 << 20
+    try:
+        if max_bytes <= 0 or os.path.getsize(path) <= max_bytes:
+            return False
+        with open(path) as fh:
+            lines = fh.readlines()
+    except OSError:
+        return False
+    last_by_name: dict = {}
+    for i, line in enumerate(lines):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        name = rec.get("name")
+        if name:
+            last_by_name[name] = i
+    keep = set(last_by_name.values())
+    budget = max_bytes // 2
+    size = 0
+    for i in range(len(lines) - 1, -1, -1):
+        size += len(lines[i])
+        if size > budget:
+            break
+        keep.add(i)
+    tmp = path + ".rot"
+    try:
+        with open(tmp, "w") as fh:
+            fh.writelines(lines[i] for i in sorted(keep))
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return False
+    return True
 
 
 class WatchdogResult:
@@ -158,7 +220,10 @@ class Watchdog:
             with open(self.state_path, "a") as fh:
                 fh.write(json.dumps(rec) + "\n")
         except OSError:
-            pass
+            return
+        # bound the append-only log; the just-written record is by
+        # definition the newest, so the streak state always survives
+        rotate_jsonl(self.state_path)
 
     # -- observability ---------------------------------------------------
 

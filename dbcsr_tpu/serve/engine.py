@@ -24,6 +24,8 @@ so the doctor/chaos tooling can join both planes.
 from __future__ import annotations
 
 import collections
+import json
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -35,6 +37,15 @@ from dbcsr_tpu.resilience.watchdog import WEDGED
 from dbcsr_tpu.serve import coalesce as _coalesce
 from dbcsr_tpu.serve.queue import AdmissionQueue, Rejected, Request, classify
 from dbcsr_tpu.serve.session import Session
+
+
+def default_journal_path() -> str:
+    """The per-process drain journal: ``DBCSR_TPU_SERVE_JOURNAL`` when
+    set (a restarted process pointing at the SAME path is what makes
+    drain -> restart lossless), else a pid-suffixed file in the working
+    directory."""
+    return os.environ.get("DBCSR_TPU_SERVE_JOURNAL",
+                          f"serve_journal-{os.getpid()}.jsonl")
 
 _lock = threading.Lock()
 _engine: "ServeEngine | None" = None
@@ -60,6 +71,14 @@ class ServeEngine:
         self._lat: Dict[str, collections.deque] = {}
         self._counts: Dict[str, collections.Counter] = {}
         self.t_start = time.time()
+        self.draining = False
+        # request ids already replayed from a journal (exactly-once)
+        self._replayed: set = set()
+        # request_id -> journal path, registered by replay_journal
+        # BEFORE the resubmit so the terminal hook is attached inside
+        # submit() (pre-admission) — the worker can never finish a
+        # replayed request before the hook exists
+        self._replay_pending: dict = {}
         if start:
             self.start()
 
@@ -68,10 +87,218 @@ class ServeEngine:
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():
             return
+        self.draining = False
+        self.queue.open_admission()
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._run, name="dbcsr-tpu-serve-worker", daemon=True)
         self._thread.start()
+        # startup replay: a journal left by a drained predecessor (the
+        # env-pinned path) is replayed as soon as the worker runs, so a
+        # restart loses no accepted work.  Best-effort — entries whose
+        # session is not (yet) registered stay journaled.
+        path = os.environ.get("DBCSR_TPU_SERVE_JOURNAL")
+        if path and os.path.exists(path):
+            try:
+                self.replay_journal(path)
+            except Exception:
+                pass  # the journal survives; replay can be re-invoked
+
+    # ------------------------------------------------------ drain/restart
+
+    def drain(self, timeout: float = 30.0,
+              journal_path: Optional[str] = None) -> dict:
+        """Drain the serving plane for a restart: close admission (new
+        submissions shed with the structured reason ``draining``),
+        journal every QUEUED request to a per-process JSONL, wait for
+        in-flight work to complete, then stop the worker.  Returns
+        ``{"journal": path, "journaled": n, "completed_inflight": ok}``.
+
+        The journal line format is the idempotent resubmission record
+        (request id, session id, op, by-name params) consumed by
+        `replay_journal` — a restarted engine replays each accepted
+        request exactly once (docs/serving.md § Drain & restart).
+        Requests submitted with raw matrix OBJECTS rather than
+        session-registered names cannot be journaled across a process
+        boundary; they finish ``failed``/WEDGED like a non-drain
+        shutdown would."""
+        from dbcsr_tpu.obs import events as _events
+        from dbcsr_tpu.obs import metrics as _metrics
+
+        self.draining = True
+        self.queue.close_admission("draining")
+        path = journal_path or default_journal_path()
+        _metrics.counter(
+            "dbcsr_tpu_serve_drain_total",
+            "serving-plane drains (admission closed, queued requests "
+            "journaled, in-flight completed)",
+        ).inc()
+        queued = self.queue.drain_queued()
+        journaled = 0
+        with open(path, "a") as fh:
+            for req in queued:
+                if req.journal is None:
+                    req._finish(
+                        "failed", outcome=WEDGED,
+                        error="drain: request not journalable (matrix "
+                              "params passed by object, not by name)")
+                    self._record(req, "failed")
+                    continue
+                fh.write(json.dumps(req.journal) + "\n")
+                journaled += 1
+                req._finish("journaled", outcome=None)
+                self._record(req, "journaled")
+        # complete in-flight: the worker finishes its current group
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            with self._slock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.01)
+        with self._slock:
+            drained_clean = self._inflight == 0
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        _events.publish("serve_drain", {
+            "journal": path, "journaled": journaled,
+            "completed_inflight": drained_clean})
+        return {"journal": path, "journaled": journaled,
+                "completed_inflight": drained_clean}
+
+    def replay_journal(self, path: Optional[str] = None,
+                       remove: bool = True) -> List[Request]:
+        """Resubmit every journaled request EXACTLY ONCE per process
+        (idempotent on request id: duplicate lines, ids already
+        replayed in this process, and ids whose completion tombstone is
+        in the journal are all skipped).  The journal is NEVER
+        rewritten at resubmit time: each replayed request appends a
+        ``replay_done`` tombstone line when it reaches a terminal state
+        (`_journal_mark_done`), and the file is removed only once every
+        journaled submission is tombstoned — so a crash mid-replay
+        re-replays the unfinished remainder on the next start
+        (at-least-once across a crash, exactly-once otherwise; see
+        docs/serving.md § Drain & restart).  Entries whose session id
+        is not registered in this process, that admission sheds, or
+        that fail to resubmit keep their lines for a later replay.
+        Returns the replayed tickets."""
+        from dbcsr_tpu.obs import events as _events
+        from dbcsr_tpu.obs import metrics as _metrics
+        from dbcsr_tpu.serve import session as _session
+
+        path = path or default_journal_path()
+        try:
+            with open(path) as fh:
+                lines = fh.readlines()
+        except OSError:
+            return []
+        done_ids: set = set()
+        recs: List[dict] = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line (process died mid-append)
+            if rec.get("replay_done"):
+                done_ids.add(rec.get("request_id"))
+            else:
+                recs.append(rec)
+        tickets: List[Request] = []
+        for rec in recs:
+            rid = rec.get("request_id")
+            if not rid or rid in done_ids or rid in self._replayed:
+                continue
+            sess = _session.get_session(str(rec.get("session", "")))
+            if sess is None:
+                continue  # unresolved session: line stays journaled
+            self._replay_pending[rid] = path
+            try:
+                req = self.submit(
+                    sess, op=rec.get("op", "multiply"),
+                    priority=int(rec.get("priority", 10)),
+                    deadline_s=rec.get("deadline_s"),
+                    request_id=rid, **(rec.get("params") or {}))
+            except Exception:
+                # a single bad entry must not abort the replay loop or
+                # consume its journal line
+                self._replay_pending.pop(rid, None)
+                continue
+            if req.state == "shed":
+                # admission refused the replay (health CRITICAL, queue
+                # or quota full): the accepted work is NOT lost — the
+                # line stays journaled for the next start()/replay
+                # (the terminal hook skips tombstoning shed requests)
+                continue
+            self._replayed.add(rid)
+            tickets.append(req)
+            _metrics.counter(
+                "dbcsr_tpu_serve_journal_replayed_total",
+                "journaled requests replayed after a drain/restart",
+            ).inc(tenant=req.tenant)
+            _events.publish("serve_replayed", {
+                "request_id": rid, "tenant": req.tenant,
+                "journal": path})
+        if remove and not tickets and recs \
+                and all(r.get("request_id") in done_ids for r in recs):
+            # every journaled submission already has its tombstone:
+            # nothing left to replay, retire the file
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        return tickets
+
+    def _journal_mark_done(self, req: Request, state: str) -> None:
+        """Terminal hook of a REPLAYED request (`Request.on_terminal`,
+        invoked by `_finish` for EVERY end state — done, failed,
+        deadline_missed included): append the completion tombstone and
+        retire the journal once every journaled submission has one.
+        Ordered BEFORE the ticket turns terminal, so a missing journal
+        implies the work durably completed; a crash between execution
+        and tombstone re-replays the request on the next start
+        (at-least-once) — accepted work is never lost.  ``shed`` and
+        ``journaled`` states do NOT tombstone: the request is going
+        back to (or staying in) the journal, not completing."""
+        path = req.replay_journal_path
+        if not path or state in ("shed", "journaled"):
+            return
+        req.replay_journal_path = None
+        try:
+            try:
+                with open(path, "rb") as fh:
+                    fh.seek(-1, os.SEEK_END)
+                    torn_tail = fh.read(1) != b"\n"
+            except (OSError, ValueError):
+                torn_tail = False  # empty or vanished file
+            with open(path, "a") as fh:
+                if torn_tail:
+                    # the file ends mid-line (a process killed during
+                    # an append): the tombstone must not merge into it
+                    fh.write("\n")
+                fh.write(json.dumps({"request_id": req.request_id,
+                                     "replay_done": True}) + "\n")
+            sub: set = set()
+            done: set = set()
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    rid = rec.get("request_id")
+                    if not rid:
+                        continue
+                    (done if rec.get("replay_done") else sub).add(rid)
+            if sub <= done:
+                os.remove(path)
+        except OSError:
+            pass
 
     def running(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
@@ -102,6 +329,7 @@ class ServeEngine:
 
     def submit(self, session: Session, op: str = "multiply",
                priority: int = 10, deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None,
                **params) -> Request:
         """Submit one request.  Matrix params (``a``/``b``/``c``) may
         be `BlockSparseMatrix` objects or names registered in the
@@ -115,11 +343,45 @@ class ServeEngine:
         if op not in OPS:
             raise ValueError(f"unknown serve op {op!r} (one of {OPS})")
         params = dict(params)
+        # drain-journal record: resubmittable iff every matrix param
+        # came by session-registered NAME (the serving surface's normal
+        # shape — raw objects cannot cross a process boundary)
+        # ...and every NON-matrix param must be JSON-native, or the
+        # replay would silently run with defaults (np.float32 alpha,
+        # np.bool_ retain_sparsity are NOT float/bool subclasses) —
+        # such a request fails WEDGED at drain instead of replaying
+        # wrong
+        journalable = all(
+            isinstance(params[k], str)
+            for k in ("a", "b", "c", "p") if k in params
+        ) and all(
+            isinstance(v, (str, int, float, bool)) or v is None
+            for k, v in params.items() if k not in ("a", "b", "c", "p")
+        )
+        journal_params = dict(params) if journalable else None
         for key in ("a", "b", "c", "p"):
             if isinstance(params.get(key), str):
                 params[key] = session.get(params[key])
         req = Request(session, op, params, priority=priority,
-                      deadline_s=deadline_s)
+                      deadline_s=deadline_s, request_id=request_id)
+        if self._replay_pending:
+            rj = self._replay_pending.pop(req.request_id, None)
+            if rj is not None:
+                # journal-replayed resubmission: attach the tombstone
+                # hook BEFORE admission, so no terminal transition —
+                # however fast the worker — can precede it
+                req.replay_journal_path = rj
+                req.on_terminal = self._journal_mark_done
+        if journal_params is not None:
+            req.journal = {
+                "request_id": req.request_id,
+                "session": session.session_id,
+                "tenant": req.tenant,
+                "op": op,
+                "priority": req.priority,
+                "deadline_s": deadline_s,
+                "params": journal_params,
+            }
         req.nbytes = self._operand_bytes(params)
         req.ckey = _coalesce.coalesce_key(op, params)
         from dbcsr_tpu.obs import events as _events
@@ -188,8 +450,15 @@ class ServeEngine:
         from dbcsr_tpu.obs import events as _events
         from dbcsr_tpu.obs import metrics as _metrics
 
+        from dbcsr_tpu.acc import abft as _abft
+
         ids = [r.request_id for r in group]
-        coalesced = len(group) > 1 and self._group_coalescable(group)
+        # under ABFT every request runs serialized: the per-request
+        # probe + pre-execution snapshot (the recover path's rollback
+        # scope) is per-C, which the composite's carve-last contract
+        # cannot provide mid-launch
+        coalesced = (len(group) > 1 and not _abft.enabled()
+                     and self._group_coalescable(group))
         _events.publish("serve_execute", {
             "request_ids": ",".join(ids), "n": len(group),
             "tenants": ",".join(sorted({r.tenant for r in group})),
@@ -283,14 +552,7 @@ class ServeEngine:
 
         p = req.params
         if req.op == "multiply":
-            flops = multiply(
-                p.get("transa", "N"), p.get("transb", "N"),
-                p.get("alpha", 1.0), p["a"], p["b"],
-                p.get("beta", 0.0), p["c"],
-                retain_sparsity=bool(p.get("retain_sparsity", False)),
-                filter_eps=p.get("filter_eps"),
-            )
-            return {"flops": int(flops), "coalesced": 0}
+            return self._execute_multiply(req)
         # iterative model chains: the per-step temporaries recycle
         # through the models' own mempool chains; the result lands in
         # the session under params["out"]
@@ -324,6 +586,75 @@ class ServeEngine:
         out_name = p.get("out", f"{req.op}_out")
         req.session.put(out_name, out)
         return dict(extra, out=out_name, coalesced=0)
+
+    def _execute_multiply(self, req: Request) -> dict:
+        """One serialized multiply request, probe-verified when the
+        ABFT knob is on and the request admits the algebraic identity
+        (`acc.abft.product_probeable`): ``C_new·v`` must equal
+        ``alpha*A@(B@v) + beta*(C_old·v)``.  On a mismatch the
+        pre-execution checkpoint of C restores and the request
+        re-executes ONCE (the transient-SDC model), re-verified before
+        the result is accepted — a second mismatch fails the request
+        with the structured ABFT error (docs/serving.md § Integrity)."""
+        from dbcsr_tpu.acc import abft as _abft
+        from dbcsr_tpu.core import mempool
+        from dbcsr_tpu.mm.multiply import multiply
+
+        p = req.params
+        args = (p.get("transa", "N"), p.get("transb", "N"),
+                p.get("alpha", 1.0), p["a"], p["b"],
+                p.get("beta", 0.0), p["c"])
+        kw = dict(retain_sparsity=bool(p.get("retain_sparsity", False)),
+                  filter_eps=p.get("filter_eps"))
+        abft_on = _abft.enabled() and _abft.product_probeable(p)
+        if not abft_on:
+            flops = multiply(*args, **kw)
+            self._maybe_corrupt_result(p["c"], req.request_id)
+            return {"flops": int(flops), "coalesced": 0}
+        a, b, c = p["a"], p["b"], p["c"]
+        alpha, beta = p.get("alpha", 1.0), p.get("beta", 0.0)
+        snap = mempool.snapshot_matrix(c)
+        r_old = None
+        if beta:
+            r_old = _abft.matrix_probe(
+                c, _abft.probe_vector(c.nfullcols, c.dtype))
+        flops = multiply(*args, **kw)
+        self._maybe_corrupt_result(c, req.request_id)
+        try:
+            _abft.verify_product(a, b, c, alpha, beta, r_old,
+                                 request_id=req.request_id)
+        except _abft.AbftMismatchError:
+            # roll C back to the accepted pre-request state and
+            # re-execute; the re-run is verified before acceptance
+            # (``recover`` semantics — at the serve boundary a merely
+            # detected-but-unrecovered wrong answer must never reach
+            # the tenant, so verify implies one recovery attempt)
+            mempool.restore_matrix(snap)
+            flops = multiply(*args, **kw)
+            self._maybe_corrupt_result(c, req.request_id)
+            try:
+                _abft.verify_product(a, b, c, alpha, beta, r_old,
+                                     request_id=req.request_id)
+            except _abft.AbftMismatchError:
+                # the re-run is ALSO condemned: fail the request, but
+                # first put the session's C back to its accepted
+                # pre-request state — a failed request must not leave
+                # silently-corrupted data registered for later reads
+                mempool.restore_matrix(snap)
+                raise
+            _abft.record_recovery("serve")
+        return {"flops": int(flops), "coalesced": 0, "verified": 1}
+
+    def _maybe_corrupt_result(self, c, request_id: str) -> None:
+        """Fault hook: a configured ``serve_execute:nan``/``flip`` spec
+        corrupts the request's freshly computed C (the simulated
+        served-silent-corruption) — what the per-request probe exists
+        to catch."""
+        if not _faults.active():
+            return
+        c.map_bin_data(
+            lambda d: _faults.corrupt("serve_execute", d,
+                                      request_id=request_id))
 
     # ---------------------------------------------------------- accounting
 
@@ -382,6 +713,8 @@ class ServeEngine:
             inflight = self._inflight
         return {
             "running": self.running(),
+            "draining": self.draining,
+            "admission_closed": self.queue.admission_closed(),
             "queue_depth": self.queue.depth(),
             "inflight": inflight,
             "sessions": len(_session.sessions()),

@@ -39,8 +39,9 @@ from dbcsr_tpu.resilience.watchdog import OK, SLOW, TRANSIENT, WEDGED
 _req_seq = itertools.count(1)
 _TOKEN = uuid.uuid4().hex[:6]
 
-# terminal request states
-DONE_STATES = ("done", "failed", "shed", "deadline_missed")
+# terminal request states ("journaled": accepted work persisted to the
+# drain journal for replay after restart — terminal in THIS process)
+DONE_STATES = ("done", "failed", "shed", "deadline_missed", "journaled")
 
 
 class Rejected(RuntimeError):
@@ -65,12 +66,17 @@ class Request:
     __slots__ = (
         "request_id", "session", "op", "params", "priority", "t_submit",
         "t_deadline", "t_done", "state", "outcome", "error", "result",
-        "ckey", "nbytes", "_event",
+        "ckey", "nbytes", "journal", "replay_journal_path",
+        "on_terminal", "_event",
     )
 
     def __init__(self, session, op: str, params: dict,
-                 priority: int = 10, deadline_s: Optional[float] = None):
-        self.request_id = f"req-{_TOKEN}-{next(_req_seq)}"
+                 priority: int = 10, deadline_s: Optional[float] = None,
+                 request_id: Optional[str] = None):
+        # an explicit request_id preserves identity across a drain ->
+        # journal -> restart -> replay cycle (idempotency contract,
+        # docs/serving.md § Drain & restart)
+        self.request_id = request_id or f"req-{_TOKEN}-{next(_req_seq)}"
         self.session = session
         self.op = op
         self.params = params
@@ -85,6 +91,19 @@ class Request:
         self.result: Optional[dict] = None
         self.ckey = None      # coalesce key (engine fills at submit)
         self.nbytes = 0       # operand bytes estimate (quota accounting)
+        self.journal = None   # JSON-safe resubmission record (engine
+        #                       fills at submit when params are by-name)
+        self.replay_journal_path: Optional[str] = None  # set when this
+        #                       request was resubmitted from a drain
+        #                       journal: its terminal state appends a
+        #                       completion tombstone there
+        self.on_terminal = None  # engine hook invoked by _finish with
+        #                       (request, state) BEFORE the terminal
+        #                       state becomes visible — the one
+        #                       chokepoint every terminal transition
+        #                       (done/failed/deadline_missed/...) runs
+        #                       through, so a replayed request cannot
+        #                       reach ANY end state untombstoned
         self._event = threading.Event()
 
     @property
@@ -102,6 +121,12 @@ class Request:
     def _finish(self, state: str, outcome: Optional[str] = None,
                 error: Optional[str] = None,
                 result: Optional[dict] = None) -> None:
+        if self.on_terminal is not None:
+            cb, self.on_terminal = self.on_terminal, None
+            try:
+                cb(self, state)
+            except Exception:
+                pass  # a journal hiccup must never mask the outcome
         self.state = state
         self.outcome = outcome
         self.error = error
@@ -157,6 +182,38 @@ class AdmissionQueue:
         # queued operand bytes (the two quota dimensions)
         self._tenant_count: dict = {}
         self._tenant_bytes: dict = {}
+        # admission gate: a non-None reason sheds every new submission
+        # with that structured reason (the drain contract — queued and
+        # in-flight work is unaffected, only NEW admission closes)
+        self._closed_reason: Optional[str] = None
+
+    # ------------------------------------------------------------- draining
+
+    def close_admission(self, reason: str = "draining") -> None:
+        """Shed every subsequent submission with ``reason`` (structured,
+        machine-readable — the drain/shutdown gate)."""
+        with self._lock:
+            self._closed_reason = str(reason)
+
+    def open_admission(self) -> None:
+        with self._lock:
+            self._closed_reason = None
+
+    def admission_closed(self) -> Optional[str]:
+        with self._lock:
+            return self._closed_reason
+
+    def drain_queued(self) -> list:
+        """Remove and return EVERY queued request without running it
+        (quota slots released) — the journaling step of a drain; the
+        caller owns the requests' terminal transition."""
+        with self._cond:
+            reqs = [item[2] for item in self._heap]
+            self._heap = []
+            for req in reqs:
+                self._release_locked(req)
+            self._depth_gauge()
+        return reqs
 
     # ------------------------------------------------------------- helpers
 
@@ -228,6 +285,12 @@ class AdmissionQueue:
             except Exception as exc:
                 self._shed(req, "fault",
                            f"{type(exc).__name__}: {exc}"[:200])
+        closed = self.admission_closed()
+        if closed is not None:
+            self._shed(req, closed,
+                       "admission closed: the serving plane is "
+                       "draining (queued work is journaled for replay "
+                       "after restart — resubmit there)")
         cfg = self._cfg()
         status = self._health_status()
         outcome = "admitted"

@@ -155,7 +155,8 @@ def test_endpoint_serves_metrics_and_healthz(endpoint):
     assert code == 200
     v = json.loads(body)
     assert v["status"] in ("OK", "DEGRADED")
-    assert set(v["components"]) == {"drivers", "watchdog", "engine", "perf"}
+    assert set(v["components"]) == {"drivers", "watchdog", "engine",
+                                    "perf", "integrity"}
 
 
 def test_endpoint_serves_flight_and_filtered_events(endpoint):

@@ -53,7 +53,15 @@ _hostdev.ensure_virtual_devices(8)
 SITES = ("execute_stack", "prepare_stack", "dense", "xla", "xla_group",
          "host", "pallas", "mesh_shift", "gather_chunk", "tas_tick",
          "serve_admit", "serve_execute")
-KINDS = ("raise", "oom", "nan")
+KINDS = ("raise", "oom", "nan", "flip")
+# targets whose OUTPUT a nan/flip spec can corrupt: the faults.corrupt
+# call sites plus the driver labels they carry (a ``pallas:nan`` spec
+# fires on the execute_stack corrupt hook via its driver label).  The
+# whole suite runs with DBCSR_TPU_ABFT=verify, so a finite flip here
+# must be detected and recovered like any other fault.
+CORRUPTIBLE = ("execute_stack", "dense", "mesh_shift", "gather_chunk",
+               "tas_tick", "serve_execute", "xla", "xla_group", "host",
+               "pallas")
 
 
 def corpus():
@@ -103,6 +111,14 @@ def corpus():
         # the case, plus --events for fault correlation)
         ("serve_storm", dict(bs=[4] * 6, dtype=np.float64, occ=0.5,
                              serve_tenants=3, serve_requests=2)),
+        # finite-SDC case: flip faults injected mid-McWeeny chain must
+        # be detected (stack ABFT probe with the knob on; chain
+        # invariant rollback with it off) and recovered BITWISE-equal
+        # to the clean run — pinned inside the case with paired legs
+        # in a pristine fault context (the outer schedule then applies
+        # to the returned checksum leg like every other case)
+        ("sdc_chain", dict(bs=[4] * 6, dtype=np.float64, occ=0.4,
+                           purify_steps=3)),
     ]
 
 
@@ -126,8 +142,8 @@ def random_schedule(rng: random.Random) -> str:
             if have_sitewide:
                 continue
             have_sitewide = True
-        if site.startswith("serve_") and kind == "nan":
-            kind = "raise"  # serve sites have no corruptible output
+        if kind in ("nan", "flip") and site not in CORRUPTIBLE:
+            kind = "raise"  # nothing to corrupt at this site
         opts = [f"seed={rng.randint(0, 2**16)}"]
         if site == "execute_stack":
             opts.append(f"times={rng.randint(1, 2)}")
@@ -293,6 +309,83 @@ def _tas_contract(entry: dict, seed: int) -> float:
         set_config(cannon_overlap=prev)
 
 
+def _sdc_chain(entry: dict, seed: int) -> float:
+    """The layered finite-SDC defense on a McWeeny chain, pinned
+    BITWISE.  Two paired legs run in a pristine inner fault context
+    (the outer schedule is suspended by the nested ``inject_faults``
+    and restored on exit):
+
+    * leg A — ``DBCSR_TPU_ABFT=verify`` + ``execute_stack:flip``: the
+      stack probe detects the finite corruption, the pristine
+      same-driver retry recovers, and the purified result is
+      bitwise-equal to the clean run.
+    * leg B — ABFT off, flip again: the corruption slips past the
+      (disarmed) probes into the iterate; the chain invariant rolls
+      back to the checkpoint and recomputes — bitwise-equal again,
+      and the rollback counter must have advanced.
+
+    The returned checksum comes from a final leg under the OUTER
+    schedule, so the case also participates in the ordinary chaos
+    contract."""
+    import numpy as np
+
+    from dbcsr_tpu.core.config import get_config, set_config
+    from dbcsr_tpu.models.purify import make_test_density, mcweeny_purify
+    from dbcsr_tpu.obs import metrics
+    from dbcsr_tpu.ops.test_methods import to_dense
+    from dbcsr_tpu.resilience import faults
+
+    steps = int(entry["purify_steps"])
+
+    def run():
+        p = make_test_density(len(entry["bs"]), int(entry["bs"][0]),
+                              occ=entry["occ"], seed=seed)
+        out, _hist = mcweeny_purify(p, steps=steps)
+        return np.asarray(to_dense(out))
+
+    def rollbacks() -> float:
+        c = metrics._counters.get("dbcsr_tpu_chain_rollback_total")
+        return float(sum(c.values.values())) if c is not None else 0.0
+
+    flip = f"execute_stack:flip,seed={seed % 997},times=1"
+    prev_abft = get_config().abft
+    with faults.inject_faults(""):  # pristine inner context
+        try:
+            set_config(abft="verify")
+            ref = run()
+            with faults.inject_faults(flip) as specs_a:
+                out_a = run()
+            if not specs_a[0].fired:
+                raise RuntimeError("sdc_chain: flip spec never fired")
+            if not (out_a == ref).all():
+                raise RuntimeError(
+                    "sdc_chain leg A: stack-ABFT recovery not "
+                    "bitwise-equal to the clean run")
+            set_config(abft="off")
+            rb0 = rollbacks()
+            with faults.inject_faults(flip):
+                out_b = run()
+            if rollbacks() <= rb0:
+                raise RuntimeError(
+                    "sdc_chain leg B: flip did not trigger a chain "
+                    "rollback (invariant failed to catch finite SDC)")
+            if not (out_b == ref).all():
+                raise RuntimeError(
+                    "sdc_chain leg B: chain-rollback recovery not "
+                    "bitwise-equal to the clean run")
+        finally:
+            set_config(abft=prev_abft)
+    # the paired legs' own fault_injected events are not part of the
+    # OUTER schedule's correlation count — drop them before the final
+    # leg so --events accounting stays exact
+    from dbcsr_tpu.obs import events as obs_events
+
+    if obs_events.enabled():
+        obs_events.clear()
+    # final leg under the outer schedule: the ordinary chaos contract
+    return float(np.sum(run()))
+
+
 def _one_product(entry: dict, seed: int):
     import numpy as np
 
@@ -301,6 +394,8 @@ def _one_product(entry: dict, seed: int):
 
     if entry.get("serve_tenants"):
         return _serve_storm(entry, seed)
+    if entry.get("purify_steps"):
+        return _sdc_chain(entry, seed)
     if entry.get("contract_mesh"):
         return _tas_contract(entry, seed)
     if entry.get("mesh"):
@@ -373,9 +468,18 @@ def run_chaos(seed: int, rounds: int, verbose: bool = False,
 
     jax.config.update("jax_enable_x64", True)
 
+    from dbcsr_tpu.core.config import get_config, set_config
     from dbcsr_tpu.resilience import breaker, faults
 
     import numpy as np
+
+    # the whole suite runs with the ABFT probes armed: flip (and nan)
+    # corruption at any corruptible target must be DETECTED and
+    # recovered, extending the chaos contract from "crashes and NaNs
+    # are invisible in the product" to "wrong-but-finite answers are
+    # too" (docs/resilience.md § ABFT probe checksums)
+    prev_abft = get_config().abft
+    set_config(abft="verify")
 
     if check_events:
         from dbcsr_tpu.obs import events as obs_events
@@ -442,6 +546,7 @@ def run_chaos(seed: int, rounds: int, verbose: bool = False,
                 })
             elif verbose:
                 print(f"  ok r{rnd} {name:>16} rel={rel:.1e} [{schedule}]")
+    set_config(abft=prev_abft)
     return {
         "seed": seed,
         "rounds": rounds,

@@ -84,6 +84,27 @@ HINTS = {
         "queued requests are expiring before execution; shorten the "
         "coalescing window, raise worker capacity, or relax deadlines",
         SERVE_RUNBOOK + "#deadlines--the-watchdog-taxonomy"),
+    "abft_mismatch": (
+        "an ABFT probe checksum disagreed: the device produced a wrong "
+        "but FINITE answer (silent data corruption) — the engine "
+        "recovered it, but repeated mismatches from one driver mean "
+        "the corruption tracks that driver",
+        "#abft-probe-checksums"),
+    "sdc_critical": (
+        "repeated SDC from one driver — deterministic corruption, not "
+        "a particle strike; quarantine the driver (force a safe "
+        "driver) and capture the flight dump",
+        "#runbook-silent-data-corruption"),
+    "chain_rollback": (
+        "an iterative chain's per-step invariant failed; the iterate "
+        "rolled back to its checkpoint and recomputed on the safe "
+        "engine — check which driver the underlying multiplies used",
+        "#chain-checkpoint-and-rollback"),
+    "serve_drain": (
+        "the serving plane drained: admission closed, queued requests "
+        "journaled; restart the process with DBCSR_TPU_SERVE_JOURNAL "
+        "pinned to the same path to replay them exactly once",
+        SERVE_RUNBOOK + "#drain--restart"),
 }
 
 
@@ -319,6 +340,58 @@ def analyze(health: dict | None, prom: dict, events: list,
             report["hints"].append(_hint("serve_deadline", detail=", ".join(
                 f"{t} ({n})" for t, n in serving["deadline_offenders"])))
 
+    # integrity plane: live ABFT/rollback counters first (prometheus),
+    # else the abft_mismatch / chain_rollback / serve_drain bus events
+    integrity: dict = {"mismatches": {}, "rollbacks": 0}
+    checks = prom.get("dbcsr_tpu_abft_checks_total")
+    if checks:
+        integrity["checks"] = int(sum(v for _, v in checks))
+    for labels, v in prom.get("dbcsr_tpu_abft_mismatches_total", []):
+        d = labels.get("driver", "?")
+        integrity["mismatches"][d] = \
+            integrity["mismatches"].get(d, 0) + int(v)
+    for labels, v in prom.get("dbcsr_tpu_abft_recoveries_total", []):
+        integrity["recoveries"] = integrity.get("recoveries", 0) + int(v)
+    rb = prom.get("dbcsr_tpu_chain_rollback_total")
+    if rb:
+        integrity["rollbacks"] = int(sum(v for _, v in rb))
+    dr = prom.get("dbcsr_tpu_serve_drain_total")
+    if dr:
+        integrity["drains"] = int(sum(v for _, v in dr))
+    rp = prom.get("dbcsr_tpu_serve_journal_replayed_total")
+    if rp:
+        integrity["replayed"] = int(sum(v for _, v in rp))
+    if not integrity["mismatches"] and not integrity["rollbacks"]:
+        for e in events:
+            if e.get("event") == "abft_mismatch":
+                d = e.get("driver", "?")
+                integrity["mismatches"][d] = \
+                    integrity["mismatches"].get(d, 0) + 1
+            elif e.get("event") == "chain_rollback":
+                integrity["rollbacks"] += 1
+            elif e.get("event") == "serve_drain":
+                integrity["drains"] = integrity.get("drains", 0) + 1
+            elif e.get("event") == "serve_replayed":
+                integrity["replayed"] = integrity.get("replayed", 0) + 1
+    sdc_total = sum(integrity["mismatches"].values())
+    if sdc_total or integrity["rollbacks"] or integrity.get("drains") \
+            or "checks" in integrity:
+        report["integrity"] = integrity
+    if sdc_total:
+        report["hints"].append(_hint("abft_mismatch", detail=", ".join(
+            f"{d}={n}" for d, n in sorted(integrity["mismatches"].items()))))
+    repeat = {d: n for d, n in integrity["mismatches"].items() if n >= 3}
+    if repeat:
+        report["hints"].append(_hint("sdc_critical", detail=", ".join(
+            f"{d} ({n}x)" for d, n in sorted(repeat.items()))))
+    if integrity["rollbacks"]:
+        report["hints"].append(_hint(
+            "chain_rollback", detail=f"{integrity['rollbacks']} rollback(s)"))
+    if integrity.get("drains"):
+        report["hints"].append(_hint("serve_drain", detail=(
+            f"{integrity['drains']} drain(s), "
+            f"{integrity.get('replayed', 0)} replayed")))
+
     # anomalies: live health verdict first, else anomaly events
     anomalies: dict = collections.Counter()
     if health:
@@ -347,10 +420,11 @@ def analyze(health: dict | None, prom: dict, events: list,
     # synthesize a health verdict from artifacts when no live one exists
     if health is None:
         status = "OK"
-        if open_breakers or wedged or anomalies:
+        if open_breakers or wedged or anomalies or sdc_total \
+                or integrity["rollbacks"]:
             status = "DEGRADED"
-        if corrupt or any(w.get("wedge_streak", 0) >= 3
-                          for w in watchdog.values()):
+        if corrupt or repeat or any(w.get("wedge_streak", 0) >= 3
+                                    for w in watchdog.values()):
             status = "CRITICAL"
         report["health"] = {"status": status, "source": "artifacts"}
     return report
@@ -431,6 +505,24 @@ def render(report: dict, out=print) -> None:
         if sv.get("deadline_offenders"):
             out("   top deadline-miss offenders: " + ", ".join(
                 f"{t} ({n})" for t, n in sv["deadline_offenders"]))
+    if report.get("integrity"):
+        ig = report["integrity"]
+        parts = []
+        if "checks" in ig:
+            parts.append(f"checks={ig['checks']}")
+        if ig.get("mismatches"):
+            parts.append("sdc[" + ", ".join(
+                f"{d}={n}" for d, n in sorted(ig["mismatches"].items()))
+                + "]")
+        if "recoveries" in ig:
+            parts.append(f"recoveries={ig['recoveries']}")
+        if ig.get("rollbacks"):
+            parts.append(f"chain_rollbacks={ig['rollbacks']}")
+        if ig.get("drains"):
+            parts.append(f"drains={ig['drains']}")
+        if ig.get("replayed"):
+            parts.append(f"replayed={ig['replayed']}")
+        out(" integrity: " + ", ".join(parts))
     if report.get("anomalies"):
         out(" anomalies: " + ", ".join(
             f"{k}={v}" for k, v in sorted(report["anomalies"].items())))
@@ -482,6 +574,17 @@ def _selftest(repo_root: str) -> int:
          "op": "multiply", "reason": "quota_inflight"},
         {"event": "serve_deadline_missed", "request_id": "req-3",
          "tenant": "bob", "op": "multiply", "waited_ms": 900.0},
+        # integrity plane: one detected-SDC probe mismatch, one chain
+        # rollback, one drain/replay pair — the integrity section and
+        # its hints must materialize from events alone
+        {"event": "abft_mismatch", "product_id": pid, "driver": "pallas",
+         "site": "stack", "rel_err": 1.2e-3, "tolerance": 3.1e-11},
+        {"event": "chain_rollback", "model": "purify", "step": 2,
+         "reason": "invariant"},
+        {"event": "serve_drain", "journal": "serve_journal-1.jsonl",
+         "journaled": 1, "completed_inflight": True},
+        {"event": "serve_replayed", "request_id": "req-4",
+         "tenant": "alice", "journal": "serve_journal-1.jsonl"},
     ]
     probe = [{"ts": "2026-01-01T00:00:00", "name": "tpu_probe",
               "outcome": "WEDGED", "streak": 4, "wedge_streak": 2,
@@ -510,6 +613,13 @@ def _selftest(repo_root: str) -> int:
         and report["serving"]["deadline_offenders"] == [("bob", 1)]
         and any(h["kind"] == "serve_shed" for h in report["hints"])
         and any(h["kind"] == "serve_deadline" for h in report["hints"])
+        and report["integrity"]["mismatches"] == {"pallas": 1}
+        and report["integrity"]["rollbacks"] == 1
+        and report["integrity"]["drains"] == 1
+        and report["integrity"]["replayed"] == 1
+        and any(h["kind"] == "abft_mismatch" for h in report["hints"])
+        and any(h["kind"] == "chain_rollback" for h in report["hints"])
+        and any(h["kind"] == "serve_drain" for h in report["hints"])
     )
     print(f" selftest: {'OK' if ok else 'FAILED'} "
           f"(captures read: {len(captures)})")
