@@ -21,6 +21,10 @@ class _MnkStat:
     nentries: int = 0
     flops: int = 0
     by_driver: dict = dataclasses.field(default_factory=dict)
+    # flops keyed (driver, dtype) — the full (driver, shape-bucket,
+    # dtype) evidence cell the telemetry time-series store samples
+    # (obs/timeseries.py); callers without a dtype land under ""
+    by_driver_dtype: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -137,6 +141,8 @@ def record_stack(m: int, n: int, k: int, nentries: int, *,
     st.nentries += nentries
     st.flops += flops
     st.by_driver[driver] = st.by_driver.get(driver, 0) + flops
+    cell = (driver, dtype)
+    st.by_driver_dtype[cell] = st.by_driver_dtype.get(cell, 0) + flops
     _agg_driver(driver, flops, nbytes or 0, seconds or 0.0, dtype, 1,
                 sync=sync)
     t = _trace._tracer

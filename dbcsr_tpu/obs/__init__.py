@@ -20,6 +20,14 @@ NVTX/cachegrind hooks — rebuilt machine-readable:
   ring + optional sharded JSONL sink, every resilience/perf emission
   published through it with a per-multiply ``product_id`` correlation
   key shared with the flight record and the multiply span.
+* `timeseries` — the telemetry history plane: cadence-sampled,
+  multi-resolution (raw/1-min/10-min) time series of every live
+  signal, persisted as per-process JSONL rollup shards
+  (``DBCSR_TPU_TS=<base>``) with a live-or-replay `query` API.
+* `slo` — declarative objectives evaluated as multi-window
+  error-budget burn rates over the store; feeds the ``slo`` health
+  component, ``slo_burn`` events and
+  ``dbcsr_tpu_slo_burn_rate{objective}``.
 * `health` — per-component OK/DEGRADED/CRITICAL verdicts folded from
   breaker states, watchdog streaks, failure rates and roofline
   fractions, plus rolling-window anomaly detectors.
@@ -33,11 +41,15 @@ engine feeds the flight recorder.  With tracing disabled the only
 hot-path cost is one attribute check per event site.
 """
 
+from dbcsr_tpu.obs import shard
+from dbcsr_tpu.obs import windows
 from dbcsr_tpu.obs import tracer
 from dbcsr_tpu.obs import flight
 from dbcsr_tpu.obs import events
 from dbcsr_tpu.obs import costmodel
 from dbcsr_tpu.obs import metrics
+from dbcsr_tpu.obs import timeseries
+from dbcsr_tpu.obs import slo
 from dbcsr_tpu.obs import health
 from dbcsr_tpu.obs import server
 
@@ -51,11 +63,12 @@ from dbcsr_tpu.obs.tracer import (  # noqa: F401
 
 # version stamp for machine-readable obs artifacts (bench capture JSON,
 # trace shards, perf-gate reports): bump when the schema of any of
-# them changes incompatibly.  v3 = event bus JSONL + product_id
-# correlation + health verdicts (PR 5); v2 = trace sharding +
-# roofline/costmodel fields (PR 2); v1 = the original obs subsystem
-# (PR 1).
-OBS_SCHEMA_VERSION = 3
+# them changes incompatibly.  v4 = telemetry time-series shards + SLO
+# burn gauges + the `slo` health component (this PR); v3 = event bus
+# JSONL + product_id correlation + health verdicts (PR 5); v2 = trace
+# sharding + roofline/costmodel fields (PR 2); v1 = the original obs
+# subsystem (PR 1).
+OBS_SCHEMA_VERSION = 4
 
 
 def enable_trace(path: str | None = None) -> "tracer.Tracer":
@@ -89,7 +102,7 @@ def obs_active() -> bool:
 
 __all__ = [
     "tracer", "flight", "metrics", "costmodel", "events", "health",
-    "server",
+    "server", "timeseries", "slo", "windows", "shard",
     "enable_trace", "disable_trace", "trace_enabled", "get_tracer",
     "annotate", "trace_add", "instant", "shard_path",
     "write_chrome_trace", "OBS_SCHEMA_VERSION", "obs_active",

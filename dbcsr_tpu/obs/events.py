@@ -38,12 +38,12 @@ from __future__ import annotations
 import collections
 import json
 import os
-import re
 import threading
 import time
 import uuid
 
 from dbcsr_tpu.obs import flight as _flight
+from dbcsr_tpu.obs import shard as _shard
 from dbcsr_tpu.obs import tracer as _trace
 
 _lock = threading.Lock()
@@ -170,6 +170,16 @@ def end_product(rec: dict | None = None, error: str | None = None,
     finally:
         if st and st[-1] == pid:
             st.pop()
+    # product boundary = a telemetry-store sample boundary (cadence-
+    # gated inside; one attribute check when DBCSR_TPU_TS=0).  AFTER
+    # the product popped: a forced sample's health collector must not
+    # observe this multiply as still open.
+    try:
+        from dbcsr_tpu.obs import timeseries as _ts
+
+        _ts.on_product()
+    except Exception:
+        pass  # telemetry must never fail a multiply
 
 
 import contextlib as _contextlib
@@ -278,13 +288,6 @@ def clear() -> None:
 
 # ---------------------------------------------------------------- sink
 
-def _provisional_tag() -> str:
-    import socket
-
-    host = re.sub(r"[^A-Za-z0-9]+", "-", socket.gethostname())[:24] or "host"
-    return f"tmp{host}-{os.getpid()}"
-
-
 def enable_sink(base_path: str | None = None) -> str:
     """Open the JSONL sink (default base: $DBCSR_TPU_EVENTS).  The base
     is sharded per process exactly like ``DBCSR_TPU_TRACE`` — see
@@ -297,12 +300,12 @@ def enable_sink(base_path: str | None = None) -> str:
                          "DBCSR_TPU_EVENTS")
     disable_sink()
     set_enabled(True)
-    pid = _trace._process_index()
+    pid = _shard.process_index()
     with _lock:
         _sink_base = base_path
         _sink_pid_final = pid is not None
-        tag = pid if pid is not None else _provisional_tag()
-        _sink_path = _trace.shard_path(base_path, tag)
+        tag = pid if pid is not None else _shard.provisional_tag()
+        _sink_path = _shard.shard_path(base_path, tag)
         _sink = open(_sink_path, "a")
     return _sink_path
 
@@ -331,27 +334,14 @@ def rebind(process_index: int | None = None, force: bool = False) -> None:
         if _sink is None or _sink_pid_final:
             return
         if process_index is None:
-            process_index = _trace._process_index()
+            process_index = _shard.process_index()
         if process_index is None:
             if not force:
                 return
             process_index = 0
         _sink_pid_final = True
-        new_path = _trace.shard_path(_sink_base, int(process_index))
-        if new_path == _sink_path:
-            return
-        try:
-            _sink.close()
-            if os.path.exists(new_path):
-                with open(_sink_path) as src, open(new_path, "a") as dst:
-                    dst.write(src.read())
-                os.remove(_sink_path)
-            else:
-                os.replace(_sink_path, new_path)
-            _sink_path = new_path
-        except OSError:
-            pass  # cross-device/locked: keep the provisional shard
-        _sink = open(_sink_path, "a")
+        _sink_path, _sink = _shard.settle(
+            _sink_base, _sink_path, _sink, int(process_index))
 
 
 import atexit

@@ -134,8 +134,11 @@ _active: dict = {}
 # serving-plane admission window: 1.0 per shed decision, 0.0 per
 # admit (fed by serve.queue via observe_serve) — the shed-storm
 # detector's rolling window, keyed by admission count like the
-# multiply detectors are keyed by multiply count (clock-free)
-_serve_window: collections.deque = collections.deque(maxlen=_window_n())
+# multiply detectors are keyed by multiply count (clock-free).
+# `obs.windows.Window` keeps the shed rate O(1) per decision.
+from dbcsr_tpu.obs.windows import Window as _Window  # noqa: E402
+
+_serve_window = _Window(_window_n())
 
 
 def _threshold(name: str, default: float) -> float:
@@ -145,23 +148,26 @@ def _threshold(name: str, default: float) -> float:
     return v
 
 
-def median(xs) -> float:
-    xs = sorted(xs)
-    n = len(xs)
-    if n == 0:
-        return 0.0
-    mid = n // 2
-    return float(xs[mid]) if n % 2 else (xs[mid - 1] + xs[mid]) / 2.0
-
-
-def mad(xs) -> float:
-    m = median(xs)
-    return median([abs(x - m) for x in xs])
+# the one median/MAD implementation (perf_gate noise convention) lives
+# in obs.windows; re-exported here because every detector below — and
+# historical callers — read them as health.median/health.mad
+from dbcsr_tpu.obs.windows import mad, median  # noqa: E402,F401
 
 
 def reset() -> None:
     """Drop the rolling windows, detector states and cached env
-    thresholds (tests; paired with `metrics.reset`)."""
+    thresholds (tests; paired with `metrics.reset`).  Also clears the
+    SLO plane's rising-edge/cached-evaluation state when that module
+    is loaded — a stale burning objective must not leak a DEGRADED
+    ``slo`` component into the next test."""
+    import sys
+
+    slo = sys.modules.get("dbcsr_tpu.obs.slo")
+    if slo is not None:
+        try:
+            slo.reset()
+        except Exception:
+            pass
     with _lock:
         _samples.clear()
         _sums["recompiles"] = 0.0
@@ -205,6 +211,15 @@ def _fire(kind: str, state_key, args: dict) -> None:
         "health-model anomaly detections by kind",
     ).inc(kind=kind)
     _events.publish("anomaly", dict(args, kind=kind), flight=True)
+    try:
+        # a health transition forces the telemetry store's NEXT sample
+        # boundary (deferred: detectors fire under their own locks and
+        # must never re-enter the collectors mid-verdict)
+        from dbcsr_tpu.obs import timeseries as _ts
+
+        _ts.request_sample(f"anomaly:{kind}")
+    except Exception:
+        pass
 
 
 def _clear_state(state_key) -> None:
@@ -367,7 +382,7 @@ def observe_serve(shed: bool) -> None:
     with _lock:
         _serve_window.append(1.0 if shed else 0.0)
         n = len(_serve_window)
-        rate = sum(_serve_window) / n if n else 0.0
+        rate = _serve_window.sum / n if n else 0.0
     if n < _MIN_SAMPLES:
         return
     th = _threshold("DBCSR_TPU_HEALTH_SHED_RATE", 0.25)
@@ -600,9 +615,22 @@ def _eval_integrity() -> dict:
                 "dbcsr_tpu_serve_journal_replayed_total")}
 
 
-def verdict() -> dict:
-    """The full health verdict: worst component status + per-component
-    reasons + the active anomaly set (the ``/healthz`` payload)."""
+def _eval_slo() -> dict:
+    """The SLO plane's component (`obs.slo.component`): error-budget
+    burn over the telemetry history store — OK with a reason when the
+    store is off or nothing evaluated yet."""
+    try:
+        from dbcsr_tpu.obs import slo
+
+        return slo.component()
+    except Exception:
+        return {"status": OK, "reasons": [], "objectives": {}}
+
+
+def _components(include_slo: bool = True) -> dict:
+    """The ONE evaluator list both `verdict` and `admission_status`
+    share — adding a component here reaches both automatically (a
+    hand-maintained second copy would silently drift)."""
     components = {
         "drivers": _eval_drivers(),
         "watchdog": _eval_watchdog(),
@@ -610,6 +638,15 @@ def verdict() -> dict:
         "perf": _eval_perf(),
         "integrity": _eval_integrity(),
     }
+    if include_slo:
+        components["slo"] = _eval_slo()
+    return components
+
+
+def verdict() -> dict:
+    """The full health verdict: worst component status + per-component
+    reasons + the active anomaly set (the ``/healthz`` payload)."""
+    components = _components()
     worst = max((c["status"] for c in components.values()),
                 key=_RANK.get)
     from dbcsr_tpu.obs import events as _events
@@ -625,6 +662,20 @@ def verdict() -> dict:
         "bus_enabled": _events.enabled(),
         "t_unix": time.time(),
     }
+
+
+def admission_status() -> str:
+    """The verdict the serving plane's admission control keys on:
+    worst of every component EXCEPT ``slo``.  The SLO burn component
+    pages operators; it must never close admission — for the serve
+    error-budget objective a SHED is itself the bad event, so a
+    burn-driven shed would be a positive feedback loop (sheds → error
+    burn → CRITICAL → shed everything) that locks the plane shut with
+    no exit.  Routing-level reactions (the ``/healthz`` 503, fleet
+    placement) still see the full verdict."""
+    return max((c["status"]
+                for c in _components(include_slo=False).values()),
+               key=_RANK.get)
 
 
 # back-compat friendly alias: "evaluate" reads naturally at call sites

@@ -29,6 +29,17 @@ route                 payload
 ``/serve/tenants``    per-tenant serving metrics: admitted/shed/
                       deadline-missed counters, queue load, rolling
                       p50/p95 latency
+``/timeseries``       telemetry history store (`obs.timeseries`):
+                      ``?metric=&since=&until=&agg=&tier=`` + any
+                      other param as a label matcher; no ``metric``
+                      lists the known series
+``/slo``              `obs.slo` burn-rate evaluation + the ``slo``
+                      health component
+``/cluster``          fleet federation: scrape the sibling processes'
+                      endpoints (the multihost port-offset scheme, or
+                      ``?ports=9100,9101`` / ``?n=4``) and merge them
+                      into ONE exposition with per-process provenance
+                      labels; ``?format=prom`` (default) or ``json``
 ``/``                 route index JSON
 ====================  ==================================================
 
@@ -112,6 +123,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(events.records(
                     product_id=q.get("product_id", [None])[0],
                     kind=q.get("kind", [None])[0], limit=limit))
+            elif route == "/timeseries":
+                self._timeseries(parse_qs(url.query))
+            elif route == "/slo":
+                from dbcsr_tpu.obs import slo
+
+                self._send_json({"objectives": slo.evaluate(),
+                                 "component": slo.component()})
+            elif route == "/cluster":
+                self._cluster(parse_qs(url.query))
             elif route == "/serve/status":
                 q = parse_qs(url.query)
                 self._serve_status(q.get("request_id", [None])[0])
@@ -124,6 +144,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json({
                     "routes": ["/metrics", "/healthz", "/flight",
                                "/events?product_id=&kind=&limit=",
+                               "/timeseries?metric=&since=&agg=&tier=",
+                               "/slo",
+                               "/cluster?format=prom|json&ports=&n=",
                                "/serve/submit (POST)",
                                "/serve/status?request_id=",
                                "/serve/tenants"],
@@ -138,6 +161,59 @@ class _Handler(BaseHTTPRequestHandler):
                     {"error": f"{type(exc).__name__}: {exc}"}, code=500)
             except Exception:
                 pass
+
+    # -------------------------------------------------- telemetry history
+
+    def _timeseries(self, q: dict) -> None:
+        """``/timeseries``: query the live store.  Reserved params:
+        ``metric``, ``since``, ``until``, ``agg``, ``tier``; every
+        OTHER param is a label matcher (``?metric=…&driver=xla``).
+        Without ``metric`` the known series are listed."""
+        from dbcsr_tpu.obs import timeseries
+
+        metric = q.get("metric", [None])[0]
+        if not metric:
+            self._send_json(timeseries.series_list())
+            return
+        reserved = ("metric", "since", "until", "agg", "tier", "format")
+        labels = {k: v[0] for k, v in q.items() if k not in reserved}
+
+        def num(name):
+            raw = q.get(name, [None])[0]
+            try:
+                return float(raw) if raw not in (None, "") else None
+            except ValueError:
+                return None
+
+        tier = q.get("tier", ["auto"])[0]
+        if tier not in ("auto", "raw"):
+            try:
+                tier = float(tier)
+            except ValueError:
+                tier = "auto"
+        self._send_json(timeseries.query(
+            metric, labels=labels or None, since=num("since"),
+            until=num("until"), agg=q.get("agg", [None])[0] or None,
+            tier=tier))
+
+    # --------------------------------------------------- fleet federation
+
+    def _cluster(self, q: dict) -> None:
+        """``/cluster``: scrape every sibling process's endpoint and
+        merge into one fleet view with per-process provenance."""
+        fmt = q.get("format", ["prom"])[0]
+        ports = q.get("ports", [None])[0]
+        n = q.get("n", [None])[0]
+        peers = _cluster_peers(
+            ports=[int(p) for p in ports.split(",") if p] if ports
+            else None,
+            n=int(n) if n else None)
+        fleet = _fleet_mod()
+        if fmt == "json":
+            self._send_json(fleet.fleet_report(peers))
+        else:
+            self._send(fleet.merge_prometheus(peers),
+                       "text/plain; version=0.0.4")
 
     # ------------------------------------------------------ serving plane
 
@@ -331,6 +407,79 @@ def rebind(process_index: int | None = None) -> None:
             _server = ObsServer(_host(), base + idx, idx)
         except OSError:
             _server = None
+
+
+# --------------------------------------------------- fleet federation
+#
+# The multihost port-offset scheme (each process serves base + index)
+# already tells every process where its siblings listen; /cluster
+# turns that into one fleet-wide view.  The scrape/relabel/merge core
+# lives ONCE in tools/fleet.py (which must stay dbcsr_tpu-import-free
+# for offline use on copied artifacts, so the server loads it by file
+# path); only peer DISCOVERY lives here — it needs the server's bind
+# state and the jax world.
+
+_fleet = None
+
+
+def _fleet_mod():
+    """tools/fleet.py loaded by path (tools/ is not a package; the
+    shared merge logic must not be duplicated here — it already
+    drifted once)."""
+    global _fleet
+    if _fleet is None:
+        import importlib.util
+
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        path = os.path.join(root, "tools", "fleet.py")
+        spec = importlib.util.spec_from_file_location(
+            "_dbcsr_tpu_fleet", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _fleet = mod
+    return _fleet
+
+
+def _cluster_peers(ports: list | None = None,
+                   n: int | None = None) -> list:
+    """[(index, url)] of the fleet's endpoints.  Explicit ``ports``
+    win; else the remembered base port + the world's process count
+    (falling back to probing up to 8 consecutive ports when no backend
+    knows the count)."""
+    host = _host()
+    base = _pending_base
+    if base is None and _server is not None:
+        base = _server.port - _server.process_index
+    if ports:
+        # provenance must name the REAL process index: with the base
+        # port known, index = port - base (so ?ports=9101 on a base
+        # of 9100 labels process="1", and subsets stay truthful);
+        # ports outside the offset scheme fall back to position
+        out = []
+        for i, p in enumerate(ports):
+            idx = p - base if (base is not None
+                               and 0 <= p - base < 4096) else i
+            out.append((idx, f"http://{host}:{p}"))
+        return out
+    if base is None:
+        return [(0, url())] if url() else []
+    if n is None:
+        import sys
+
+        jax = sys.modules.get("jax")
+        xb = sys.modules.get("jax._src.xla_bridge")
+        if jax is not None and xb is not None \
+                and getattr(xb, "_backends", None):
+            try:
+                n = int(jax.process_count())
+            except Exception:
+                n = None
+    # no world evidence and no explicit count: the fleet is just this
+    # process — fabricating sibling ports would report phantom peers
+    # as down and page spuriously on a healthy single-process job
+    count = n if n else 1
+    return [(i, f"http://{host}:{base + i}") for i in range(count)]
 
 
 # env activation: DBCSR_TPU_OBS_PORT set at import serves the endpoint

@@ -38,8 +38,10 @@ every call site is one module-attribute ``is None`` check — the
 off-path no-op contract the <2% multiply-overhead budget requires.
 
 This module is deliberately stdlib-only: `core.timings` and
-`core.stats` import it at module level, so it must not pull in any
-dbcsr_tpu (or jax) module.
+`core.stats` import it at module level, so it must not pull in jax or
+any dbcsr_tpu module beyond `obs.shard` (itself stdlib-only — the one
+sharding-contract implementation the tracer, the event bus and the
+time-series store share).
 """
 
 from __future__ import annotations
@@ -47,9 +49,10 @@ from __future__ import annotations
 import atexit
 import json
 import os
-import re
 import threading
 import time
+
+from dbcsr_tpu.obs import shard as _shard
 
 # bound on the in-memory event list backing the Chrome export; the
 # JSONL stream is unbounded (it goes straight to disk)
@@ -64,12 +67,10 @@ def _json_default(o):
     return str(o)
 
 
-def shard_path(base: str, index) -> str:
-    """Shard file for a base trace path: ``t.jsonl`` + 0 ->
-    ``t.p0.jsonl`` (the extension, when present, stays last so shell
-    globs like ``t.p*.jsonl`` work)."""
-    root, ext = os.path.splitext(base)
-    return f"{root}.p{index}{ext}"
+# the one sharding-contract implementation lives in obs.shard; these
+# aliases keep the tracer's historical import surface working (the
+# event bus, the obs server and init_multihost all read them here)
+shard_path = _shard.shard_path
 
 
 class Tracer:
@@ -99,14 +100,7 @@ class Tracer:
         pid = _process_index()
         self._pid_final = pid is not None
         self.process_index = pid or 0
-        if self._pid_final:
-            tag = pid
-        else:
-            import socket
-
-            host = re.sub(r"[^A-Za-z0-9]+", "-",
-                          socket.gethostname())[:24] or "host"
-            tag = f"tmp{host}-{os.getpid()}"
+        tag = pid if self._pid_final else _shard.provisional_tag()
         self.path = shard_path(path, tag)
         self.chrome_path = chrome_path or (self.path + ".chrome.json")
         self._chrome_path_forced = chrome_path is not None
@@ -211,23 +205,10 @@ class Tracer:
             pid = 0
         self._pid_final = True
         self.process_index = int(pid)
-        new_path = shard_path(self.base_path, int(pid))
-        if new_path != self.path:
-            self._fh.close()
-            try:
-                if os.path.exists(new_path):
-                    # a shard already lives at the final name (an
-                    # earlier run's, or another process's): APPEND this
-                    # session's events instead of clobbering it —
-                    # rename must never destroy trace data
-                    with open(self.path) as src, open(new_path, "a") as dst:
-                        dst.write(src.read())
-                    os.remove(self.path)
-                else:
-                    os.replace(self.path, new_path)
-            except OSError:  # cross-device/locked: keep the provisional
-                new_path = self.path
-            self._fh = open(new_path, "a")
+        new_path, fh = _shard.settle(self.base_path, self.path, self._fh,
+                                     int(pid))
+        if fh is not self._fh:
+            self._fh = fh
             self.path = new_path
             if not self._chrome_path_forced:
                 self.chrome_path = new_path + ".chrome.json"
@@ -255,25 +236,8 @@ class Tracer:
         self._fh.close()
 
 
-def _process_index() -> int | None:
-    """jax process index when a backend is ALREADY initialized; None
-    otherwise.  Calling `jax.process_index()` would itself initialize
-    the backend — on a wedged axon tunnel that hangs the bare import,
-    and in multi-process runs it races `jax.distributed.initialize()` —
-    so only consult it once the backend registry is provably populated
-    (best-effort peek at xla_bridge's cache; falls back to None)."""
-    import sys
-
-    jax = sys.modules.get("jax")
-    if jax is None:
-        return None
-    xb = sys.modules.get("jax._src.xla_bridge")
-    if xb is None or not getattr(xb, "_backends", None):
-        return None  # no backend up yet: do NOT force one
-    try:
-        return int(jax.process_index())
-    except Exception:
-        return None
+# see obs.shard.process_index — never forces backend init
+_process_index = _shard.process_index
 
 
 def chrome_events(events: list) -> list:

@@ -1,0 +1,98 @@
+"""Shared rolling-window statistics: the ONE quantile/median/MAD
+implementation the obs plane agrees on.
+
+Three consumers historically carried private copies of this logic —
+`serve/engine.py`'s exact rolling p50/p95 (the `/serve/tenants`
+latency percentiles), `obs/health.py`'s median/MAD (the
+`tools/perf_gate.py` noise convention reused by the latency-spike
+detector), and now `obs/slo.py`'s multi-window burn rates.  They are
+deduplicated here with the historical output conventions PINNED:
+
+* `median` / `mad` — the perf-gate convention: true median (mean of
+  the two middle elements on even length), MAD = median of absolute
+  deviations.  `obs/health.py` re-exports both unchanged.
+* `rank_quantile` — the serving plane's exact empirical quantile:
+  ``sorted_xs[min(n - 1, int(n * q))]``.  For q=0.5 this is
+  ``sorted_xs[n // 2]`` — the upper median, NOT `median()`'s
+  interpolated one; `/serve/tenants` has always reported it this way
+  and the pinned tests keep it so.
+* `Window` — a bounded rolling sample window with O(1) running sums
+  (the health detectors' budget: no O(window) pass per multiply).
+
+Stdlib-only: `serve.engine` and `obs.health` reach this from hot-ish
+paths.
+"""
+
+from __future__ import annotations
+
+import collections
+
+
+def median(xs) -> float:
+    """True median (interpolated on even length) — the
+    `tools/perf_gate.py` noise convention."""
+    xs = sorted(xs)
+    n = len(xs)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    return float(xs[mid]) if n % 2 else (xs[mid - 1] + xs[mid]) / 2.0
+
+
+def mad(xs) -> float:
+    """Median absolute deviation (same convention as `median`)."""
+    m = median(xs)
+    return median([abs(x - m) for x in xs])
+
+
+def rank_quantile(sorted_xs, q: float) -> float:
+    """The serving plane's exact empirical quantile over an already
+    SORTED sequence: ``sorted_xs[min(n - 1, int(n * q))]``.  Matches
+    the historical `/serve/tenants` p50/p95 outputs bit-for-bit."""
+    n = len(sorted_xs)
+    if n == 0:
+        return 0.0
+    return float(sorted_xs[min(n - 1, int(n * q))])
+
+
+def p50_p95(values) -> tuple:
+    """(p50, p95) of an UNSORTED sample via `rank_quantile` — the one
+    call `/serve/tenants` and the timeseries serve collector share."""
+    xs = sorted(values)
+    return rank_quantile(xs, 0.5), rank_quantile(xs, 0.95)
+
+
+class Window:
+    """Bounded rolling window of float samples with a running sum.
+
+    `append` evicts the oldest sample once ``maxlen`` is reached and
+    keeps ``sum`` incrementally — consumers that need a rate over the
+    window (shed fraction, recompiles per multiply) read it O(1).
+    """
+
+    __slots__ = ("_dq", "sum")
+
+    def __init__(self, maxlen: int):
+        self._dq: collections.deque = collections.deque(
+            maxlen=max(1, int(maxlen)))
+        self.sum = 0.0
+
+    def append(self, v: float) -> None:
+        if len(self._dq) == self._dq.maxlen:
+            self.sum -= self._dq[0]
+        self._dq.append(v)
+        self.sum += v
+
+    def __len__(self) -> int:
+        return len(self._dq)
+
+    def __iter__(self):
+        return iter(self._dq)
+
+    def mean(self) -> float:
+        n = len(self._dq)
+        return self.sum / n if n else 0.0
+
+    def clear(self) -> None:
+        self._dq.clear()
+        self.sum = 0.0

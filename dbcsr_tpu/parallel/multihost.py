@@ -36,15 +36,18 @@ from dbcsr_tpu.resilience import faults as _faults
 
 def _obs_rebind() -> None:
     """World-join obs bookkeeping that does NOT need the trace barrier:
-    settle the event-bus sink shard onto its final ``p{index}`` name
-    and move the introspection endpoint to its ``base + index`` port —
-    both no-ops when the respective layer is off."""
+    settle the event-bus and telemetry time-series sink shards onto
+    their final ``p{index}`` names and move the introspection endpoint
+    to its ``base + index`` port — all no-ops when the respective
+    layer is off."""
     try:
         from dbcsr_tpu.obs import events as _events
         from dbcsr_tpu.obs import server as _server
+        from dbcsr_tpu.obs import timeseries as _timeseries
 
         idx = int(jax.process_index())
         _events.rebind(idx)
+        _timeseries.rebind(idx)
         _server.rebind(idx)
     except Exception:
         pass  # obs bookkeeping must never fail a world join

@@ -751,15 +751,26 @@ class ServeEngine:
             out.setdefault(lab.get("tenant", "?"), {})[
                 "deadline_missed"] = int(v)
         load = self.queue.tenant_load()
-        with self._slock:
-            lats = {t: sorted(d) for t, d in self._lat.items() if d}
+        lats = self.latency_quantiles()
         for t, ent in out.items():
             ent.update(load.get(t, {}))
-            xs = lats.get(t)
-            if xs:
-                ent["p50_ms"] = round(xs[len(xs) // 2], 3)
-                ent["p95_ms"] = round(
-                    xs[min(len(xs) - 1, int(len(xs) * 0.95))], 3)
+            if t in lats:
+                ent.update(lats[t])
+        return out
+
+    def latency_quantiles(self) -> dict:
+        """{tenant: {"p50_ms", "p95_ms"}} over the rolling latency
+        windows — the exact empirical quantiles (`obs.windows.p50_p95`,
+        the shared convention `/serve/tenants` has always reported and
+        the telemetry time-series store samples)."""
+        from dbcsr_tpu.obs import windows as _windows
+
+        with self._slock:
+            snap = {t: list(d) for t, d in self._lat.items() if d}
+        out = {}
+        for t, xs in snap.items():
+            p50, p95 = _windows.p50_p95(xs)
+            out[t] = {"p50_ms": round(p50, 3), "p95_ms": round(p95, 3)}
         return out
 
 

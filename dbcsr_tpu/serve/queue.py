@@ -271,6 +271,15 @@ class AdmissionQueue:
             _health.observe_serve(shed=shed)
         except Exception:
             pass  # health sampling must never fail admission
+        try:
+            # admission decisions are sample boundaries too: a shed
+            # storm with no multiplies running must still land in the
+            # telemetry history (cadence-gated inside)
+            from dbcsr_tpu.obs import timeseries as _ts
+
+            _ts.maybe_sample()
+        except Exception:
+            pass
 
     # ------------------------------------------------------------ admission
 
@@ -342,7 +351,7 @@ class AdmissionQueue:
         try:
             from dbcsr_tpu.obs import health as _health
 
-            return _health.verdict()["status"]
+            return _health.admission_status()
         except Exception:
             return "OK"  # an unevaluable verdict must not close admission
 

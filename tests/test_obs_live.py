@@ -156,7 +156,7 @@ def test_endpoint_serves_metrics_and_healthz(endpoint):
     v = json.loads(body)
     assert v["status"] in ("OK", "DEGRADED")
     assert set(v["components"]) == {"drivers", "watchdog", "engine",
-                                    "perf", "integrity"}
+                                    "perf", "integrity", "slo"}
 
 
 def test_endpoint_serves_flight_and_filtered_events(endpoint):
@@ -369,7 +369,8 @@ def test_doctor_runbook_anchors_exist():
         return anchors
 
     docs = {"resilience.md": anchors_of("resilience.md"),
-            "serving.md": anchors_of("serving.md")}
+            "serving.md": anchors_of("serving.md"),
+            "observability.md": anchors_of("observability.md")}
     for kind, (_, anchor) in doctor.HINTS.items():
         if anchor.startswith("docs/"):
             doc, frag = anchor[len("docs/"):].split("#", 1)

@@ -18,6 +18,15 @@ Artifact mode (any subset; shard bases expand like DBCSR_TPU_TRACE):
     python tools/doctor.py --events events.jsonl --trace trace.jsonl \\
         --probe capture_probe.jsonl --captures BENCH_CAPTURES.jsonl
 
+Trend mode (``--trend``): sparkline history tables per telemetry cell
+and the SLO burn summary, from a live endpoint's ``/timeseries`` +
+``/slo`` routes or from committed time-series shard artifacts
+(``--timeseries``, default ``timeseries.jsonl``; the capture loop's
+committed ``TELEMETRY_ROLLUP.jsonl`` works too):
+
+    python tools/doctor.py --port 9100 --trend
+    python tools/doctor.py --trend --timeseries TELEMETRY_ROLLUP.jsonl
+
 With no arguments the doctor looks for the default artifact names in
 the current directory.  ``--json`` emits the report machine-readable;
 ``--selftest`` runs the full pipeline offline against synthetic events
@@ -105,7 +114,27 @@ HINTS = {
         "journaled; restart the process with DBCSR_TPU_SERVE_JOURNAL "
         "pinned to the same path to replay them exactly once",
         SERVE_RUNBOOK + "#drain--restart"),
+    "slo_burn": (
+        "an objective is burning its error budget on BOTH the short "
+        "and long windows — sustained, not a spike; shed load, raise "
+        "capacity, or roll back the regressing change",
+        "docs/observability.md#slo-objectives--error-budget-burn"),
 }
+
+# the telemetry cells --trend tables by default (history worth eyes:
+# per-driver roofline, the autotune evidence cells, serve load/latency,
+# breaker states, SLO burn, health status)
+TREND_METRICS = (
+    "dbcsr_tpu_roofline_fraction",
+    "dbcsr_tpu_cell_flops_total",
+    "dbcsr_tpu_serve_queue_depth",
+    "dbcsr_tpu_serve_latency_p95_ms",
+    "dbcsr_tpu_serve_shed_total",
+    "dbcsr_tpu_breaker_state",
+    "dbcsr_tpu_abft_mismatches_total",
+    "dbcsr_tpu_slo_burn_rate",
+    "dbcsr_tpu_health_status",
+)
 
 
 # --------------------------------------------------------- prometheus
@@ -392,6 +421,22 @@ def analyze(health: dict | None, prom: dict, events: list,
             f"{integrity['drains']} drain(s), "
             f"{integrity.get('replayed', 0)} replayed")))
 
+    # SLO burn: the live verdict's slo component first, else slo_burn
+    # bus events (the telemetry history plane, obs/slo.py)
+    slo_burning: dict = {}
+    if health:
+        slo_comp = (health.get("components") or {}).get("slo") or {}
+        for name, row in (slo_comp.get("objectives") or {}).items():
+            if row.get("status") == "BURNING":
+                slo_burning[name] = row.get("burn")
+    for e in events:
+        if e.get("event") == "slo_burn":
+            slo_burning.setdefault(e.get("objective", "?"), e.get("burn"))
+    if slo_burning:
+        report["slo_burning"] = slo_burning
+        report["hints"].append(_hint("slo_burn", detail=", ".join(
+            f"{n} ({b}x)" for n, b in sorted(slo_burning.items()))))
+
     # anomalies: live health verdict first, else anomaly events
     anomalies: dict = collections.Counter()
     if health:
@@ -421,7 +466,7 @@ def analyze(health: dict | None, prom: dict, events: list,
     if health is None:
         status = "OK"
         if open_breakers or wedged or anomalies or sdc_total \
-                or integrity["rollbacks"]:
+                or integrity["rollbacks"] or slo_burning:
             status = "DEGRADED"
         if corrupt or repeat or any(w.get("wedge_streak", 0) >= 3
                                     for w in watchdog.values()):
@@ -523,6 +568,10 @@ def render(report: dict, out=print) -> None:
         if ig.get("replayed"):
             parts.append(f"replayed={ig['replayed']}")
         out(" integrity: " + ", ".join(parts))
+    if report.get("slo_burning"):
+        out(" slo burning: " + ", ".join(
+            f"{n} ({b}x)" for n, b in
+            sorted(report["slo_burning"].items())))
     if report.get("anomalies"):
         out(" anomalies: " + ", ".join(
             f"{k}={v}" for k, v in sorted(report["anomalies"].items())))
@@ -537,6 +586,127 @@ def render(report: dict, out=print) -> None:
             and not (report.get("offenders") or {}).get("recompiles"):
         out(" (no signals found — is the job instrumented / are the "
             "artifact paths right?)")
+
+
+# -------------------------------------------------------------- trend
+
+def _fleet_mod():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import fleet
+
+    return fleet
+
+
+def fetch_trend_live(url: str, timeout: float = 10.0) -> dict:
+    """Trend report off a live endpoint: per-cell history from
+    ``/timeseries`` (one query per `TREND_METRICS` family) + the SLO
+    evaluation from ``/slo``."""
+    import urllib.error
+    import urllib.request
+
+    def get(route):
+        try:
+            with urllib.request.urlopen(url.rstrip("/") + route,
+                                        timeout=timeout) as r:
+                return r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.read().decode()
+
+    series = []
+    reached = 0
+    last_exc = None
+    for metric in TREND_METRICS:
+        try:
+            resp = json.loads(get(f"/timeseries?metric={metric}"))
+        except ValueError:
+            reached += 1  # endpoint answered, payload unusable
+            continue
+        except Exception as exc:
+            # endpoint restarting/dying mid-loop: keep what the other
+            # queries already fetched instead of discarding everything
+            last_exc = exc
+            continue
+        reached += 1
+        if isinstance(resp, list):  # a pre-v4 endpoint 404s with a dict
+            series.extend(r for r in resp if isinstance(r, dict))
+    slo: dict = {}
+    try:
+        resp = json.loads(get("/slo"))
+        reached += 1
+        if isinstance(resp, dict):
+            slo = resp.get("objectives") or {}
+    except Exception:
+        pass
+    if not reached and last_exc is not None:
+        raise last_exc  # fully unreachable: main's exit-2 path
+    return {"source": "live", "processes": {"live": series}, "slo": slo}
+
+
+def trend_from_artifacts(ts_base: str) -> dict:
+    """Trend report from committed time-series shard artifacts (the
+    `tools/fleet.py` data model; no dbcsr_tpu import).  The SLO burn
+    summary replays the persisted ``dbcsr_tpu_slo_burn_rate`` points —
+    burn history travels WITH the shard, so an offline diagnosis sees
+    the same objectives the live process alerted on."""
+    fleet = _fleet_mod()
+    merged = fleet.merge_shards(ts_base)
+    processes: dict = {}
+    slo: dict = {}
+    for proc, series in merged.items():
+        rows = []
+        for (metric, _), ent in sorted(series.items()):
+            if metric not in TREND_METRICS:
+                continue
+            rows.append({"metric": metric, "labels": ent["labels"],
+                         "points": [[t, v] for t, v in ent["points"]]})
+            if metric == "dbcsr_tpu_slo_burn_rate" and ent["points"]:
+                name = ent["labels"].get("objective", "?")
+                burn = ent["points"][-1][1]
+                peak = max(v for _, v in ent["points"])
+                row = slo.setdefault(name, {"burn": burn, "peak": peak})
+                row["burn"] = max(row["burn"], burn)
+                row["peak"] = max(row["peak"], peak)
+                # BURNING = still over budget at the shard's tail;
+                # BURNED = a burn is in the history but it recovered
+                row["status"] = ("BURNING" if row["burn"] > 1.0 else
+                                 "BURNED" if row["peak"] > 1.0 else "OK")
+        processes[proc] = rows
+    return {"source": "artifacts", "processes": processes, "slo": slo}
+
+
+def render_trend(report: dict, out=print) -> None:
+    fleet = _fleet_mod()
+    out(f" dbcsr_tpu doctor --trend  (source: {report['source']})")
+    for proc, rows in sorted(report["processes"].items()):
+        if not rows:
+            continue
+        out(f" process {proc}:")
+        by_metric: dict = collections.defaultdict(list)
+        for row in rows:
+            by_metric[row["metric"]].append(row)
+        for metric in TREND_METRICS:
+            if metric not in by_metric:
+                continue
+            out(f"   {metric}")
+            for row in by_metric[metric]:
+                pts = row["points"]
+                if not pts:
+                    continue
+                lab = ",".join(f"{k}={v}" for k, v in
+                               sorted(row["labels"].items())) or "-"
+                spark = fleet.sparkline([v for _, v in pts]) \
+                    if len(pts) > 1 else ""
+                out(f"     {lab:<44} last={pts[-1][1]:<12.6g} "
+                    f"n={len(pts):<4} {spark}")
+    slo = report.get("slo") or {}
+    if slo:
+        out(" slo burn summary:")
+        for name, row in sorted(slo.items()):
+            extra = f" peak={row['peak']:.2f}x" if "peak" in row else ""
+            out(f"   {name:<22} {row.get('status', '?'):<8} "
+                f"burn={row.get('burn', 0):.2f}x{extra}")
+    else:
+        out(" slo burn summary: (no slo series found)")
 
 
 # ----------------------------------------------------------- selftest
@@ -585,6 +755,11 @@ def _selftest(repo_root: str) -> int:
          "journaled": 1, "completed_inflight": True},
         {"event": "serve_replayed", "request_id": "req-4",
          "tenant": "alice", "journal": "serve_journal-1.jsonl"},
+        # SLO plane: one objective burning its error budget — the
+        # slo_burn hint must materialize from events alone
+        {"event": "slo_burn", "objective": "serve_p95_latency",
+         "burn": 3.2, "burn_short": 4.0, "burn_long": 3.2,
+         "budget": 0.1},
     ]
     probe = [{"ts": "2026-01-01T00:00:00", "name": "tpu_probe",
               "outcome": "WEDGED", "streak": 4, "wedge_streak": 2,
@@ -601,7 +776,43 @@ def _selftest(repo_root: str) -> int:
     captures += _read_jsonl(os.path.join(repo_root, "BENCH_CAPTURES.jsonl"))
     report = analyze(None, {}, events, [], probe, captures)
     render(report)
-    ok = (
+
+    # --trend offline: a synthetic 2-process shard family (one rank
+    # healthy, one with a burning serve-latency SLO) through the full
+    # trend pipeline — per-cell sparklines + the burn summary
+    import tempfile
+
+    trend_lines = []
+    with tempfile.TemporaryDirectory() as td:
+        for proc, burns in (("0", [0.0, 0.2, 0.1]), ("1", [0.5, 2.0, 3.2])):
+            with open(os.path.join(td, f"ts.p{proc}.jsonl"), "w") as fh:
+                for i, burn in enumerate(burns):
+                    fh.write(json.dumps({
+                        "seq": i + 1, "t": 1000.0 + 10 * i,
+                        "reason": "interval",
+                        "points": [
+                            ["dbcsr_tpu_roofline_fraction",
+                             {"driver": "xla"}, 0.4 - 0.1 * i, "gauge"],
+                            ["dbcsr_tpu_serve_latency_p95_ms",
+                             {"tenant": "alice"}, 40.0 + 400 * i,
+                             "gauge"],
+                            ["dbcsr_tpu_slo_burn_rate",
+                             {"objective": "serve_p95_latency"}, burn,
+                             "gauge"],
+                        ]}) + "\n")
+        trend = trend_from_artifacts(os.path.join(td, "ts.jsonl"))
+        render_trend(trend, out=trend_lines.append)
+    for ln in trend_lines:
+        print(ln)
+    trend_ok = (
+        set(trend["processes"]) == {"0", "1"}
+        and trend["slo"]["serve_p95_latency"]["status"] == "BURNING"
+        and trend["slo"]["serve_p95_latency"]["burn"] == 3.2
+        and any("driver=xla" in ln for ln in trend_lines)
+        and any("slo burn summary" in ln for ln in trend_lines)
+    )
+
+    ok = trend_ok and (
         report["health"]["status"] in ("DEGRADED", "CRITICAL")
         and report["breakers"].get("pallas|23x23x23xfloat64") == "open"
         and report["watchdog"].get("tpu_probe", {}).get("wedge_streak") == 2
@@ -620,6 +831,8 @@ def _selftest(repo_root: str) -> int:
         and any(h["kind"] == "abft_mismatch" for h in report["hints"])
         and any(h["kind"] == "chain_rollback" for h in report["hints"])
         and any(h["kind"] == "serve_drain" for h in report["hints"])
+        and report["slo_burning"] == {"serve_p95_latency": 3.2}
+        and any(h["kind"] == "slo_burn" for h in report["hints"])
     )
     print(f" selftest: {'OK' if ok else 'FAILED'} "
           f"(captures read: {len(captures)})")
@@ -644,6 +857,14 @@ def main(argv=None) -> int:
                     help="watchdog probe JSONL (capture loop)")
     ap.add_argument("--captures", default="BENCH_CAPTURES.jsonl",
                     help="bench capture JSONL (roofline fractions)")
+    ap.add_argument("--timeseries", default="timeseries.jsonl",
+                    help="telemetry time-series shard base or file "
+                         "(--trend artifact mode; the committed "
+                         "TELEMETRY_ROLLUP.jsonl works too)")
+    ap.add_argument("--trend", action="store_true",
+                    help="sparkline history tables per telemetry cell "
+                         "+ SLO burn summary, from /timeseries + /slo "
+                         "(live) or the --timeseries shards")
     ap.add_argument("--top", type=int, default=5,
                     help="offender table size (default 5)")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -656,6 +877,35 @@ def main(argv=None) -> int:
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if args.selftest:
         return _selftest(repo_root)
+
+    if args.trend:
+        if args.url or args.port:
+            url = args.url or f"http://127.0.0.1:{args.port}"
+            try:
+                report = fetch_trend_live(url)
+            except Exception as exc:
+                print(f"doctor: cannot reach {url}: "
+                      f"{type(exc).__name__}: {exc}", file=sys.stderr)
+                return 2
+            if not any(report["processes"].values()) \
+                    and not report.get("slo"):
+                # something answered but nothing was telemetry (a
+                # typo'd port hitting another service must not read
+                # as "fleet healthy, no burn")
+                print(f"doctor: {url} returned no timeseries/slo data "
+                      f"(is this an obs endpoint?)", file=sys.stderr)
+                return 2
+        else:
+            report = trend_from_artifacts(args.timeseries)
+            if not any(report["processes"].values()):
+                print(f"doctor: no timeseries data at "
+                      f"{args.timeseries!r}", file=sys.stderr)
+                return 2
+        if args.as_json:
+            print(json.dumps(report, default=str))
+        else:
+            render_trend(report)
+        return 0
 
     health = None
     prom: dict = {}
@@ -697,4 +947,7 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `doctor ... | head` closing the pipe
+        sys.exit(0)

@@ -1,0 +1,342 @@
+"""dbcsr_tpu fleet: merge per-process telemetry into one fleet view.
+
+The offline equivalent of the live ``/cluster`` route: where every
+process of a multihost world serves its own introspection endpoint
+(``DBCSR_TPU_OBS_PORT`` + process-index offset) and writes its own
+telemetry time-series shard (``DBCSR_TPU_TS=<base>`` →
+``timeseries.p{index}.jsonl``), this tool merges EITHER source into
+one fleet-wide report with per-process provenance:
+
+Artifact mode (committed/copied shards; no dbcsr_tpu import):
+
+    python tools/fleet.py --timeseries timeseries.jsonl
+    python tools/fleet.py --timeseries TELEMETRY_ROLLUP.jsonl --json
+
+Live mode (scrape a running fleet's endpoints):
+
+    python tools/fleet.py --urls http://127.0.0.1:9100,http://127.0.0.1:9101
+    python tools/fleet.py --ports 9100,9101 --prom > fleet.prom
+
+``--prom`` emits one merged Prometheus exposition with
+``process``/``endpoint`` labels injected into every sample line
+(exactly the ``/cluster?format=prom`` payload, built client-side);
+the default rendering is a per-(process, metric, labels) table with
+sparkline history for series that carry more than one point.
+
+Like `tools/doctor.py`, artifact mode never imports dbcsr_tpu — it
+works on files copied off another machine; live mode is stdlib urllib.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import re
+import sys
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 24) -> str:
+    """Unicode sparkline of a numeric history (down-sampled to
+    ``width`` by taking the last point of each segment)."""
+    vs = [float(v) for v in values]
+    if not vs:
+        return ""
+    if len(vs) > width:
+        step = len(vs) / width
+        vs = [vs[min(len(vs) - 1, int((i + 1) * step) - 1)]
+              for i in range(width)]
+    lo, hi = min(vs), max(vs)
+    if hi - lo < 1e-12:
+        return _SPARK[0] * len(vs)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((v - lo) / (hi - lo) * (len(_SPARK) - 1)))]
+        for v in vs)
+
+
+# ----------------------------------------------------------- artifacts
+
+def expand_ts_shards(base: str) -> dict:
+    """{process_label: [shard files]} for a timeseries shard base (or
+    a concrete file/glob).  Process labels come from the ``pN`` shard
+    suffix; a file without one labels ``0``.  Unsettled ``.ptmp*``
+    shards are skipped (the trace/events convention)."""
+    hits = sorted(glob.glob(base))
+    if not hits and not re.search(r"\.p\d+\.", os.path.basename(base)):
+        root, ext = os.path.splitext(base)
+        hits = [h for h in sorted(glob.glob(f"{root}.p*{ext}"))
+                if ".ptmp" not in os.path.basename(h)]
+    if not hits and os.path.exists(base):
+        hits = [base]
+    out: dict = collections.defaultdict(list)
+    for path in hits:
+        if ".ptmp" in os.path.basename(path):
+            continue
+        m = re.search(r"\.p(\d+)\.", os.path.basename(path))
+        out[m.group(1) if m else "0"].append(path)
+    return dict(out)
+
+
+def read_samples(paths) -> list:
+    """Sample records of one process's shard files, oldest first."""
+    recs = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail line
+                    if isinstance(rec, dict) and "points" in rec:
+                        recs.append(rec)
+        except OSError:
+            continue
+    recs.sort(key=lambda r: (r.get("t", 0), r.get("seq", 0)))
+    return recs
+
+
+def series_history(samples: list) -> dict:
+    """{(metric, labels_key): {"labels", "kind", "points": [(t, v)]}}
+    rebuilt from one process's raw sample records."""
+    out: dict = {}
+    for rec in samples:
+        t = rec.get("t", 0)
+        for pt in rec.get("points", []):
+            try:
+                metric, labels, value, kind = pt
+            except (ValueError, TypeError):
+                continue
+            key = (metric, tuple(sorted((labels or {}).items())))
+            ent = out.setdefault(key, {"labels": dict(labels or {}),
+                                       "kind": kind, "points": []})
+            ent["points"].append((t, float(value)))
+    return out
+
+
+def merge_shards(base: str) -> dict:
+    """{process: {series_key: history}} across the whole shard family
+    — the fleet table's data model."""
+    return {proc: series_history(read_samples(paths))
+            for proc, paths in sorted(expand_ts_shards(base).items())}
+
+
+# ---------------------------------------------------------- live scrape
+
+def fetch(url: str, route: str, timeout: float = 5.0):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + route,
+                                    timeout=timeout) as r:
+            return r.read().decode()
+    except urllib.error.HTTPError as exc:  # 503 CRITICAL still has a body
+        try:
+            return exc.read().decode()
+        except Exception:
+            return None
+    except Exception:
+        return None  # unreachable sibling: provenance records the gap
+
+
+def fetch_all(peers: list, route: str, timeout: float = 5.0) -> dict:
+    """{process: body-or-None} for one route across every peer,
+    fetched CONCURRENTLY — a partially-down fleet costs one timeout,
+    not one timeout per dead peer (a degraded fleet is exactly when
+    this tooling matters)."""
+    import concurrent.futures
+
+    if not peers:
+        return {}
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(16, len(peers))) as pool:
+        futs = [(proc, pool.submit(fetch, url, route, timeout))
+                for proc, url in peers]
+        return {proc: fut.result() for proc, fut in futs}
+
+
+_SAMPLE_RE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+(.+)$")
+
+
+def relabel_prometheus(text: str, extra: dict) -> list:
+    """Inject provenance labels into every sample line (the /cluster
+    transform, client-side)."""
+    inject = ",".join(f'{k}="{v}"' for k, v in sorted(extra.items()))
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, labels, value = m.group(1), m.group(2), m.group(3)
+        inner = (labels[1:-1] + "," + inject) if labels else inject
+        out.append(f"{name}{{{inner}}} {value}")
+    return out
+
+
+def merge_prometheus(peers: list) -> str:
+    """One exposition from [(process, url)] — duplicate HELP/TYPE
+    lines deduped, unreachable peers as ``dbcsr_tpu_cluster_peer_up 0``.
+    Also the body behind ``/cluster?format=prom`` (the obs server
+    delegates here — ONE scrape/relabel/merge implementation)."""
+    lines = ["# HELP dbcsr_tpu_cluster_peer_up fleet peer endpoint "
+             "reachability (1 = scraped)",
+             "# TYPE dbcsr_tpu_cluster_peer_up gauge"]
+    bodies: list = []
+    seen: set = set()
+    texts = fetch_all(peers, "/metrics")
+    for proc, url in peers:
+        text = texts.get(proc)
+        lines.append(f'dbcsr_tpu_cluster_peer_up{{process="{proc}",'
+                     f'endpoint="{url}"}} {1 if text is not None else 0}')
+        if text is None:
+            continue
+        for line in relabel_prometheus(
+                text, {"process": str(proc), "endpoint": url}):
+            if line.startswith("#"):
+                if line in seen:
+                    continue
+                seen.add(line)
+            bodies.append(line)
+    return "\n".join(lines + bodies) + "\n"
+
+
+def fleet_report(peers: list) -> dict:
+    """Live fleet summary from [(process, url)]: per-process health
+    status + components + anomalies, SLO burn, fleet-worst status.
+    Also the ``/cluster?format=json`` payload (the obs server
+    delegates here)."""
+    healths = fetch_all(peers, "/healthz")
+    slos = fetch_all(peers, "/slo")
+    procs: dict = {}
+    for proc, url in peers:
+        ent: dict = {"endpoint": url, "up": False}
+        body = healths.get(proc)
+        if body:
+            try:
+                h = json.loads(body)
+                ent.update(up=True, status=h.get("status"),
+                           components={k: c.get("status") for k, c in
+                                       (h.get("components") or {}).items()},
+                           anomalies=h.get("anomalies"))
+            except ValueError:
+                pass
+        slo_body = slos.get(proc)
+        if slo_body:
+            try:
+                ent["slo"] = {
+                    n: {"status": r.get("status"), "burn": r.get("burn")}
+                    for n, r in (json.loads(slo_body)
+                                 .get("objectives") or {}).items()}
+            except ValueError:
+                pass
+        procs[str(proc)] = ent
+    order = {"OK": 0, "DEGRADED": 1, "CRITICAL": 2}
+    worst = "OK"
+    for ent in procs.values():
+        if order.get(ent.get("status"), 0) > order[worst]:
+            worst = ent["status"]
+    return {"fleet_status": worst, "processes": procs,
+            "reachable": sum(1 for e in procs.values() if e["up"]),
+            "scraped": len(procs)}
+
+
+# ------------------------------------------------------------ rendering
+
+def render_table(fleet: dict, metrics: list | None = None,
+                 out=print) -> int:
+    """The fleet table: one row per (process, metric, labels) with the
+    latest value and a sparkline history.  Returns rows printed."""
+    rows = 0
+    for proc, series in fleet.items():
+        out(f" process {proc}: {len(series)} series")
+        for (metric, _), ent in sorted(series.items()):
+            if metrics and metric not in metrics:
+                continue
+            pts = ent["points"]
+            if not pts:
+                continue
+            lab = ",".join(f"{k}={v}" for k, v in
+                           sorted(ent["labels"].items())) or "-"
+            spark = sparkline([v for _, v in pts]) if len(pts) > 1 else ""
+            out(f"   {metric:<40} {lab:<36} "
+                f"last={pts[-1][1]:<12.6g} n={len(pts):<4} {spark}")
+            rows += 1
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--timeseries", default="timeseries.jsonl",
+                    help="timeseries shard base or file (artifact mode)")
+    ap.add_argument("--urls", help="comma-separated live endpoint URLs")
+    ap.add_argument("--ports",
+                    help="comma-separated live ports on localhost")
+    ap.add_argument("--metric", action="append",
+                    help="restrict the table to these metrics "
+                         "(repeatable)")
+    ap.add_argument("--prom", action="store_true",
+                    help="live mode: emit one merged Prometheus "
+                         "exposition with provenance labels")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    if args.urls or args.ports:
+        if args.urls:
+            peers = [(i, u) for i, u in
+                     enumerate(u for u in args.urls.split(",") if u)]
+        else:
+            peers = [(i, f"http://127.0.0.1:{p}") for i, p in
+                     enumerate(p for p in args.ports.split(",") if p)]
+        if args.prom:
+            sys.stdout.write(merge_prometheus(peers))
+            return 0
+        report = fleet_report(peers)
+        if args.as_json:
+            print(json.dumps(report, default=str))
+        else:
+            print(f" fleet: {report['fleet_status']} "
+                  f"({report['reachable']}/{len(peers)} reachable)")
+            for proc, ent in sorted(report["processes"].items()):
+                comp = ", ".join(f"{k}={v}" for k, v in
+                                 sorted((ent.get("components") or {})
+                                        .items()))
+                print(f"   p{proc} {ent.get('status', 'UNREACHABLE'):<12}"
+                      f" {ent['endpoint']}  {comp}")
+                for name, row in sorted((ent.get("slo") or {}).items()):
+                    print(f"      slo {name:<20} {row['status']:<8} "
+                          f"burn={row['burn']}")
+        return 0 if report["reachable"] else 2
+
+    fleet = merge_shards(args.timeseries)
+    if not fleet:
+        print(f"fleet: no timeseries shards at {args.timeseries!r}",
+              file=sys.stderr)
+        return 2
+    if args.as_json:
+        doc = {proc: [
+            {"metric": m, "labels": ent["labels"], "kind": ent["kind"],
+             "points": ent["points"]}
+            for (m, _), ent in sorted(series.items())]
+            for proc, series in fleet.items()}
+        print(json.dumps(doc, default=str))
+        return 0
+    rows = render_table(fleet, metrics=args.metric)
+    return 0 if rows else 2
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `fleet ... | head` closing the pipe
+        sys.exit(0)
