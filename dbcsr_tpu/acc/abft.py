@@ -78,6 +78,17 @@ class AbftMismatchError(RuntimeError):
     `acc.smm._classify_failure`."""
 
 
+class PrecisionExceededError(AbftMismatchError):
+    """A DEMOTED launch's probe residual breached its demotion ceiling
+    (`obs.costmodel.demoted_abft_tolerance`): not corruption but the
+    adaptive-precision promote signal.  `acc.smm.execute_stack` answers
+    it by rebuilding the plan at native precision (the involved cells
+    were already promoted by `acc.precision.note_exceeded` when this
+    raised) instead of walking the SDC failover chain.  Subclasses
+    `AbftMismatchError` so any unaware layer still treats it as a
+    condemned result rather than accepting it."""
+
+
 def mode() -> str:
     return get_config().abft
 
@@ -270,11 +281,37 @@ def record_recovery(driver: str) -> None:
 
 
 def _check_scalars(err: float, scale: float, *, dtype, k: int,
-                   depth: int, driver: str, shape, site: str) -> None:
-    tol = _costmodel.abft_tolerance(str(jnp.dtype(dtype)), k, depth)
+                   depth: int, driver: str, shape, site: str,
+                   prec=None, cells=None) -> None:
+    """``prec``/``cells`` mark a launch executed at a DEMOTED compute
+    dtype (`acc.precision` spec + the (m,n,k,dtype) cells involved):
+    the ceiling widens to the demotion tolerance, a breach promotes the
+    cells and raises `PrecisionExceededError` instead of the SDC path,
+    and a pass feeds the residual back to the planner as headroom."""
+    dt = str(jnp.dtype(dtype))
+    if prec is not None:
+        tol = _costmodel.demoted_abft_tolerance(dt, prec[0], prec[1],
+                                                k, depth)
+    else:
+        tol = _costmodel.abft_tolerance(dt, k, depth)
+    rel = err / max(scale, 1e-30)
     if not np.isfinite(err) or err > tol * max(scale, 1e-30):
-        _mismatch(driver, err / max(scale, 1e-30), tol, scale, shape,
-                  site=site)
+        if prec is not None:
+            from dbcsr_tpu.acc import precision as _precision
+
+            _precision.note_exceeded(cells, rel, tol)
+            shape_s = "x".join(str(x) for x in shape)
+            raise PrecisionExceededError(
+                f"demoted-precision probe residual at {site} (driver "
+                f"{driver!r}, shape {shape_s}, compute {prec[0]}"
+                f"{'+comp' if prec[1] else ''}): relative error "
+                f"{rel:.3e} > demotion ceiling {tol:.3e} — cells "
+                f"promoted to native")
+        _mismatch(driver, rel, tol, scale, shape, site=site)
+    elif prec is not None and cells:
+        from dbcsr_tpu.acc import precision as _precision
+
+        _precision.note_probe_ok(cells, rel)
 
 
 # ------------------------------------------------ deferred verification
@@ -314,25 +351,41 @@ def flush() -> None:
     if not pend:
         return
     items, pend[:] = list(pend), []
-    first: Optional[AbftMismatchError] = None
+    first_sdc: Optional[AbftMismatchError] = None
+    first_prec: Optional[PrecisionExceededError] = None
     mismatch_drivers: list = []
     for es_dev, meta, shape_key in items:
         es = np.asarray(es_dev)
         try:
             _check_scalars(float(es[0]), float(es[1]), **meta)
+        except PrecisionExceededError as exc:
+            # adaptive promote, not corruption: the cells were
+            # promoted when the check raised; keep it OUT of the
+            # mismatch/recovery accounting (a PrecisionExceeded never
+            # recorded a mismatch, so attributing a recovery to its
+            # driver would unbalance the counters)
+            exc.driver = meta["driver"]
+            exc.shape_key = shape_key
+            if first_prec is None:
+                first_prec = exc
         except AbftMismatchError as exc:
             exc.driver = meta["driver"]
             exc.shape_key = shape_key
             mismatch_drivers.append(meta["driver"])
-            if first is None:
-                first = exc
-    if first is not None:
+            if first_sdc is None:
+                first_sdc = exc
+    if first_sdc is not None:
         # one re-execution heals EVERY mismatched launch of the
         # product: the caller records one recovery per entry here, so
         # the mismatch/recovery counters stay balanced and health
-        # never reports fully-recovered SDC as escaped corruption
-        first.mismatch_drivers = mismatch_drivers
-        raise first
+        # never reports fully-recovered SDC as escaped corruption.
+        # A genuine SDC outranks a co-queued precision breach — the
+        # redo runs with immediate verification, where each demoted
+        # plan still heals itself.
+        first_sdc.mismatch_drivers = mismatch_drivers
+        raise first_sdc
+    if first_prec is not None:
+        raise first_prec
 
 
 # ----------------------------------------------------- stack boundary
@@ -372,9 +425,18 @@ def check_stack(base, out, a_data, b_data, plan, alpha,
             base, out, a_data, b_data, *idx, u, v, alpha_dev, nseg)
     # the double-sided probe folds the u (length-m) contraction into
     # every compared scalar: widen the accumulation depth accordingly
-    meta = dict(dtype=out.dtype, k=k,
+    prec = getattr(plan, "precision", None)
+    # the k-merged grouped layout contracts r0*k products per dot: the
+    # demoted ceiling's narrow-accumulation term must see the MERGED
+    # length or it condemns healthy grouped launches
+    k_tol = k * max(getattr(plan, "r_grp", 1), 1) \
+        if (prec is not None and plan.driver == "xla_group") else k
+    meta = dict(dtype=out.dtype, k=k_tol,
                 depth=_segment_depth(np.asarray(ci)) * max(m, n),
-                driver=plan.driver, shape=(m, n, k), site="stack")
+                driver=plan.driver, shape=(m, n, k), site="stack",
+                prec=prec,
+                cells=([(m, n, k, str(jnp.dtype(out.dtype)))]
+                       if prec is not None else None))
     if defer:
         _pending_list().append((es_dev, meta, shape_key))
         return
@@ -401,10 +463,26 @@ def check_superstack(base, out, a_datas, b_datas, splan, alpha,
         r, out_scale = _delta_probe(base, out, u, v)
     p = jnp.zeros((nseg,), acc)
     k_max, depth = 1, 1
+    prec = None  # the loosest demoted spec among the bin's spans
+    cells: list = []
+    dt_name = str(jnp.dtype(out.dtype))
     for plan, a_d, b_d in zip(splan.plans, a_datas, b_datas):
         src = getattr(plan, "src_idx", None)
         if src is None:
             return  # cannot reconstruct this span: skip the whole bin
+        p_prec = getattr(plan, "precision", None)
+        if p_prec is not None:
+            cells.append((a_d.shape[1], b_d.shape[2], a_d.shape[2],
+                          dt_name))
+            if prec is None or (
+                _costmodel.effective_epsilon(*p_prec)
+                > _costmodel.effective_epsilon(*prec)
+            ):
+                prec = p_prec
+            if plan.driver == "xla_group":
+                # merged contraction length (see check_stack)
+                k_max = max(k_max,
+                            a_d.shape[2] * max(plan.r_grp, 1))
         ai, bi, ci = src
         p = p + _span_probe(
             a_d, b_d,
@@ -422,7 +500,7 @@ def check_superstack(base, out, a_datas, b_datas, splan, alpha,
     es_dev = _compare_err(r, p, out_scale)
     meta = dict(dtype=out.dtype, k=k_max, depth=depth * max(m, n),
                 driver="fused", shape=(m, n, len(splan.plans)),
-                site="superstack")
+                site="superstack", prec=prec, cells=cells or None)
     if defer:
         _pending_list().append((es_dev, meta, shape_key))
         return

@@ -8,7 +8,14 @@ role the reference's per-GPU JSON plays for `libsmm_acc_process`
 (`libsmm_acc.cpp:227-249` parameter lookup on kernel-cache miss).
 
 Schema per entry: {"m", "n", "k", "dtype", "stack_size",
-"driver": "pallas"|"xla"|..., "grouping", "gflops"}.  Rows are keyed by
+"driver": "pallas"|"xla"|..., "grouping", "gflops", and optionally
+"precision": "native"|"f32"|"f32c"|"bf16"|"bf16c" — the per-cell
+compute-dtype column `acc.precision.resolve` consults in adaptive
+mode ("native" pins the cell to full precision, "f32"/"bf16" name the
+demoted compute dtype with a trailing "c" selecting the two-product-
+compensated kernel — the tuner ranks compensated and uncompensated as
+separate candidates, so the column carries which one won; absent =
+the platform default policy)}.  Rows are keyed by
 (m, n, k, dtype, stack_size): the same shape tuned at S=30k and S=800k
 keeps BOTH rows (through the tunnel, small-stack timings are
 latency-bound and would otherwise clobber production-scale rows —

@@ -1,0 +1,373 @@
+"""Mixed-precision planner: demoted compute dtypes, certified by ABFT.
+
+The engine historically executed every stack at the request dtype, so
+f64 workloads ran as slow multi-pass emulation on hardware whose
+bf16/f32 peak sat idle.  This module opens a precision axis on the
+stack engine: a stack may execute with its A/B inputs DEMOTED to a
+narrower compute dtype (f32 or bf16) while accumulating in the wide
+dtype (`acc.smm._accum_dtype`), optionally with two-product
+compensation (hi/lo operand splits that restore every cross term, so
+the dropped error is O(eps_compute²) instead of O(eps_compute)).
+
+**Why this is safe here and nowhere else:** the PR 10 integrity plane
+probes every launch (`acc.abft`), so a demoted launch carries a
+quantitative per-product error certificate.  The planner closes the
+loop: a probe residual breaching its demotion ceiling
+(`obs.costmodel.demoted_abft_tolerance`) PROMOTES the (m, n, k, dtype)
+cell back to native compute — the launch re-executes natively, and
+every later plan for the cell resolves native.  Iterative ops chains
+(purify/sign/invsqrt) additionally open a `chain_scope`, which
+promotes the whole chain once its convergence measure tightens past
+the demoted error floor — the per-iteration precision schedule is
+published on the event bus (``precision_schedule``) and sampled into
+the time-series store, so ``doctor --trend`` can show which cells run
+demoted.
+
+**The knob** (``DBCSR_TPU_PRECISION``, `core.config.precision`):
+
+* ``native`` — no demotion (default; the planner resolves to None
+  everywhere and the engine is byte-identical to the historical one).
+* ``adaptive`` — demote eligible stacks per the policy below, gated on
+  the ABFT plane being armed (no certificate, no demotion) and on the
+  cell/chain state.
+* ``f32`` / ``bf16`` — force the demoted compute dtype with
+  compensation, no certification requirement (bench/test legs).
+
+**Default adaptive policy** (`default_spec`): f64 demotes to f32 —
+compensated where f64 is emulated anyway (TPU: the split passes are
+already being paid, compensation buys accuracy nearly free), plain
+f32 inputs with f64 accumulation elsewhere (the narrower dtype IS the
+saving; the probe certifies it).  f32 demotes to bf16 (f32
+accumulation) on TPU only, where the MXU's bf16 peak is ~4x f32.
+Complex dtypes never demote.  A ``precision`` column in the tuned
+parameter table (`acc.params`) overrides the default per cell.
+
+Specs are ``(compute_dtype_name, compensated)`` tuples — hashable, so
+they ride jit static args and plan-cache keys directly; ``None`` means
+native.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import numpy as np
+
+from dbcsr_tpu.core.config import get_config
+from dbcsr_tpu.obs import costmodel as _costmodel
+from dbcsr_tpu.obs import events as _events
+from dbcsr_tpu.obs import metrics as _metrics
+
+_lock = threading.Lock()
+
+# (m, n, k, dtype_name) -> {"state": "demoted"|"promoted",
+#                           "last_rel_err": float, "launches": int}
+_cells: dict = {}
+# bumped on ANY state change (cell promotion, chain-scope transition):
+# the mm plan cache keys on it, so a promotion can never be served a
+# stale demoted plan
+_generation = 0
+
+_tls = threading.local()
+
+
+def _bump() -> None:
+    global _generation
+    _generation += 1
+
+
+def generation() -> int:
+    return _generation
+
+
+def _scopes() -> list:
+    st = getattr(_tls, "scopes", None)
+    if st is None:
+        st = _tls.scopes = []
+    return st
+
+
+def plan_token() -> tuple:
+    """The precision state a cached stack plan depends on: config mode,
+    the global adaptive generation, and the innermost chain scope's
+    current demand — included in `mm.multiply`'s plan-cache key so any
+    promotion invalidates the affected cached plans."""
+    st = _scopes()
+    # an INACTIVE scope (native config, non-demotable dtype) must not
+    # perturb the token — native mode stays byte-identical, including
+    # its plan-cache hits
+    return (get_config().precision, _generation,
+            st[-1].mode if st and st[-1].active else None)
+
+
+# ------------------------------------------------------------- policy
+
+def _abft_on() -> bool:
+    from dbcsr_tpu.acc import abft as _abft
+
+    return _abft.enabled()
+
+
+def _on_tpu() -> bool:
+    from dbcsr_tpu.acc.smm import _on_tpu as smm_on_tpu
+
+    return smm_on_tpu()
+
+
+def default_spec(dtype) -> Optional[tuple]:
+    """The adaptive policy's demotion target for a request dtype, or
+    None when the dtype is ineligible (complex, already narrowest)."""
+    d = np.dtype(dtype)
+    if d == np.float64:
+        # where f64 is EMULATED the multi-pass cost is already paid and
+        # compensation is nearly free accuracy; where it is native the
+        # demotion IS the saving, so skip the extra compensation dots
+        # and let the probe certify the plain-f32 error
+        from dbcsr_tpu.acc.smm import emulated_dtype_on_tpu
+
+        return ("float32", bool(emulated_dtype_on_tpu(d)))
+    if d == np.float32 and _on_tpu():
+        return ("bfloat16", False)
+    return None
+
+
+def _forced_spec(mode: str, dtype) -> Optional[tuple]:
+    d = np.dtype(dtype)
+    if np.issubdtype(d, np.complexfloating):
+        return None
+    if mode == "f32":
+        return ("float32", True) if d == np.float64 else None
+    if mode == "bf16":
+        if d == np.float64:
+            return ("bfloat16", True)
+        if d == np.float32:
+            return ("bfloat16", True)
+    return None
+
+
+def forced() -> bool:
+    """True under the FORCED bench/test modes (``f32``/``bf16``),
+    which override even a tuned host-driver row; adaptive mode defers
+    to measured driver evidence."""
+    return get_config().precision in ("f32", "bf16")
+
+
+def resolve(m: int, n: int, k: int, dtype,
+            tuned: Optional[dict] = None) -> Optional[tuple]:
+    """The compute spec one stack plan should execute with, or None for
+    native.  Consulted by `acc.smm._prepare_stack_impl`; the decision
+    order is config force > chain-scope demand > params-table
+    ``precision`` column > adaptive cell state > default policy."""
+    mode = get_config().precision
+    if mode == "native":
+        return None
+    d = np.dtype(dtype)
+    if np.issubdtype(d, np.complexfloating):
+        return None
+    if mode in ("f32", "bf16"):
+        return _forced_spec(mode, d)
+    # adaptive: no certificate, no demotion
+    if not _abft_on():
+        return None
+    st = _scopes()
+    if st and st[-1].mode == "native":
+        return None
+    cell = (int(m), int(n), int(k), d.name)
+    info = _cells.get(cell)
+    if info is not None and info["state"] == "promoted":
+        return None
+    if tuned and tuned.get("precision"):
+        col = str(tuned["precision"])
+        if col == "native":
+            return None
+        spec = column_spec(col, d)
+        if spec is not None:
+            return spec
+    return default_spec(d)
+
+
+# column value -> (compute dtype, its byte width); a trailing "c"
+# selects the two-product-compensated kernel — the column must carry
+# the compensation bit, because the tuner ranks the compensated and
+# uncompensated variants as SEPARATE candidates (they differ ~3x in
+# dot count) and dispatch must run exactly the one that won
+_COLUMN_COMPUTE = {"f32": ("float32", 4), "bf16": ("bfloat16", 2)}
+
+
+def column_spec(col: str, dtype) -> Optional[tuple]:
+    """Parse a params-table ``precision`` column value ("f32"/"bf16",
+    optionally suffixed "c" for compensated) into a spec — None when
+    the value is unknown or would not narrow the request dtype."""
+    comp = col.endswith("c")
+    entry = _COLUMN_COMPUTE.get(col[:-1] if comp else col)
+    if entry is None:
+        return None
+    compute, width = entry
+    if width >= np.dtype(dtype).itemsize:
+        return None
+    return (compute, comp)
+
+
+# --------------------------------------------------- adaptive feedback
+
+def note_launch(requested: str, spec: tuple) -> None:
+    """Count one demoted launch (per driver dispatch, xla family)."""
+    _metrics.counter(
+        "dbcsr_tpu_precision_launches_total",
+        "stack launches executed at a demoted compute dtype, by "
+        "requested/compute dtype and compensation",
+    ).inc(requested=str(requested), compute=spec[0],
+          compensated=str(bool(spec[1])).lower())
+
+
+def note_probe_ok(cells, rel_err: float) -> None:
+    """Feedback from a passing ABFT probe of a demoted launch: keep the
+    last AND worst residual per cell (doctor headroom / the bench's
+    evidence rows)."""
+    if not cells:
+        return
+    with _lock:
+        for cell in cells:
+            info = _cells.setdefault(
+                cell, {"state": "demoted", "last_rel_err": 0.0,
+                       "max_rel_err": 0.0, "launches": 0})
+            info["last_rel_err"] = float(rel_err)
+            info["max_rel_err"] = max(info.get("max_rel_err", 0.0),
+                                      float(rel_err))
+            info["launches"] += 1
+
+
+def note_exceeded(cells, rel_err: float, ceiling: float) -> None:
+    """A demoted launch's probe residual breached its demotion ceiling:
+    promote every involved cell back to native compute (sticky for the
+    process; the chain scopes and plan-cache generation pick it up
+    immediately) and publish the schedule transition."""
+    # a NaN probe scalar classifies as exceeded upstream: keep the
+    # stored residuals (and the published events) finite-only so the
+    # JSONL sinks stay strict-JSON and the gauges stay plottable
+    rel = float(rel_err) if np.isfinite(rel_err) else None
+    promoted = []
+    with _lock:
+        for cell in cells or ():
+            info = _cells.setdefault(
+                cell, {"state": "demoted", "last_rel_err": 0.0,
+                       "max_rel_err": 0.0, "launches": 0})
+            if rel is not None:
+                info["last_rel_err"] = rel
+                info["max_rel_err"] = max(info.get("max_rel_err", 0.0),
+                                          rel)
+            if info["state"] != "promoted":
+                info["state"] = "promoted"
+                promoted.append(cell)
+        if promoted:
+            _bump()
+    for cell in promoted:
+        m, n, k, dt = cell
+        _metrics.counter(
+            "dbcsr_tpu_precision_promotions_total",
+            "(m,n,k,dtype) cells promoted back to native compute after "
+            "a probe residual breached its demotion ceiling",
+        ).inc(dtype=dt)
+        _events.publish(
+            "precision_promote",
+            {"mnk": f"{m}x{n}x{k}", "dtype": dt,
+             "rel_err": rel, "ceiling": float(ceiling),
+             "why": "probe-ceiling"},
+            flight=True,
+        )
+
+
+def cells_snapshot() -> dict:
+    """{(m, n, k, dtype): {state, last_rel_err, launches}} — read by
+    the time-series collector and `tools/doctor.py`."""
+    with _lock:
+        return {cell: dict(info) for cell, info in _cells.items()}
+
+
+def reset() -> None:
+    """Drop adaptive state and chain scopes (tests)."""
+    with _lock:
+        _cells.clear()
+    _tls.scopes = []
+    _bump()
+
+
+# -------------------------------------------------------- chain scopes
+
+class ChainScope:
+    """Per-chain precision schedule: while ``mode == "demoted"`` the
+    planner may demote stacks issued inside the scope; `observe` flips
+    the scope to native once the chain's convergence measure drops
+    below the demoted error floor (further demoted iterations could
+    not make progress past it), publishing one ``precision_schedule``
+    event per observed iteration."""
+
+    __slots__ = ("name", "mode", "active", "floor", "step", "spec")
+
+    def __init__(self, name: str, dtype=None, scale: float = 1.0,
+                 promote_below: Optional[float] = None):
+        self.name = name
+        self.step = 0
+        cfg_mode = get_config().precision
+        self.spec = None
+        if cfg_mode == "adaptive" and _abft_on() and dtype is not None:
+            self.spec = default_spec(dtype)
+        self.active = self.spec is not None
+        self.mode = "demoted" if self.active else "native"
+        if promote_below is not None:
+            self.floor = float(promote_below)
+        elif self.spec is not None:
+            # the demoted scheme injects ~eps_eff relative error per
+            # product; once the convergence measure is within 64x that
+            # floor (scaled to the chain's measure), demotion stalls
+            # the iteration — promote
+            self.floor = 64.0 * _costmodel.effective_epsilon(
+                *self.spec) * float(scale)
+        else:
+            self.floor = 0.0
+
+    def observe(self, delta: float) -> None:
+        """Record one iteration's convergence measure; may promote."""
+        if not self.active:
+            return
+        self.step += 1
+        finite = bool(np.isfinite(delta))
+        promote = (self.mode == "demoted" and finite
+                   and abs(float(delta)) <= self.floor)
+        if promote:
+            self.mode = "native"
+            _bump()
+        _events.publish(
+            "precision_schedule",
+            {"chain": self.name, "step": self.step,
+             "precision": self.mode,
+             # null, not Infinity/NaN: the event sink's JSONL must
+             # stay strict JSON (a chain's first iteration has no
+             # previous iterate to diff against)
+             "delta": float(delta) if finite else None,
+             "floor": float(self.floor),
+             **({"promoted": True} if promote else {})},
+        )
+
+
+@contextlib.contextmanager
+def chain_scope(name: str, dtype=None, scale: float = 1.0,
+                promote_below: Optional[float] = None):
+    """Open a chain precision scope around an iterative workload
+    (purify/sign/invsqrt).  Inert (zero events, native resolution)
+    unless the adaptive mode is armed and the dtype is demotable."""
+    scope = ChainScope(name, dtype=dtype, scale=scale,
+                       promote_below=promote_below)
+    _scopes().append(scope)
+    if scope.active:
+        _bump()  # entering/leaving a demotable scope re-keys plans
+    try:
+        yield scope
+    finally:
+        st = _scopes()
+        if st and st[-1] is scope:
+            st.pop()
+        if scope.active:
+            _bump()

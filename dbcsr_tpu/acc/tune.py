@@ -144,6 +144,35 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
     candidates.append({"driver": "xla_flat", "grouping": None, "gflops": flops / t / 1e9})
     out(f"  xla_flat: {flops / t / 1e9:.1f} GFLOP/s")
 
+    # demoted-precision candidates (acc.precision specs on the xla
+    # driver): a winner stamps the table's "precision" column, which
+    # adaptive dispatch consults per (m,n,k,dtype) cell — runtime
+    # certification stays with the ABFT probes, the tuner only ranks
+    # throughput
+    prec_specs = []
+    if np.dtype(dtype) == np.float64:
+        prec_specs = [("f32c", ("float32", True)),
+                      ("f32", ("float32", False))]
+    elif np.dtype(dtype) == np.float32:
+        prec_specs = [("bf16", ("bfloat16", False))]
+    for col, spec in prec_specs:
+        def run_xla_prec(spec=spec):
+            return _process_stack_xla(
+                jnp.zeros((nc, m, n), dtype), a, b, *xla_args,
+                jnp.asarray(1.0, dtype), prec=spec,
+            )
+
+        tag = f"xla {col}{'+comp' if spec[1] else ''}"
+        try:
+            t = _time_config(run_xla_prec, nrep)
+        except Exception as exc:
+            out(f"  {tag}: failed ({type(exc).__name__})")
+            continue
+        candidates.append({"driver": "xla", "grouping": None,
+                           "precision": col,
+                           "gflops": flops / t / 1e9})
+        out(f"  {tag}: {flops / t / 1e9:.1f} GFLOP/s")
+
     # native host stack driver (CPU backends; the reference's tuned CPU
     # SMM library is likewise a per-shape dispatch candidate,
     # dbcsr_mm_hostdrv.F:90) — auto dispatch takes a tuned "host" row

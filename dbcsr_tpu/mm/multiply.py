@@ -279,6 +279,7 @@ def _multiply_body(a, b, c, alpha, beta, retain_sparsity, filter_eps,
     plan_key = None
     if filter_eps is None or mempool.enabled():
         from dbcsr_tpu.acc import params as params_mod
+        from dbcsr_tpu.acc import precision as precision_mod
         from dbcsr_tpu.core.config import get_config as _cfg
 
         cfg_ = _cfg()
@@ -293,6 +294,10 @@ def _multiply_body(a, b, c, alpha, beta, retain_sparsity, filter_eps,
              cfg_.mm_stack_size, cfg_.max_kernel_dim,
              cfg_.validate_kernels),
             params_mod._table_gen,
+            # executed-precision state: an adaptive promotion or a
+            # chain-scope transition must never be served a cached
+            # demoted plan (acc.precision bumps its generation on both)
+            precision_mod.plan_token(),
         )
         if filter_eps is not None:
             import hashlib
@@ -1441,6 +1446,7 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
     from dbcsr_tpu.acc.smm import (
         execute_stack,
         execute_superstack,
+        plan_exec_dtype,
         prepare_stack,
         prepare_superstack,
     )
@@ -1595,7 +1601,9 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
                             nseg=(nseg if (gi == 0 or not was_fused)
                                   else 0),
                             itemsize=itemsize),
-                        dtype=dt_name, sync=sync,
+                        # EXECUTED compute dtype (demoted launches must
+                        # not roofline against the request dtype's peak)
+                        dtype=plan_exec_dtype(plan, dt_name), sync=sync,
                     )
                     flops += span_flops[gi]
                 i = j
@@ -1617,7 +1625,7 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
                     nbytes=_costmodel.stack_bytes(
                         m, n, k, cnt, nseg=out.shape[0],
                         itemsize=itemsize),
-                    dtype=dt_name, sync=sync,
+                    dtype=plan_exec_dtype(plan, dt_name), sync=sync,
                 )
                 flops += 2 * m * n * k * cnt
             i = j
@@ -1644,9 +1652,17 @@ def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
         except _abft.AbftMismatchError as exc:
             from dbcsr_tpu.acc import smm as _smm
 
-            _smm.note_deferred_sdc(exc)
-            recovered_from = getattr(exc, "mismatch_drivers", None) \
-                or [getattr(exc, "driver", "?")]
+            if isinstance(exc, _abft.PrecisionExceededError):
+                # adaptive-precision promote, not SDC: the cells were
+                # promoted when the flush evaluated the probe; the redo
+                # below re-executes with immediate verification, where
+                # each still-demoted plan heals itself to native — no
+                # breaker feed, no recovery attribution
+                recovered_from = None
+            else:
+                _smm.note_deferred_sdc(exc)
+                recovered_from = getattr(exc, "mismatch_drivers", None) \
+                    or [getattr(exc, "driver", "?")]
             # roll every bin back to its pristine (all-zero) pre-run
             # state and redo the product with immediate verification
             for bin_ in c.bins:

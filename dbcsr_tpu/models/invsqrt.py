@@ -93,7 +93,15 @@ def invsqrt_iteration(
     # safe engine instead of iterating on a corrupted pair
     guard = _integrity.guard_enabled()
     prev_res = None
-    with mempool.chain() as ch:
+    # adaptive-precision chain scope: demoted coupled-NS steps promote
+    # to native once the residual tightens past the demoted error
+    # floor (see models/purify.py)
+    from dbcsr_tpu.acc import precision as _precision
+
+    with mempool.chain() as ch, _precision.chain_scope(
+            "invsqrt", dtype=s.dtype,
+            scale=float(max(s.nfullrows, 1)) ** 0.5,
+    ) as psc:
         ch.adopt(y)
         ch.adopt(z)
         ny = frobenius_norm(y) if guard else None
@@ -143,6 +151,7 @@ def invsqrt_iteration(
                 r = _integrity.recompute_step(
                     ch, _build_r, _validate_r, "invsqrt", it, "residual")
                 res = seen["res"]
+            psc.observe(res)
             if res < tol:
                 ch.detach(z)
                 return z, sf, it

@@ -13,6 +13,7 @@ from typing import Optional
 
 import numpy as np
 
+from dbcsr_tpu.acc import precision as _precision
 from dbcsr_tpu.core import mempool
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
 from dbcsr_tpu.mm.multiply import multiply
@@ -102,7 +103,15 @@ def mcweeny_purify(
     convergence."""
     guard = _integrity.guard_enabled()
     history = []
-    with mempool.chain() as ch:
+    # adaptive-precision chain scope (acc.precision; inert unless the
+    # adaptive mode + ABFT are armed): early iterations may run their
+    # multiplies at a demoted compute dtype; once the trace-delta
+    # convergence measure tightens past the demoted error floor the
+    # scope promotes the remaining iterations to native — the
+    # per-iteration schedule lands on the event bus
+    with mempool.chain() as ch, _precision.chain_scope(
+            "purify", dtype=p.dtype, scale=float(max(p.nfullrows, 1)),
+    ) as psc:
         cur = p
         cur_norm = frobenius_norm(cur) if guard else None
         for step_i in range(steps):
@@ -142,6 +151,8 @@ def mcweeny_purify(
             cur = new
             # the guarded invariant already paid trace(new): reuse it
             history.append(trace(cur) if tr_new is None else tr_new)
+            psc.observe(abs(history[-1] - history[-2])
+                        if len(history) > 1 else float("inf"))
             if tol is not None and len(history) > 1:
                 if abs(history[-1] - history[-2]) < tol:
                     break

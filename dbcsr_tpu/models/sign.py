@@ -13,6 +13,7 @@ from __future__ import annotations
 import math
 from typing import Optional
 
+from dbcsr_tpu.acc import precision as _precision
 from dbcsr_tpu.core import mempool
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
 from dbcsr_tpu.mm.multiply import multiply
@@ -75,7 +76,13 @@ def sign_iteration(
     # recompute on the safe engine on violation
     guard = _integrity.guard_enabled()
     history = []
-    with mempool.chain() as ch:
+    # adaptive-precision chain scope: demoted Newton–Schulz steps
+    # promote to native once ||X_k - X_{k-1}||_F tightens past the
+    # demoted error floor (see models/purify.py)
+    with mempool.chain() as ch, _precision.chain_scope(
+            "sign", dtype=a.dtype,
+            scale=float(max(a.nfullrows, 1)) ** 0.5,
+    ) as psc:
         x_norm = frobenius_norm(x) if guard else None
         for step_i in range(steps):
             snap = ch.snapshot(x) if guard else None
@@ -117,6 +124,7 @@ def sign_iteration(
                     metric, nn = seen["metric"], seen["nn"]
                 x_norm = nn
             history.append(metric)
+            psc.observe(metric)
             ch.retire(diff)
             if x is not x0:
                 ch.retire(x)

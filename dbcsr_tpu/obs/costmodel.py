@@ -101,6 +101,31 @@ _ACC_EPS = {
 }
 
 
+# machine epsilon of each COMPUTE dtype's own representation (the
+# input-rounding term of a demoted or reduced-precision kernel) — the
+# companion of _ACC_EPS, which maps bf16 to its f32 ACCUMULATION
+# epsilon instead.  Stdlib-only like everything in this module.
+_COMPUTE_EPS = {
+    "float64": 2.220446049250313e-16,
+    "complex128": 2.220446049250313e-16,
+    "float32": 1.1920929e-07,
+    "complex64": 1.1920929e-07,
+    "bfloat16": 2.0 ** -8,
+    "float16": 2.0 ** -11,
+}
+
+
+def effective_epsilon(compute: str, compensated: bool) -> float:
+    """Effective per-product relative rounding of a DEMOTED compute
+    scheme: the compute dtype's own epsilon, or — under two-product
+    compensation (the hi/lo split of `acc.smm`, which restores every
+    cross term and drops only lo·lo plus the split residue) — its
+    square, with a x4 margin for the three extra roundings the
+    compensated recombination performs."""
+    eps = _COMPUTE_EPS.get(str(compute), 2.0 ** -8)
+    return 4.0 * eps * eps if compensated else eps
+
+
 def abft_tolerance(dtype: str, k: int, depth: int) -> float:
     """Relative tolerance of an ABFT probe-checksum comparison: the
     rank-1 probe ``C·v`` vs ``A·(B·v)`` evaluates the same bilinear
@@ -115,6 +140,52 @@ def abft_tolerance(dtype: str, k: int, depth: int) -> float:
     k = max(int(k), 1)
     depth = max(int(depth), 1)
     return 64.0 * eps * (k + 1) * float(depth + 1) ** 0.5
+
+
+def demoted_abft_tolerance(dtype: str, compute: str, compensated: bool,
+                           k: int, depth: int) -> float:
+    """Probe ceiling of a launch executed at a DEMOTED compute dtype:
+    the per-product demotion error is relative to each product term,
+    and the probe's comparison scale already bounds the sum of |terms|
+    (the S_c scale of the beta==0 probe form, the max-|p| scale of the
+    delta form), so the demotion term is the effective compute epsilon
+    times the same x64 engineering margin as the native tolerance —
+    the (k, depth) reduction factors are NOT re-applied to it (they
+    are absorbed by the scale).  EXCEPT: the uncompensated kernel
+    accumulates INSIDE the dot at the compute family's natural narrow
+    accumulator (`acc.smm._batch_dot`), and a ``k``-deep narrow sum
+    legitimately contributes up to ~k*eps_acc relative to sum|terms| —
+    callers pass the MERGED contraction length (r0*k for the k-merged
+    xla_group layout), or the ceiling would condemn healthy grouped
+    launches.  The native accumulation tolerance floors the result (a
+    demoted launch can never be held to a tighter bound than a native
+    one)."""
+    tol = 64.0 * effective_epsilon(compute, compensated)
+    if not compensated:
+        acc_eps = _ACC_EPS.get(str(compute), 1.1920929e-07)
+        tol += 8.0 * acc_eps * max(int(k), 1)
+    return max(tol, abft_tolerance(dtype, k, depth))
+
+
+def kernel_validation_tolerance(dtype: str, k: int, depth: int) -> float:
+    """Relative tolerance of a kernel-vs-host-oracle ELEMENTWISE-max
+    validation (the first-use Pallas gate in
+    `acc.smm._validate_pallas_kernel` and its test-suite mirrors): an
+    accumulation term ~eps_acc*sqrt((k+1)*(depth+1)) for the k-deep
+    dot times depth-deep segment sum, plus an input-rounding term for
+    dtypes whose own epsilon exceeds their accumulation epsilon (bf16
+    inputs round at 2^-8 while accumulating in f32) — one dtype-aware
+    source of truth replacing the historical `5e-2 if bf16 else 1e-5`
+    literals.  Deliberately NOT `abft_tolerance`: that bound carries
+    the probe comparison's x64 margin and scale-absorption reasoning,
+    which would loosen this elementwise gate ~100x and let a subtly
+    miscompiled kernel through first-use validation."""
+    eps_acc = _ACC_EPS.get(str(dtype), 1.1920929e-07)
+    eps_in = _COMPUTE_EPS.get(str(dtype), eps_acc)
+    k = max(int(k), 1)
+    depth = max(int(depth), 1)
+    return max(2.0 * eps_acc * float((k + 1) * (depth + 1)) ** 0.5,
+               4.0 * eps_in * float(k + 1) ** 0.5)
 
 
 # ------------------------------------------------------- roofline table

@@ -404,8 +404,37 @@ def _collect_health() -> list:
     return pts
 
 
+def _collect_precision() -> list:
+    """Executed-precision plane (acc.precision): per-(m,n,k,dtype)
+    adaptive cell state (1 = running demoted, 0 = promoted back to
+    native), the cell's last probe residual (demotion headroom), and
+    the demoted-launch / promotion counters — `doctor --trend` renders
+    these next to the `dbcsr_tpu_cell_flops_total` cells, whose dtype
+    label records the EXECUTED compute dtype."""
+    import sys
+
+    pts: list = []
+    from dbcsr_tpu.obs import metrics
+
+    for name in ("dbcsr_tpu_precision_launches_total",
+                 "dbcsr_tpu_precision_promotions_total"):
+        for labels, v in metrics.counter_items(name):
+            pts.append((name, labels, v, COUNTER))
+    prec = sys.modules.get("dbcsr_tpu.acc.precision")
+    if prec is None:
+        return pts  # planner never imported: nothing ever demoted
+    for (m, n, k, dt), info in prec.cells_snapshot().items():
+        labels = {"mnk": f"{m}x{n}x{k}", "dtype": dt}
+        pts.append(("dbcsr_tpu_precision_cell_demoted", labels,
+                    0.0 if info["state"] == "promoted" else 1.0, GAUGE))
+        pts.append(("dbcsr_tpu_precision_cell_rel_err", labels,
+                    info["last_rel_err"], GAUGE))
+    return pts
+
+
 _COLLECTORS = (_collect_engine, _collect_serve, _collect_breakers,
-               _collect_pool, _collect_integrity, _collect_health)
+               _collect_pool, _collect_integrity, _collect_precision,
+               _collect_health)
 
 
 # ------------------------------------------------------------ sampling

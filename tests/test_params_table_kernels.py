@@ -65,7 +65,11 @@ def test_tuned_row_drives_correct_kernel(row, tmp_path, monkeypatch):
         a = jnp.asarray(rng.standard_normal((na, m, k)), jnp.bfloat16)
         b = jnp.asarray(rng.standard_normal((nb, k, n)), jnp.bfloat16)
         c = jnp.zeros((nc, m, n), jnp.bfloat16)
-        tol = 5e-2
+        # dtype-aware oracle tolerance — the shared source of truth
+        # (obs.costmodel) the runtime validation gate also uses
+        from dbcsr_tpu.obs import costmodel
+
+        tol = costmodel.kernel_validation_tolerance("bfloat16", k, 160)
     else:
         cplx = np.issubdtype(dtype, np.complexfloating)
         a = rng.standard_normal((na, m, k))

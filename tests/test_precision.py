@@ -1,0 +1,379 @@
+"""Mixed-precision plane: planner policy, compensated-accumulation
+error bounds, ABFT-certified adaptive demotion, the promote loop, and
+the ops-chain precision schedule (ISSUE 12)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dbcsr_tpu.acc import precision as precision_mod
+from dbcsr_tpu.acc import smm
+from dbcsr_tpu.core.config import get_config, set_config
+from dbcsr_tpu.core.matrix import BlockSparseMatrix
+from dbcsr_tpu.mm.multiply import multiply
+from dbcsr_tpu.obs import costmodel
+from dbcsr_tpu.obs import events as obs_events
+from dbcsr_tpu.ops.test_methods import make_random_matrix, to_dense
+
+
+@pytest.fixture(autouse=True)
+def _restore_precision_config(monkeypatch, tmp_path):
+    # empty params table: the committed cpu table routes these shapes
+    # to the tuned native host driver, which adaptive demotion
+    # deliberately never preempts — the engine-level tests here
+    # exercise the XLA-family demotion path
+    monkeypatch.setenv("DBCSR_TPU_PARAMS_DIR", str(tmp_path))
+    precision_mod.reset()
+    yield
+    set_config(precision="native", abft="off", mm_driver="auto")
+    precision_mod.reset()
+
+
+def _random_stack(rng, na, nb, nc, s, m, n, k):
+    a = rng.standard_normal((na, m, k))
+    b = rng.standard_normal((nb, k, n))
+    ai = rng.integers(0, na, s).astype(np.int32)
+    bi = rng.integers(0, nb, s).astype(np.int32)
+    ci = np.sort(rng.integers(0, nc, s)).astype(np.int32)
+    return a, b, ai, bi, ci
+
+
+def _stack_oracle(a, b, ai, bi, ci, nc):
+    """(f64 reference, Σ|terms| scale) of one zeroed-C stack."""
+    ref = np.zeros((nc,) + (a.shape[1], b.shape[2]))
+    np.add.at(ref, ci, np.einsum("smk,skn->smn", a[ai], b[bi]))
+    scale = np.zeros_like(ref)
+    np.add.at(scale, ci,
+              np.einsum("smk,skn->smn", np.abs(a[ai]), np.abs(b[bi])))
+    return ref, max(float(scale.max()), 1e-30)
+
+
+# -------------------------------------------------- error-bound fuzzing
+
+@pytest.mark.parametrize("spec", [
+    ("float32", True), ("float32", False),
+    ("bfloat16", True), ("bfloat16", False),
+])
+def test_demoted_stack_error_within_ceiling_fuzzed(spec):
+    """Property: the demoted(+compensated) stack result's error vs a
+    NumPy f64 reference stays inside the `demoted_abft_tolerance`
+    ceiling across fuzzed (m, n, k) — the runtime certificate and the
+    offline bound agree."""
+    rng = np.random.default_rng(7)
+    for trial in range(6):
+        m, n, k = (int(rng.integers(2, 24)) for _ in range(3))
+        na, nb, nc, s = 12, 11, 8, int(rng.integers(40, 300))
+        a, b, ai, bi, ci = _random_stack(rng, na, nb, nc, s, m, n, k)
+        out = smm._process_stack_xla(
+            jnp.zeros((nc, m, n), jnp.float64),
+            jnp.asarray(a), jnp.asarray(b),
+            jnp.asarray(ai.reshape(1, s)), jnp.asarray(bi.reshape(1, s)),
+            jnp.asarray(ci.reshape(1, s)),
+            jnp.asarray(1.0, jnp.float64), prec=spec,
+        )
+        ref, scale = _stack_oracle(a, b, ai, bi, ci, nc)
+        err = float(np.abs(np.asarray(out) - ref).max()) / scale
+        depth = int(np.bincount(ci).max())
+        tol = costmodel.demoted_abft_tolerance(
+            "float64", spec[0], spec[1], k, depth)
+        assert err <= tol, (spec, m, n, k, err, tol)
+
+
+@pytest.mark.parametrize("spec", [("float32", True), ("float32", False)])
+def test_demoted_stack_cancellation_adversarial(spec):
+    """Adversarial cancellation: paired entries whose products cancel
+    exactly leave a tiny residual — the error must stay bounded by the
+    ceiling RELATIVE TO the Σ|terms| scale (the probe's comparison
+    scale), which is what makes cancellation safe to certify."""
+    rng = np.random.default_rng(13)
+    m = n = k = 9
+    na, nc, pairs = 10, 4, 120
+    a = rng.standard_normal((2 * na, m, k))
+    a[na:] = -a[:na]  # mirrored blocks
+    b = rng.standard_normal((na, k, n))
+    ai = np.empty(2 * pairs, np.int64)
+    base = rng.integers(0, na, pairs)
+    ai[0::2] = base
+    ai[1::2] = base + na  # each pair sums to exactly zero
+    bi = np.repeat(rng.integers(0, na, pairs), 2)
+    ci = np.sort(np.repeat(rng.integers(0, nc, pairs), 2))
+    s = 2 * pairs
+    out = smm._process_stack_xla(
+        jnp.zeros((nc, m, n), jnp.float64),
+        jnp.asarray(a), jnp.asarray(b),
+        jnp.asarray(ai.astype(np.int32).reshape(1, s)),
+        jnp.asarray(bi.astype(np.int32).reshape(1, s)),
+        jnp.asarray(ci.astype(np.int32).reshape(1, s)),
+        jnp.asarray(1.0, jnp.float64), prec=spec,
+    )
+    _, scale = _stack_oracle(a, b, ai, bi, ci, nc)
+    # exact result is 0: everything that remains is demotion rounding
+    err = float(np.abs(np.asarray(out)).max()) / scale
+    depth = int(np.bincount(ci).max())
+    tol = costmodel.demoted_abft_tolerance(
+        "float64", spec[0], spec[1], k, depth)
+    assert err <= tol, (spec, err, tol)
+
+
+def test_compensation_tightens_the_bound():
+    """The two-product split is worth its extra dots: compensated f32
+    lands orders of magnitude closer to the f64 reference."""
+    rng = np.random.default_rng(3)
+    m = n = k = 13
+    a, b, ai, bi, ci = _random_stack(rng, 10, 10, 6, 200, m, n, k)
+
+    def run(spec):
+        out = smm._process_stack_xla(
+            jnp.zeros((6, m, n), jnp.float64),
+            jnp.asarray(a), jnp.asarray(b),
+            jnp.asarray(ai.reshape(1, -1)), jnp.asarray(bi.reshape(1, -1)),
+            jnp.asarray(ci.reshape(1, -1)),
+            jnp.asarray(1.0, jnp.float64), prec=spec,
+        )
+        ref, scale = _stack_oracle(a, b, ai, bi, ci, 6)
+        return float(np.abs(np.asarray(out) - ref).max()) / scale
+
+    assert run(("float32", True)) < run(("float32", False)) / 100.0
+
+
+# --------------------------------------------------------- planner
+
+def test_native_mode_resolves_none():
+    assert get_config().precision == "native"
+    assert precision_mod.resolve(23, 23, 23, np.float64) is None
+
+
+def test_adaptive_requires_abft():
+    set_config(precision="adaptive", abft="off")
+    assert precision_mod.resolve(23, 23, 23, np.float64) is None
+    set_config(abft="verify")
+    assert precision_mod.resolve(23, 23, 23, np.float64) == \
+        ("float32", False)  # CPU: plain f32 inputs, certified
+
+
+def test_forced_modes_and_complex_ineligible():
+    set_config(precision="f32")
+    assert precision_mod.resolve(8, 8, 8, np.float64) == ("float32", True)
+    assert precision_mod.resolve(8, 8, 8, np.float32) is None
+    assert precision_mod.resolve(8, 8, 8, np.complex128) is None
+    set_config(precision="bf16")
+    assert precision_mod.resolve(8, 8, 8, np.float32) == \
+        ("bfloat16", True)
+
+
+def test_platform_seam_policy():
+    """Under the pretend-TPU seam the adaptive policy compensates f64
+    (the emulated passes are already paid) and demotes f32 to bf16."""
+    set_config(precision="adaptive", abft="verify",
+               platform_override="tpu")
+    try:
+        assert precision_mod.resolve(23, 23, 23, np.float64) == \
+            ("float32", True)
+        assert precision_mod.resolve(23, 23, 23, np.float32) == \
+            ("bfloat16", False)
+    finally:
+        set_config(platform_override="")
+
+
+def test_params_precision_column_overrides():
+    set_config(precision="adaptive", abft="verify")
+    assert precision_mod.resolve(
+        9, 9, 9, np.float64, tuned={"precision": "native"}) is None
+    # the column carries the compensation bit: the tuner ranked the
+    # compensated and uncompensated kernels as separate candidates
+    assert precision_mod.resolve(
+        9, 9, 9, np.float64, tuned={"precision": "f32"}) == \
+        ("float32", False)
+    assert precision_mod.resolve(
+        9, 9, 9, np.float64, tuned={"precision": "f32c"}) == \
+        ("float32", True)
+    # a column that would not narrow the request dtype is ignored
+    # (falls through to the default policy: none on CPU for f32)
+    assert precision_mod.resolve(
+        9, 9, 9, np.float32, tuned={"precision": "f32"}) is None
+
+
+def test_promoted_cell_resolves_native_and_bumps_generation():
+    set_config(precision="adaptive", abft="verify")
+    gen0 = precision_mod.generation()
+    cell = (23, 23, 23, "float64")
+    assert precision_mod.resolve(*cell[:3], np.float64) is not None
+    precision_mod.note_exceeded([cell], 1e-3, 1e-6)
+    assert precision_mod.resolve(*cell[:3], np.float64) is None
+    assert precision_mod.generation() > gen0
+    assert precision_mod.cells_snapshot()[cell]["state"] == "promoted"
+
+
+# ------------------------------------------- engine-level certification
+
+def _pair(rng, nblk=6, bs=5, occ=0.6):
+    sizes = [bs] * nblk
+    a = make_random_matrix("A", sizes, sizes, occupation=occ, rng=rng)
+    b = make_random_matrix("B", sizes, sizes, occupation=occ, rng=rng)
+    return a, b
+
+
+def _product(a, b):
+    c = BlockSparseMatrix("C", a.row_blk_sizes, b.col_blk_sizes,
+                          a.dtype, a.dist)
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    return to_dense(c)
+
+
+def test_adaptive_multiply_certified_and_recorded():
+    """Adaptive demotion through the whole engine: result within the
+    demotion ceiling of the native one, probes all passed, the
+    executed dtype lands in the stats rollup (roofline scores the
+    demoted launches against the f32 peak, not the f64 one)."""
+    from dbcsr_tpu.core import stats
+
+    rng = np.random.default_rng(21)
+    a, b = _pair(rng)
+    ref = _product(a, b)
+
+    set_config(precision="adaptive", abft="verify")
+    stats.reset()
+    got = _product(a, b)
+    err = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-30)
+    assert err < costmodel.demoted_abft_tolerance(
+        "float64", "float32", False, 5, 8)
+    cells = precision_mod.cells_snapshot()
+    assert cells and all(i["state"] == "demoted" for i in cells.values())
+    assert all(i["last_rel_err"] >= 0 for i in cells.values())
+    rollup = stats.driver_rollup()
+    by_dtype = {}
+    for agg in rollup.values():
+        for dt, fl in agg["by_dtype"].items():
+            by_dtype[dt] = by_dtype.get(dt, 0) + fl
+    assert by_dtype.get("float32", 0) > 0
+    assert by_dtype.get("float64", 0) == 0
+
+
+def test_probe_ceiling_breach_promotes_and_reexecutes(monkeypatch):
+    """The adaptive promote loop: a demoted launch whose probe residual
+    breaches its (here: sabotaged) ceiling promotes the cell, rebuilds
+    the plan natively IN PLACE, and re-executes — the product
+    completes, exactly equal to the native engine's result, and later
+    multiplies resolve native up front."""
+    rng = np.random.default_rng(31)
+    a, b = _pair(rng)
+    ref = _product(a, b)
+
+    set_config(precision="adaptive", abft="verify")
+    real = costmodel.demoted_abft_tolerance
+
+    def tiny(dtype, compute, compensated, k, depth):
+        return 1e-30  # every demoted residual breaches
+
+    monkeypatch.setattr(costmodel, "demoted_abft_tolerance", tiny)
+    got = _product(a, b)
+    monkeypatch.setattr(costmodel, "demoted_abft_tolerance", real)
+    # native re-execution: bitwise equal to the native engine
+    assert np.array_equal(got, ref)
+    cells = precision_mod.cells_snapshot()
+    assert cells and all(i["state"] == "promoted"
+                         for i in cells.values())
+    # the promotion is sticky: the next product resolves native
+    assert precision_mod.resolve(5, 5, 5, np.float64) is None
+    evs = obs_events.records(kind="precision_promote")
+    assert evs and evs[-1]["why"] == "probe-ceiling"
+
+
+def test_adaptive_fused_superstack_mixed_k():
+    """Mixed inner blockings give a C bin several spans -> the fused
+    superstack path carries per-span precision specs; the demoted
+    fused launch stays inside the ceiling."""
+    rng = np.random.default_rng(41)
+    rows = [4] * 6
+    inner = [4, 6] * 3
+    a = make_random_matrix("A", rows, inner, occupation=0.7, rng=rng)
+    b = make_random_matrix("B", inner, rows, occupation=0.7, rng=rng)
+    ref = _product(a, b)
+    set_config(precision="adaptive", abft="verify")
+    got = _product(a, b)
+    err = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-30)
+    assert err < costmodel.demoted_abft_tolerance(
+        "float64", "float32", False, 6, 8)
+    assert precision_mod.cells_snapshot()
+
+
+# --------------------------------------------------- ops-chain schedule
+
+def test_sign_chain_demotes_then_promotes():
+    """Acceptance: an iterative ops chain runs its early iterations
+    demoted and automatically promotes to native as the iterates
+    tighten past the demoted error floor — the per-iteration schedule
+    is on the event bus.  Newton–Schulz sign converges quadratically,
+    so its ||X_k - X_{k-1}||_F measure crosses the floor fast."""
+    from dbcsr_tpu.models.sign import sign_iteration
+
+    set_config(precision="adaptive", abft="verify")
+    obs_events.clear()
+    rng = np.random.default_rng(9)
+    a = make_random_matrix("A", [5] * 6, [5] * 6, occupation=0.6,
+                           matrix_type="S", rng=rng)
+    x, history = sign_iteration(a, steps=60, tol=1e-11)
+    evs = obs_events.records(kind="precision_schedule")
+    assert evs, "no precision_schedule events published"
+    assert evs[0]["precision"] == "demoted"
+    assert evs[-1]["precision"] == "native"
+    assert any(e.get("promoted") for e in evs)
+    # converged despite the demoted opening iterations, and every
+    # post-promote iteration ran (and was scheduled) native
+    assert history[-1] < 1e-11
+    after = [e["precision"] for e in evs
+             if e["step"] > next(e2["step"] for e2 in evs
+                                 if e2.get("promoted"))]
+    assert all(p == "native" for p in after)
+
+
+def test_purify_chain_publishes_schedule():
+    """The purify chain publishes its per-iteration precision schedule
+    (demoted while the trace-delta sits above the floor)."""
+    from dbcsr_tpu.models.purify import make_test_density, mcweeny_purify
+
+    set_config(precision="adaptive", abft="verify")
+    obs_events.clear()
+    p = make_test_density(6, 5, occ=0.4, seed=3)
+    mcweeny_purify(p, steps=4)
+    evs = obs_events.records(kind="precision_schedule")
+    assert evs and evs[0]["precision"] == "demoted"
+    assert all(e["chain"] == "purify" for e in evs)
+
+
+def test_chain_scope_inert_when_native():
+    from dbcsr_tpu.models.purify import make_test_density, mcweeny_purify
+
+    obs_events.clear()
+    p = make_test_density(4, 5, occ=0.4, seed=4)
+    mcweeny_purify(p, steps=3)
+    assert not obs_events.records(kind="precision_schedule")
+
+
+# ------------------------------------------------- obs / tolerance SSoT
+
+def test_timeseries_collects_precision_cells():
+    from dbcsr_tpu.obs import timeseries as ts
+
+    rng = np.random.default_rng(51)
+    a, b = _pair(rng)
+    set_config(precision="adaptive", abft="verify")
+    _product(a, b)
+    pts = ts._collect_precision()
+    metrics = {p[0] for p in pts}
+    assert "dbcsr_tpu_precision_cell_demoted" in metrics
+    assert "dbcsr_tpu_precision_launches_total" in metrics
+    cell_pts = [p for p in pts
+                if p[0] == "dbcsr_tpu_precision_cell_demoted"]
+    assert all(p[2] == 1.0 for p in cell_pts)
+
+
+def test_kernel_validation_tolerance_is_dtype_aware():
+    bf16 = costmodel.kernel_validation_tolerance("bfloat16", 23, 16)
+    f32 = costmodel.kernel_validation_tolerance("float32", 23, 16)
+    f64 = costmodel.kernel_validation_tolerance("float64", 23, 16)
+    assert f64 < f32 < bf16
+    # the bf16 bound must admit legitimate bf16 input rounding
+    # (~eps_bf16 * sqrt(k)) and still reject O(1) corruption
+    assert 1e-2 < bf16 < 0.5

@@ -127,6 +127,8 @@ HINTS = {
 TREND_METRICS = (
     "dbcsr_tpu_roofline_fraction",
     "dbcsr_tpu_cell_flops_total",
+    "dbcsr_tpu_precision_cell_demoted",
+    "dbcsr_tpu_precision_promotions_total",
     "dbcsr_tpu_serve_queue_depth",
     "dbcsr_tpu_serve_latency_p95_ms",
     "dbcsr_tpu_serve_shed_total",
@@ -698,6 +700,26 @@ def render_trend(report: dict, out=print) -> None:
                     if len(pts) > 1 else ""
                 out(f"     {lab:<44} last={pts[-1][1]:<12.6g} "
                     f"n={len(pts):<4} {spark}")
+        # executed-precision occupancy: share of each (m,n,k) cell's
+        # flops by the dtype its launches actually EXECUTED at (the
+        # cell_flops dtype label records the executed compute dtype,
+        # so a demoted cell splits across float64/float32/bfloat16)
+        occ: dict = {}
+        for row in by_metric.get("dbcsr_tpu_cell_flops_total", []):
+            pts = row["points"]
+            if not pts:
+                continue
+            d = occ.setdefault(row["labels"].get("mnk", "?"), {})
+            dt = row["labels"].get("dtype", "?") or "?"
+            d[dt] = d.get(dt, 0.0) + pts[-1][1]
+        if occ:
+            out("   executed-precision occupancy "
+                "(share of cell flops by executed dtype)")
+            for mnk, by_dt in sorted(occ.items()):
+                tot = sum(by_dt.values()) or 1.0
+                share = "  ".join(f"{dt}={v / tot:.0%}"
+                                  for dt, v in sorted(by_dt.items()))
+                out(f"     {mnk:<20} {share}")
     slo = report.get("slo") or {}
     if slo:
         out(" slo burn summary:")

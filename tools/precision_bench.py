@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Precision A/B bench: f64 baseline vs adaptive demotion, certified.
+
+The machine evidence behind the mixed-precision plane (ISSUE 12 /
+ROADMAP item 2): one block-sparse f64 multiply workload timed twice in
+one process —
+
+* ``native`` leg: ``precision=native`` (the historical engine, every
+  stack at the request dtype);
+* ``adaptive`` leg: ``precision=adaptive`` + ``abft=verify`` — eligible
+  stacks execute at the demoted compute dtype the planner resolves
+  (f64 -> f32 with wide accumulation; compensated where f64 is
+  emulated), every launch probe-certified, and the leg records the
+  worst probe residual next to its dtype-aware demotion ceiling so the
+  committed row *proves* the certificates held.
+
+Emits ONE JSON line shaped like the bench.py chain A/B rows: top-level
+``metric``/``value`` (the adaptive leg), ``ab`` legs keyed
+``native``/``adaptive`` that `tools/perf_gate.py` can gate against
+each other, the speedup, the accuracy of the demoted result against
+the native one, and the probe-residual evidence.
+
+Environment: ``DBCSR_TPU_PREC_BENCH_M`` (block-grid rows, default 48),
+``_BS`` (block size, default 23), ``_OCC`` (occupation, default 0.3 —
+below the dense-mode threshold so the stack engine is what's timed),
+``_REPS`` (timed repetitions per leg, default 3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def main() -> int:
+    os.environ.setdefault("DBCSR_TPU_ABFT", "off")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    from dbcsr_tpu import obs as _obs
+    from dbcsr_tpu.acc import precision as precision_mod
+    from dbcsr_tpu.core.config import set_config
+    from dbcsr_tpu.core.matrix import BlockSparseMatrix
+    from dbcsr_tpu.mm import multiply as mm
+    from dbcsr_tpu.obs import costmodel as _costmodel
+    from dbcsr_tpu.ops.test_methods import make_random_matrix, to_dense
+    from dbcsr_tpu.utils.sync import fetch_fence
+
+    nblk = _env_int("DBCSR_TPU_PREC_BENCH_M", 48)
+    bs = _env_int("DBCSR_TPU_PREC_BENCH_BS", 23)
+    reps = _env_int("DBCSR_TPU_PREC_BENCH_REPS", 3)
+    try:
+        occ = float(os.environ.get("DBCSR_TPU_PREC_BENCH_OCC", 0.3))
+    except ValueError:
+        occ = 0.3
+    rng = np.random.default_rng(11)
+    sizes = [bs] * nblk
+    a = make_random_matrix("A", sizes, sizes, occupation=occ, rng=rng)
+    b = make_random_matrix("B", sizes, sizes, occupation=occ, rng=rng)
+
+    # hold the driver constant across legs: the A/B measures the
+    # precision axis on the kernels demotion applies to (the XLA
+    # family), not a driver-selection difference — on CPU device kinds
+    # the auto dispatch would otherwise hand the native leg to the
+    # tuned C++ host driver, which demotion deliberately never preempts
+    set_config(mm_driver="xla")
+
+    def _run_leg(precision: str, abft: str, timed: bool = True):
+        set_config(precision=precision, abft=abft)
+        precision_mod.reset()
+        best, flops = None, 0
+        for _ in range(max(reps, 1) if timed else 1):
+            c = BlockSparseMatrix("C", a.row_blk_sizes, b.col_blk_sizes,
+                                  a.dtype, a.dist)
+            t0 = time.perf_counter()
+            flops = mm.multiply("N", "N", 1.0, a, b, 0.0, c)
+            for bin_ in c.bins:  # forced fetch: dispatch != completion
+                fetch_fence(bin_.data)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        cells = precision_mod.cells_snapshot()
+        worst_rel = max((i.get("max_rel_err", i["last_rel_err"])
+                         for i in cells.values()), default=None)
+        demoted = sorted(
+            f"{m}x{n}x{k}:{d}" for (m, n, k, d), i in cells.items()
+            if i["state"] == "demoted" and i["launches"] > 0)
+        promoted = sorted(
+            f"{m}x{n}x{k}:{d}" for (m, n, k, d), i in cells.items()
+            if i["state"] == "promoted")
+        return {
+            "seconds": round(best, 4),
+            "gflops": round(flops / best / 1e9, 3) if best else 0.0,
+            "flops": int(flops),
+            "worst_probe_rel_err": worst_rel,
+            "demoted_cells": demoted,
+            "promoted_cells": promoted,
+        }, np.asarray(to_dense(c))
+
+    # absorb every compile before either timed leg
+    _run_leg("native", "off", timed=False)
+    _run_leg("adaptive", "verify", timed=False)
+
+    fallback = jax.devices()[0].platform != "tpu"
+    metric = (f"precision_ab GFLOP/s ({nblk * bs}^2 BCSR, {bs}x{bs} "
+              f"blocks, occ={occ}, f64)")
+    stamps = {
+        "unit": "GFLOP/s",
+        "device": str(jax.devices()[0]),
+        "device_fallback": fallback,
+        "device_kind": _costmodel.device_kind(),
+        "jax_version": jax.__version__,
+        "obs_schema": _obs.OBS_SCHEMA_VERSION,
+        "mm_driver": "xla",
+    }
+    legs, denses = {}, {}
+    for name, (prec, abft) in (("native", ("native", "off")),
+                               ("adaptive", ("adaptive", "verify"))):
+        res, dense = _run_leg(prec, abft)
+        denses[name] = dense
+        legs[name] = dict(stamps, metric=metric, value=res["gflops"],
+                          precision=prec, abft=abft, **res)
+    set_config(precision="native", abft="off", mm_driver="auto")
+    spec = ("float32", True)
+    try:
+        dspec = precision_mod.default_spec(np.float64)
+        spec = dspec or spec
+    except Exception:
+        pass
+    # the authoritative ceiling verdict is the RUNTIME enforcement: a
+    # breach promotes the cell in-flight, so "every probe sat inside
+    # its ceiling" is exactly "nothing got promoted and demoted
+    # launches ran".  The nominal ceiling below is context only (the
+    # runtime one additionally widens with the launch's merged k and
+    # segment depth).
+    ceiling = _costmodel.demoted_abft_tolerance(
+        "float64", spec[0], spec[1], bs, 4)
+    a_leg = legs["adaptive"]
+    certified = bool(a_leg["demoted_cells"]
+                     and not a_leg["promoted_cells"])
+    worst = a_leg["worst_probe_rel_err"]
+    nref = float(np.linalg.norm(denses["native"]))
+    acc_rel = (float(np.linalg.norm(denses["adaptive"] - denses["native"]))
+               / nref if nref else 0.0)
+    out = dict(
+        stamps,
+        metric=metric,
+        value=legs["adaptive"]["value"],
+        speedup_adaptive=round(
+            legs["adaptive"]["value"] / legs["native"]["value"], 3)
+        if legs["native"]["value"] else None,
+        accuracy_vs_native_rel=acc_rel,
+        demotion_spec={"compute": spec[0], "compensated": bool(spec[1])},
+        probe_ceiling_nominal=ceiling,
+        worst_probe_rel_err=worst,
+        probes_within_ceiling=certified,
+        ab=legs,
+    )
+    print(json.dumps(out))
+    return 0 if certified else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
