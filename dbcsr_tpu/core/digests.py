@@ -1,0 +1,151 @@
+"""Content digests and identity keys — THE value-keying convention.
+
+Three mechanisms grew up independently keying caches by "the same
+values": the block-norms memo (bin data-array identities), the
+filtered-product candidate-list sha1 (`mm.multiply`), and the serve
+coalescer's pattern-fingerprint tuples.  This module single-sources
+the convention so every value-level cache — the plan cache's filtered
+leg, the delta-aware incremental multiply, and the serve-layer
+content-addressed product cache — keys the same way:
+
+* **Identity keys** (`buffers_key`): jax device arrays are immutable,
+  so ``id(data)`` identifies CONTENT as long as the array is held
+  alive (the holder pins it, so ids cannot recycle).  The cheap
+  convention for caches that live next to the arrays they key.
+* **Content digests** (`digest` / `host_digest` / `index_digest`):
+  sha1 over the raw bytes (+ shape/dtype where aliasing matters) for
+  keys that must survive across objects and processes — candidate
+  lists, pattern fingerprints, value-addressed product keys.
+* **Value digests of matrices** (`bin_value_digest` /
+  `matrix_value_digest`): the per-shape-bin content hash of the LIVE
+  rows (bucket padding excluded — two value-identical matrices may
+  sit in different bucket capacities), memoized twice over: per
+  buffer by identity (immutability) and per matrix by its mutation
+  epoch (`BlockSparseMatrix.mutation_epoch`), so an unchanged matrix
+  re-digests in O(1) however often it is submitted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Tuple
+
+import numpy as np
+
+
+def digest(*chunks: bytes) -> bytes:
+    """sha1 over the concatenated byte chunks (the one hash function
+    every value key in the tree uses)."""
+    h = hashlib.sha1()
+    for c in chunks:
+        h.update(c)
+    return h.digest()
+
+
+def host_digest(arr) -> bytes:
+    """Content digest of one host array, shape/dtype-qualified (two
+    arrays with identical bytes but different shape or dtype must not
+    collide — a (2,3) and a (3,2) int64 view share bytes)."""
+    arr = np.ascontiguousarray(arr)
+    return digest(
+        str(arr.dtype).encode(),
+        np.asarray(arr.shape, np.int64).tobytes(),
+        arr.tobytes(),
+    )
+
+
+def index_digest(*arrays) -> bytes:
+    """Digest of a fixed-arity tuple of host index arrays (candidate
+    lists, key vectors).  Shape-unqualified on purpose: the caller's
+    arity and ordering are part of the call-site contract, exactly the
+    semantics of the historical filtered-product sha1."""
+    h = hashlib.sha1()
+    for a in arrays:
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.digest()
+
+
+def scalar_key(x):
+    """Canonical scalar for cache keys: ``complex`` collapses python
+    floats, numpy scalars, and 0-d arrays of the same value onto one
+    key (the coalesce-key convention, now shared)."""
+    return complex(x)
+
+
+def buffers_key(arrays) -> Tuple[int, ...]:
+    """Identity key of a sequence of immutable device buffers.
+    OWNERSHIP CONTRACT: the cache storing this key must also hold the
+    arrays (ids recycle the moment the last reference drops)."""
+    return tuple(id(a) for a in arrays)
+
+
+# -------------------------------------------------- device value digests
+
+# id(buffer) -> (buffer, count, digest, nbytes): the buffer is held so
+# the id stays pinned — which means the memo PINS device memory, so it
+# is bounded by BYTES as well as entries (the `mempool.upload_index`
+# mirror convention); eviction only costs a re-fetch + re-hash
+_bin_memo: "OrderedDict[int, tuple]" = OrderedDict()
+_bin_memo_bytes = 0
+_BIN_MEMO_MAX = 256
+_BIN_MEMO_MAX_BYTES = 128 * 1024 * 1024
+
+
+def bin_value_digest(data, count: int) -> bytes:
+    """Content digest of one shape bin's LIVE rows (``data[:count]``),
+    memoized by buffer identity.  The D2H fetch on a miss is counted
+    against the transfer totals like every other engine fetch."""
+    from dbcsr_tpu.core import mempool
+
+    global _bin_memo_bytes
+    key = id(data)
+    hit = _bin_memo.get(key)
+    if hit is not None and hit[0] is data and hit[1] == count:
+        _bin_memo.move_to_end(key)
+        return hit[2]
+    host = np.asarray(data[:count])
+    mempool.record_d2h(host.nbytes)
+    d = host_digest(host)
+    nbytes = int(np.prod(data.shape)) * int(np.dtype(str(data.dtype)).itemsize)
+    if hit is not None:
+        _bin_memo_bytes -= hit[3]
+    _bin_memo[key] = (data, count, d, nbytes)
+    _bin_memo_bytes += nbytes
+    while _bin_memo and (len(_bin_memo) > _BIN_MEMO_MAX
+                         or _bin_memo_bytes > _BIN_MEMO_MAX_BYTES):
+        if len(_bin_memo) == 1 and _bin_memo_bytes <= _BIN_MEMO_MAX_BYTES:
+            break
+        _, old = _bin_memo.popitem(last=False)
+        _bin_memo_bytes -= old[3]
+    return d
+
+
+def matrix_value_digest(m) -> bytes:
+    """Full value digest of a finalized matrix: structure (pattern
+    fingerprint, which covers keys AND blocking) + dtype + per-bin
+    content.  Memoized on the matrix by its mutation epoch: an
+    unchanged matrix (same epoch) returns the cached digest without
+    touching the device; any mutation funnel bumps the epoch and the
+    next call re-digests (only the replaced buffers miss the per-bin
+    memo) — the epoch machinery IS the invalidation path."""
+    cached = getattr(m, "_value_digest_cache", None)
+    if cached is not None and cached[0] == m.mutation_epoch:
+        return cached[1]
+    parts = [repr(m.pattern_fingerprint()).encode(),
+             str(np.dtype(m.dtype)).encode()]
+    for b in m.bins:
+        parts.append(np.asarray(
+            (b.shape[0], b.shape[1], b.count), np.int64).tobytes())
+        if b.count:
+            parts.append(bin_value_digest(b.data, b.count))
+    d = digest(*parts)
+    m._value_digest_cache = (m.mutation_epoch, d)
+    return d
+
+
+def clear() -> None:
+    """Drop the per-buffer digest memo (tests / memory pressure)."""
+    global _bin_memo_bytes
+    _bin_memo.clear()
+    _bin_memo_bytes = 0

@@ -143,6 +143,14 @@ class BlockSparseMatrix:
         # fingerprint changes (any structure-altering finalize)
         self._dev_mirrors: Dict = {}
         self._mirror_fp = None
+        # value-delta tracking (mm.incremental / serve.product_cache):
+        # a monotone mutation epoch plus a bounded journal of
+        # (epoch, dirtied block keys | None) entries — None marks a
+        # structure change (everything dirty).  Each matrix owns its
+        # delta state exclusively; `copy()` deliberately does NOT
+        # carry it over (shared bins never alias delta state).
+        self._epoch = 0
+        self._delta_log: List = []
         ch = mempool.current_chain()
         if ch is not None:
             ch.adopt(self)
@@ -431,9 +439,14 @@ class BlockSparseMatrix:
                 False,
             ) + self._work_batches
             self._work.clear()
-        merged = np.union1d(
-            self.keys, np.concatenate([k for (k, _, _) in self._work_batches])
-        )
+        staged_keys = np.unique(
+            np.concatenate([k for (k, _, _) in self._work_batches]))
+        merged = np.union1d(self.keys, staged_keys)
+        # same-pattern finalize (the SCF-loop value update): the delta
+        # journal records exactly the staged keys instead of marking
+        # the whole matrix dirty
+        same_pattern = len(merged) == len(self.keys) and np.array_equal(
+            merged, self.keys)
         rows = (merged // nbc).astype(np.int64)
         cols = (merged % nbc).astype(np.int64)
         nb, nsl, shapes = _bin_entries(
@@ -478,16 +491,22 @@ class BlockSparseMatrix:
         ]
         self._work.clear()
         self._work_batches.clear()
-        self.set_structure_from_device(merged, bins, binning=(nb, nsl, shapes))
+        self.set_structure_from_device(
+            merged, bins, binning=(nb, nsl, shapes),
+            value_delta_keys=staged_keys if same_pattern else None)
         return self
 
     def set_structure_from_device(
-        self, keys: np.ndarray, bins: List[_Bin], binning=None
+        self, keys: np.ndarray, bins: List[_Bin], binning=None,
+        value_delta_keys=None,
     ) -> None:
         """Adopt a prebuilt index + device bins (used by the multiply
         engine, which assembles C on device).  ``binning`` optionally
         carries a precomputed ``_bin_entries`` result to avoid
-        recomputing it.
+        recomputing it.  ``value_delta_keys`` refines the delta
+        journal: a same-pattern caller (value-only finalize) passes
+        exactly the touched block keys; the default None records a
+        structure change (everything dirty).
 
         Caller contract (every in-tree caller satisfies it): ``bins``
         hold FRESHLY CONSTRUCTED device arrays not aliased into any
@@ -522,6 +541,7 @@ class BlockSparseMatrix:
                 if id(d) not in live:
                     mempool.release(d)
         self._bins_shared = False  # fresh bins: exclusively owned again
+        self._note_mutation(value_delta_keys)
         self.valid = True
 
     # --------------------------------------------------------------- access
@@ -627,8 +647,11 @@ class BlockSparseMatrix:
         operands of a filtered product — or reused across a chain's
         multiplies — computes (and fetches) its norms once, like the
         reference's per-data-area `calc_norms` caching.  The cache
-        holds the hashed arrays, so ids cannot recycle."""
-        key = tuple(id(b.data) for b in self.bins)
+        holds the hashed arrays, so ids cannot recycle (the
+        `core.digests.buffers_key` identity-key convention)."""
+        from dbcsr_tpu.core import digests
+
+        key = digests.buffers_key(b.data for b in self.bins)
         cached = getattr(self, "_norms_cache", None)
         if mempool.enabled() and cached is not None and cached[0] == key:
             return cached[1]
@@ -653,19 +676,73 @@ class BlockSparseMatrix:
         Holding the hashed array alive makes the identity check sound
         (no id reuse).  Used to key plan caches for repeated
         same-pattern multiplies (SCF-style loops)."""
-        import hashlib
+        from dbcsr_tpu.core import digests
 
         if getattr(self, "_blk_fp", None) is None:
-            self._blk_fp = hashlib.sha1(
-                self.row_blk_sizes.tobytes() + self.col_blk_sizes.tobytes()
-            ).digest()[:8]
+            self._blk_fp = digests.digest(
+                self.row_blk_sizes.tobytes(), self.col_blk_sizes.tobytes()
+            )[:8]
         if getattr(self, "_fp_keys", None) is not self.keys:
             self._fp_keys = self.keys
             self._fp = (
                 self.nblkrows, self.nblkcols, len(self.keys), self._blk_fp,
-                hashlib.sha1(self.keys.tobytes()).digest()[:8],
+                digests.digest(self.keys.tobytes())[:8],
             )
         return self._fp
+
+    # ---------------------------------------------------------- value deltas
+    # bounded journal: older baselines than the journal reaches degrade
+    # to "unknown" (full recompute), never to a wrong delta
+    _DELTA_LOG_MAX = 64
+
+    @property
+    def mutation_epoch(self) -> int:
+        """Monotone per-matrix mutation counter: bumped by every
+        mutation funnel (finalize/restructure, `map_bin_data`, diag
+        writes, donated adds, pool restore/free).  Consumers snapshot
+        it and later ask `dirty_keys_since` for the delta."""
+        return self._epoch
+
+    def _note_mutation(self, keys) -> None:
+        """Record one mutation: ``keys`` is the int64 block-key array
+        the mutation touched (values only, structure unchanged), or
+        None for a structure change / unknown extent (everything
+        dirty).  The journal holds consecutive epochs; a None entry
+        resets it (nothing older can be reconstructed past it)."""
+        self._epoch += 1
+        if keys is None:
+            self._delta_log = [(self._epoch, None)]
+            return
+        self._delta_log.append(
+            (self._epoch, np.asarray(keys, np.int64)))
+        if len(self._delta_log) > self._DELTA_LOG_MAX:
+            del self._delta_log[0]
+
+    def dirty_keys_since(self, epoch: int):
+        """Block keys whose VALUES may have changed since ``epoch`` (a
+        prior `mutation_epoch` snapshot): an int64 key array (possibly
+        empty = provably unchanged), or None when the delta is unknown
+        — the structure changed, the journal no longer reaches back to
+        ``epoch``, or ``epoch`` was never this matrix's (a rolled-back
+        or foreign epoch).  None always means "treat everything as
+        dirty"; it is never wrong, only conservative."""
+        if epoch == self._epoch:
+            return np.empty(0, np.int64)
+        if epoch > self._epoch or not self._delta_log:
+            return None
+        first = self._delta_log[0][0]
+        if epoch < first - 1:
+            return None  # journal truncated past the baseline
+        parts = []
+        for e, k in self._delta_log:
+            if e <= epoch:
+                continue
+            if k is None:
+                return None
+            parts.append(k)
+        if not parts:
+            return None  # epoch inside a reset journal: unknown
+        return np.unique(np.concatenate(parts))
 
     def copy(self, name: Optional[str] = None) -> "BlockSparseMatrix":
         m = BlockSparseMatrix(
@@ -720,6 +797,7 @@ class BlockSparseMatrix:
             # lineage passed through copy()+scale regains donation)
             self._bins_shared = False
         self.invalidate_dense_cache()  # values changed
+        self._note_mutation(self.keys)  # every stored value touched
 
     def device_index(self, tag, build):
         """Per-matrix device mirror of a structure-derived index array
@@ -779,6 +857,7 @@ class BlockSparseMatrix:
         self._mirror_fp = None
         self._dense_canvas_cache = None
         self._norms_cache = None
+        self._note_mutation(None)  # emptied: nothing reusable remains
         self.valid = False
 
     def invalidate_dense_cache(self) -> None:

@@ -339,6 +339,34 @@ def upload_index(tag: str, arr) -> object:
     return dev
 
 
+def alias_bins(m) -> tuple:
+    """Zero-copy result snapshot of ``m``'s bins: ``([(shape, data,
+    count)], total_device_bytes)``.  The snapshot ALIASES the live
+    buffers — the caller must mark the matrix's bins shared
+    (``m._bins_shared = True``) so no funnel ever donates them back to
+    the pool, and must never bank the aliased buffers itself
+    (exclusivity is unprovable; eviction just drops the references).
+    Shared by the incremental-multiply result cache and the serve
+    product cache."""
+    bins = [(b.shape, b.data, b.count) for b in m.bins]
+    return bins, sum(_arr_bytes(d) for _, d, _ in bins)
+
+
+def adopt_aliased_bins(m, keys, bins_snapshot) -> None:
+    """Install an `alias_bins` snapshot into ``m`` wholesale: the
+    matrix adopts the ALIASED device buffers and its bins are marked
+    shared so no later funnel can donate them while the snapshot's
+    holder (the incremental result cache, the serve product cache)
+    still references them.  The one adoption implementation both
+    caches share."""
+    from dbcsr_tpu.core.matrix import _Bin
+
+    m.set_structure_from_device(
+        np.ascontiguousarray(keys, np.int64).copy(),
+        [_Bin(shape, data, count) for shape, data, count in bins_snapshot])
+    m._bins_shared = True
+
+
 # ----------------------------------------------------------- snapshots
 
 class SnapshotError(RuntimeError):
@@ -408,6 +436,11 @@ def restore_matrix(snap: MatrixSnapshot):
     m._work_batches.clear()
     m.invalidate_dense_cache()
     m._bins_shared = False  # restored bins are exclusively owned again
+    # the epoch stays MONOTONE through a rollback and marks everything
+    # dirty: a consumer that cached a result computed from the
+    # now-discarded post-snapshot state must never see "unchanged" —
+    # a rolled-back matrix is never served as current
+    m._note_mutation(None)
     m.valid = snap.valid
     if old_data is not None:
         for d in old_data:

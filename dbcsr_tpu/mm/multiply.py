@@ -300,12 +300,33 @@ def _multiply_body(a, b, c, alpha, beta, retain_sparsity, filter_eps,
             precision_mod.plan_token(),
         )
         if filter_eps is not None:
-            import hashlib
+            from dbcsr_tpu.core import digests
 
-            h = hashlib.sha1(cand_keys.tobytes())
-            h.update(a_ent.tobytes())
-            h.update(b_ent.tobytes())
-            plan_key += ("filtered", float(filter_eps), h.digest())
+            plan_key += ("filtered", float(filter_eps),
+                         digests.index_digest(cand_keys, a_ent, b_ent))
+
+    # delta-aware incremental path (mm.incremental): a repeated
+    # beta==0 product whose operands carry a known dirty-block delta
+    # since its last full execution recomputes only the affected C
+    # blocks and splices the rest from the cached device-resident
+    # result — bitwise-identical by construction, ABFT-certified, and
+    # always falling back to the full path below on any doubt
+    inc_eligible = (
+        plan_key is not None and filter_eps is None and beta == 0
+        and beta_window is None and not retain_sparsity and no_limits
+        and mempool.enabled() and c.matrix_type == NO_SYMMETRY
+    )
+    if inc_eligible:
+        from dbcsr_tpu.mm import incremental as _inc
+
+        inc_flops = _inc.maybe_reuse(plan_key, a, b, c, alpha, new_keys,
+                                     cand_keys, a_ent, b_ent)
+        if inc_flops is not None:
+            c._note_mutation(c.keys)  # spliced values installed
+            stats.record_multiply(2 * c.nfullrows * c.nfullcols
+                                  * a.nfullcols)
+            stats.sample_memory()
+            return int(inc_flops)
 
     with timed("multiply_c_assemble"):
         _rebuild_c(c, new_keys, beta, beta_window=beta_window)
@@ -314,6 +335,14 @@ def _multiply_body(a, b, c, alpha, beta, retain_sparsity, filter_eps,
         flops = _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha,
                             plan_key=plan_key,
                             c_zero=(beta == 0 and beta_window is None))
+    # the stack launches rebound bin data after _rebuild_c's structure
+    # note: stamp the completed values so epoch consumers (value
+    # digests, delta caches) never see a pre-completion epoch as current
+    c._note_mutation(c.keys)
+    if inc_eligible:
+        from dbcsr_tpu.mm import incremental as _inc
+
+        _inc.note_executed(plan_key, a, b, c, alpha)
 
     if filter_eps is not None and not retain_sparsity:
         with timed("multiply_filter"):
@@ -664,7 +693,9 @@ def _dense_canvas_cached(m: BlockSparseMatrix, build) -> object:
     the arrays so ids cannot be recycled): repeated dense-mode
     multiplies with unchanged operands skip the scatter entirely.
     ``build`` constructs the canvas on a miss."""
-    key = tuple(id(b.data) for b in m.bins)
+    from dbcsr_tpu.core import digests
+
+    key = digests.buffers_key(b.data for b in m.bins)
     cache = getattr(m, "_dense_canvas_cache", None)
     if cache is not None and cache[0] == key:
         return cache[1]

@@ -25,8 +25,10 @@ import numpy as np
 
 from dbcsr_tpu.core import mempool
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
+from dbcsr_tpu.mm import incremental as _incremental
 from dbcsr_tpu.mm.multiply import multiply
 from dbcsr_tpu.models import integrity as _integrity
+from dbcsr_tpu.obs import events as _events
 from dbcsr_tpu.ops.operations import add_on_diag, frobenius_norm, gershgorin_norm, scale
 
 
@@ -107,6 +109,7 @@ def invsqrt_iteration(
         ny = frobenius_norm(y) if guard else None
         nz = frobenius_norm(z) if guard else None
         for it in range(max_iter):
+            reuse0 = _incremental.stats_snapshot()
             # residual R = I - Z Y — doubles as the step's T = I + R/2
             # (T = (3I - Z Y)/2), so each iteration is 3 multiplies total
             r = BlockSparseMatrix("R", s.row_blk_sizes, s.col_blk_sizes,
@@ -205,6 +208,10 @@ def invsqrt_iteration(
                         "invariant")
                     ny2, nz2 = seen["ny"], seen["nz"]
                 ny, nz = ny2, nz2
+            # per-iteration value-reuse fraction (delta plane)
+            _events.publish("model_reuse", dict(
+                model="invsqrt", step=it,
+                **_incremental.reuse_delta(reuse0)))
             ch.retire(t)
             ch.retire(y)
             ch.retire(z)

@@ -16,7 +16,9 @@ import numpy as np
 from dbcsr_tpu.acc import precision as _precision
 from dbcsr_tpu.core import mempool
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
+from dbcsr_tpu.mm import incremental as _incremental
 from dbcsr_tpu.mm.multiply import multiply
+from dbcsr_tpu.obs import events as _events
 from dbcsr_tpu.models import integrity as _integrity
 from dbcsr_tpu.ops.operations import add, frobenius_norm, trace
 from dbcsr_tpu.parallel.dist_matrix import DistMatrix, multiply_distributed
@@ -115,6 +117,7 @@ def mcweeny_purify(
         cur = p
         cur_norm = frobenius_norm(cur) if guard else None
         for step_i in range(steps):
+            reuse0 = _incremental.stats_snapshot()
             snap = ch.snapshot(cur) if guard else None
             new = mcweeny_step(cur, filter_eps=filter_eps)
             tr_new = None
@@ -153,6 +156,12 @@ def mcweeny_purify(
             history.append(trace(cur) if tr_new is None else tr_new)
             psc.observe(abs(history[-1] - history[-2])
                         if len(history) > 1 else float("inf"))
+            # per-iteration value-reuse fraction (the delta-aware
+            # incremental plane tracks every mutation funnel this
+            # loop's adds/multiplies flow through)
+            _events.publish("model_reuse", dict(
+                model="purify", step=step_i,
+                **_incremental.reuse_delta(reuse0)))
             if tol is not None and len(history) > 1:
                 if abs(history[-1] - history[-2]) < tol:
                     break

@@ -16,7 +16,9 @@ from typing import Optional
 from dbcsr_tpu.acc import precision as _precision
 from dbcsr_tpu.core import mempool
 from dbcsr_tpu.core.matrix import BlockSparseMatrix
+from dbcsr_tpu.mm import incremental as _incremental
 from dbcsr_tpu.mm.multiply import multiply
+from dbcsr_tpu.obs import events as _events
 from dbcsr_tpu.models import integrity as _integrity
 from dbcsr_tpu.ops.operations import (
     add_on_diag,
@@ -85,6 +87,7 @@ def sign_iteration(
     ) as psc:
         x_norm = frobenius_norm(x) if guard else None
         for step_i in range(steps):
+            reuse0 = _incremental.stats_snapshot()
             snap = ch.snapshot(x) if guard else None
             x_new = sign_step(x, filter_eps=filter_eps)
             # out-of-place diff: no copy, so neither iterate is ever
@@ -125,6 +128,10 @@ def sign_iteration(
                 x_norm = nn
             history.append(metric)
             psc.observe(metric)
+            # per-iteration value-reuse fraction (delta plane)
+            _events.publish("model_reuse", dict(
+                model="sign", step=step_i,
+                **_incremental.reuse_delta(reuse0)))
             ch.retire(diff)
             if x is not x0:
                 ch.retire(x)

@@ -432,9 +432,38 @@ def _collect_precision() -> list:
     return pts
 
 
+def _collect_value_reuse() -> list:
+    """Value-reuse plane: incremental-multiply outcomes/savings and the
+    serve-layer content-addressed product cache (hit rates, pinned
+    bytes per tenant) — `doctor --trend` renders these alongside the
+    plan-cache and pool series they extend."""
+    import sys
+
+    pts: list = []
+    from dbcsr_tpu.obs import metrics
+
+    for name in ("dbcsr_tpu_incremental_total",
+                 "dbcsr_tpu_incremental_saved_flops_total",
+                 "dbcsr_tpu_incremental_saved_bytes_total",
+                 "dbcsr_tpu_incremental_degrade_total",
+                 "dbcsr_tpu_product_cache_total",
+                 "dbcsr_tpu_product_cache_saved_flops_total"):
+        for labels, v in metrics.counter_items(name):
+            pts.append((name, labels, v, COUNTER))
+    pcm = sys.modules.get("dbcsr_tpu.serve.product_cache")
+    if pcm is not None:  # never instantiated by a scrape
+        snap = pcm.snapshot()
+        pts.append(("dbcsr_tpu_product_cache_bytes", {},
+                    snap["bytes"], GAUGE))
+        for t, v in snap["bytes_by_tenant"].items():
+            pts.append(("dbcsr_tpu_product_cache_bytes", {"tenant": t},
+                        v, GAUGE))
+    return pts
+
+
 _COLLECTORS = (_collect_engine, _collect_serve, _collect_breakers,
                _collect_pool, _collect_integrity, _collect_precision,
-               _collect_health)
+               _collect_value_reuse, _collect_health)
 
 
 # ------------------------------------------------------------ sampling

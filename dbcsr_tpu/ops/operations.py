@@ -142,6 +142,7 @@ def scale_by_vector(
         else:
             b.data = b.data * segs_d[:, :, None]
     matrix.invalidate_dense_cache()
+    matrix._note_mutation(matrix.keys)  # every stored value scaled
     return matrix
 
 
@@ -340,6 +341,7 @@ def add(
             ba.data = mempool.run_donated(fn, ba.data, bb.data, alpha, beta)
         matrix_a._bins_shared = False  # fresh outputs: exclusive again
         matrix_a.invalidate_dense_cache()
+        matrix_a._note_mutation(matrix_a.keys)  # every stored value axpby'd
         return matrix_a
     _add_union(matrix_a, matrix_a, matrix_b, alpha, beta)
     return matrix_a
@@ -413,8 +415,25 @@ def clear(matrix: BlockSparseMatrix) -> BlockSparseMatrix:
         matrix.dist,
         matrix.matrix_type,
     )
-    matrix.__dict__.update(fresh.__dict__)
+    _swap_state(matrix, fresh)
     return matrix
+
+
+def _swap_state(matrix: BlockSparseMatrix,
+                replacement: BlockSparseMatrix) -> None:
+    """Replace ``matrix``'s state with ``replacement``'s wholesale
+    (clear / triu's symmetry fold).  The mutation epoch must stay
+    MONOTONE through the swap: the replacement is a fresh object whose
+    epoch restarts at ~0, and lazily attached epoch-keyed caches
+    (``_value_digest_cache``) survive a plain ``__dict__.update`` —
+    a reset epoch counting back up could then re-serve a stale digest
+    as current.  Carry the old epoch over and record an all-dirty
+    mutation instead."""
+    epoch = matrix._epoch
+    matrix.__dict__.pop("_value_digest_cache", None)
+    matrix.__dict__.update(replacement.__dict__)
+    matrix._epoch = epoch
+    matrix._note_mutation(None)
 
 
 def get_block_diag(
@@ -478,6 +497,7 @@ def copy_into_existing(
             new_data = new_data.at[jnp.asarray(matrix_b.ent_slot[ent])].set(blocks)
         b.data = new_data
     matrix_b.invalidate_dense_cache()
+    matrix_b._note_mutation(matrix_b.keys)  # every stored value rewritten
     return matrix_b
 
 
@@ -799,6 +819,7 @@ def set_diag(matrix: BlockSparseMatrix, values) -> BlockSparseMatrix:
     n = min(matrix.nfullrows, matrix.nfullcols)
     row_off = matrix.row_blk_offsets
     rows, cols = matrix.entry_coords()
+    touched = []  # diag block keys written, for the delta journal
     for b_id, b in enumerate(matrix.bins):
         sel, slots, rws = _diag_entries(matrix, b_id, rows, cols)
         if not len(sel):
@@ -823,7 +844,10 @@ def set_diag(matrix: BlockSparseMatrix, values) -> BlockSparseMatrix:
         if matrix._donatable:
             mempool.release(b.data)  # non-donating jit: old buffer dies here
         b.data = new
+        touched.append(matrix.keys[sel])
     matrix.invalidate_dense_cache()
+    matrix._note_mutation(
+        np.concatenate(touched) if touched else matrix.keys[:0])
     return matrix
 
 
@@ -843,6 +867,7 @@ def add_on_diag(matrix: BlockSparseMatrix, alpha) -> BlockSparseMatrix:
     reserve_blocks(matrix, idx, idx)
     a = jnp.asarray(alpha).astype(matrix.dtype)
     rows, cols = matrix.entry_coords()
+    touched = []  # diag block keys written, for the delta journal
     for b_id, b in enumerate(matrix.bins):
         sel, slots, _ = _diag_entries(matrix, b_id, rows, cols)
         if not len(sel):
@@ -852,7 +877,10 @@ def add_on_diag(matrix: BlockSparseMatrix, alpha) -> BlockSparseMatrix:
         if matrix._donatable:
             mempool.release(b.data)  # non-donating jit: old buffer dies here
         b.data = new
+        touched.append(matrix.keys[sel])
     matrix.invalidate_dense_cache()
+    matrix._note_mutation(
+        np.concatenate(touched) if touched else matrix.keys[:0])
     return matrix
 
 
@@ -878,7 +906,7 @@ def triu(matrix: BlockSparseMatrix) -> BlockSparseMatrix:
         from dbcsr_tpu.ops.transformations import desymmetrize
 
         desymmetrized = desymmetrize(matrix, name=matrix.name)
-        matrix.__dict__.update(desymmetrized.__dict__)
+        _swap_state(matrix, desymmetrized)
     rows, cols = matrix.entry_coords()
     compress(matrix, rows <= cols)
     rows, cols = matrix.entry_coords()
@@ -888,6 +916,7 @@ def triu(matrix: BlockSparseMatrix) -> BlockSparseMatrix:
         if len(sel):
             b.data = _zero_strict_lower(b.data, jnp.asarray(matrix.ent_slot[sel]))
     matrix.invalidate_dense_cache()
+    matrix._note_mutation(matrix.keys[diag])
     return matrix
 
 

@@ -274,10 +274,22 @@ def run_perf(cfg: PerfConfig, seed: int = 12341313, verbose: bool = True,
         return c_run, flops, time.perf_counter() - t0
 
     times, flops_list = [], []
-    for _ in range(cfg.nrep):
-        c_run, flops, dt = _run_once()
-        times.append(dt)
-        flops_list.append(flops)
+    # repeated-identical reps must measure the ENGINE: with the
+    # delta-aware incremental plane live, rep 3+ of an unchanged
+    # beta==0 product would legitimately serve the cached result with
+    # zero launches, turning gflops into a cache benchmark
+    from dbcsr_tpu.core.config import get_config as _get_cfg
+    from dbcsr_tpu.core.config import set_config as _set_cfg
+
+    _prev_inc = _get_cfg().incremental
+    _set_cfg(incremental="off")
+    try:
+        for _ in range(cfg.nrep):
+            c_run, flops, dt = _run_once()
+            times.append(dt)
+            flops_list.append(flops)
+    finally:
+        _set_cfg(incremental=_prev_inc)
     gflops = [f / t / 1e9 for f, t in zip(flops_list, times)]
     cs = matrix_checksum(c_run)
     cs_pos = matrix_checksum(c_run, pos=True)

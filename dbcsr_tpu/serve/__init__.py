@@ -25,6 +25,11 @@ thin glue over the engine machinery PRs 4–7 proved out:
   flight records, per-tenant latency stats, and the
   ``serve_admit``/``serve_execute`` fault sites so chaos schedules
   exercise shedding and mid-request failover.
+* `product_cache` — the content-addressed product cache: identical
+  (A, B, scalars, flags) submissions, keyed by VALUE digests and
+  invalidated through the mutation-epoch machinery, return the cached
+  C with zero engine dispatches; ABFT-on hits are re-certified per
+  request.  See docs/serving.md § Content-addressed product cache.
 
 Surface: `obs.server` gains ``/serve/submit``, ``/serve/status`` and
 ``/serve/tenants``; `tools/serve_bench.py` is the many-client

@@ -35,6 +35,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from dbcsr_tpu.core import digests
 from dbcsr_tpu.core.matrix import (
     NO_SYMMETRY,
     BlockSparseMatrix,
@@ -72,8 +73,10 @@ def coalesce_key(op: str, params: dict) -> Optional[tuple]:
         if m.matrix_type != NO_SYMMETRY:
             return None  # desymmetrize is per-request, not block-diag
     try:
-        alpha = complex(params.get("alpha", 1.0))
-        beta = complex(params.get("beta", 0.0))
+        # one scalar-canonicalization convention (core.digests) across
+        # the coalesce key, the plan cache, and the product cache
+        alpha = digests.scalar_key(params.get("alpha", 1.0))
+        beta = digests.scalar_key(params.get("beta", 0.0))
     except TypeError:
         return None
     return (

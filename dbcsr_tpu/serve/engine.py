@@ -599,8 +599,37 @@ class ServeEngine:
         from dbcsr_tpu.acc import abft as _abft
         from dbcsr_tpu.core import mempool
         from dbcsr_tpu.mm.multiply import multiply
+        from dbcsr_tpu.serve import product_cache as _pcache
 
         p = req.params
+        # content-addressed product cache: an identical (A, B, alpha,
+        # flags, C-pattern) submission — keyed by VALUE digests,
+        # invalidated through the mutation-epoch machinery — returns
+        # the cached C with zero engine dispatches.  Every cacheable
+        # product is probeable, so with the ABFT knob on the hit is
+        # re-certified against the live operands before it is served;
+        # a condemned entry is dropped and the request dispatches.
+        pckey = _pcache.key_of(p) if _pcache.enabled() else None
+        if pckey is not None:
+            ent = _pcache.lookup(pckey, tenant=req.tenant)
+            if ent is not None:
+                _pcache.install(ent, p["c"])
+                self._maybe_corrupt_result(p["c"], req.request_id)
+                served = True
+                if _abft.enabled():
+                    try:
+                        _abft.verify_product(
+                            p["a"], p["b"], p["c"], p.get("alpha", 1.0),
+                            0.0, None, request_id=req.request_id)
+                    except _abft.AbftMismatchError:
+                        # stale or corrupted entry: never serve it —
+                        # drop and fall through to a real dispatch
+                        _pcache.invalidate(pckey, tenant=req.tenant)
+                        served = False
+                if served:
+                    _pcache.note_served(ent, tenant=req.tenant)
+                    return {"flops": 0, "coalesced": 0, "cached": 1,
+                            "saved_flops": ent.flops}
         args = (p.get("transa", "N"), p.get("transb", "N"),
                 p.get("alpha", 1.0), p["a"], p["b"],
                 p.get("beta", 0.0), p["c"])
@@ -609,6 +638,13 @@ class ServeEngine:
         abft_on = _abft.enabled() and _abft.product_probeable(p)
         if not abft_on:
             flops = multiply(*args, **kw)
+            if pckey is not None:
+                # banked BEFORE the fault hook: an injected
+                # serve_execute corruption is per-request and must
+                # never outlive its window through the cache (the
+                # ABFT path gets the same guarantee from certifying
+                # before it stores)
+                _pcache.store(pckey, p["c"], req.tenant, flops)
             self._maybe_corrupt_result(p["c"], req.request_id)
             return {"flops": int(flops), "coalesced": 0}
         a, b, c = p["a"], p["b"], p["c"]
@@ -643,6 +679,10 @@ class ServeEngine:
                 mempool.restore_matrix(snap)
                 raise
             _abft.record_recovery("serve")
+        if pckey is not None:
+            # banked only AFTER the probe certified the result: the
+            # cache can never hold a C the ABFT plane has not accepted
+            _pcache.store(pckey, c, req.tenant, flops)
         return {"flops": int(flops), "coalesced": 0, "verified": 1}
 
     def _maybe_corrupt_result(self, c, request_id: str) -> None:

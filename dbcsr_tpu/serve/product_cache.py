@@ -1,0 +1,243 @@
+"""Content-addressed product cache: identical submissions, zero dispatch.
+
+The serve plane's cross-request value reuse: a ``multiply`` request
+whose (A, B, alpha, trans flags, options, C input pattern) VALUE
+digest matches a previously served product returns the cached C
+without touching the engine — no candidate enumeration, no plan, no
+launch.  Different tenants submitting the same operands share the
+entry (content addressing is tenant-blind by design; bytes are
+ACCOUNTED per inserting tenant for quota visibility).
+
+Keying and invalidation ride the PR's epoch machinery end to end:
+`core.digests.matrix_value_digest` memoizes each operand's digest by
+its mutation epoch, so an unchanged matrix re-keys in O(1) and any
+mutation funnel (finalize, map_bin_data, diag writes, donated adds,
+chain rollback) changes the digest and simply misses — stale entries
+age out of the LRU.  A cached C is ALIASED, never copied: installing
+an entry marks the target's bins shared (`_bins_shared`), which
+permanently blocks pool donation of those buffers, the same contract
+the incremental plane uses.
+
+Eligibility mirrors the ABFT probe's (beta == 0, no value-dependent
+filter, no pattern lock, plain 'N' ops, non-symmetric finalized
+operands): every cacheable product is also probeable, so with the
+ABFT knob on each served hit is re-certified against the live A/B
+before it leaves the engine — a corrupted or stale entry is dropped
+and the request dispatches for real.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from dbcsr_tpu.core import digests
+from dbcsr_tpu.core.matrix import NO_SYMMETRY, BlockSparseMatrix
+
+_lock = threading.Lock()
+
+
+class _Entry:
+    """One cached product: the result structure + aliased device bins,
+    byte size, inserting tenant, and the true flops a hit saves."""
+
+    __slots__ = ("keys", "bins", "nbytes", "tenant", "flops", "hits")
+
+    def __init__(self, c: BlockSparseMatrix, tenant: str, flops: int):
+        from dbcsr_tpu.core import mempool
+
+        self.keys = c.keys
+        self.bins, self.nbytes = mempool.alias_bins(c)
+        self.tenant = tenant
+        self.flops = int(flops)
+        self.hits = 0
+
+
+_entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+_bytes_total = 0
+_bytes_by_tenant: dict = {}
+
+
+def _counter(result: str, **labels):
+    from dbcsr_tpu.obs import metrics as _metrics
+
+    _metrics.counter(
+        "dbcsr_tpu_product_cache_total",
+        "serve-layer content-addressed product cache outcomes (hit = "
+        "request served without an engine dispatch)",
+    ).inc(result=result, **labels)
+
+
+def _bytes_gauges() -> None:
+    from dbcsr_tpu.obs import metrics as _metrics
+
+    g = _metrics.gauge(
+        "dbcsr_tpu_product_cache_bytes",
+        "device bytes pinned by the content-addressed product cache, "
+        "accounted to the inserting tenant",
+    )
+    g.set(_bytes_total)
+    for t, v in _bytes_by_tenant.items():
+        g.set(v, tenant=t)
+
+
+def enabled() -> bool:
+    from dbcsr_tpu.core.config import get_config
+
+    return bool(get_config().serve_product_cache)
+
+
+def key_of(params: dict) -> Optional[tuple]:
+    """The content-addressed key of one multiply request, or None when
+    the request is not value-cacheable (beta != 0 — the old C's
+    values would be an input —, value-dependent filtering, pattern
+    locks, limits, symmetric or unfinalized operands)."""
+    if params.get("filter_eps") is not None:
+        return None
+    if params.get("retain_sparsity"):
+        return None
+    for lim in ("first_row", "last_row", "first_col", "last_col",
+                "first_k", "last_k", "element_limits"):
+        if params.get(lim) is not None:
+            return None
+    try:
+        alpha = digests.scalar_key(params.get("alpha", 1.0))
+        beta = digests.scalar_key(params.get("beta", 0.0))
+    except TypeError:
+        return None
+    if beta != 0:
+        return None
+    if str(params.get("transa", "N")).upper() != "N" \
+            or str(params.get("transb", "N")).upper() != "N":
+        return None
+    a, b, c = params.get("a"), params.get("b"), params.get("c")
+    for m in (a, b, c):
+        if not isinstance(m, BlockSparseMatrix) or not m.valid:
+            return None
+        if m.matrix_type != NO_SYMMETRY:
+            return None
+    return (
+        alpha,
+        digests.matrix_value_digest(a),
+        digests.matrix_value_digest(b),
+        # beta == 0 makes C's VALUES irrelevant, but its input pattern
+        # shapes the result (new_keys = union(old, product))
+        c.pattern_fingerprint(),
+        str(np.dtype(c.dtype)),
+    )
+
+
+def lookup(key: tuple, tenant: str = "?") -> Optional[_Entry]:
+    """Fetch an entry (LRU-refreshing); counts only misses — a hit is
+    counted by `note_served` AFTER the engine's ABFT re-certification
+    accepted it, so a condemned entry never reads as saved work."""
+    with _lock:
+        ent = _entries.get(key)
+        if ent is None:
+            _counter("miss", tenant=tenant)
+            return None
+        _entries.move_to_end(key)
+    return ent
+
+
+def note_served(ent: _Entry, tenant: str = "?") -> None:
+    """Account one certified, served hit."""
+    ent.hits += 1
+    _counter("hit", tenant=tenant)
+    from dbcsr_tpu.obs import metrics as _metrics
+
+    _metrics.counter(
+        "dbcsr_tpu_product_cache_saved_flops_total",
+        "true flops of products served from the content-addressed "
+        "cache instead of dispatched",
+    ).inc(ent.flops)
+
+
+def install(ent: _Entry, c: BlockSparseMatrix) -> None:
+    """Install a cached result into the request's C: the entry's
+    device buffers are adopted directly (zero-copy) and C's bins are
+    marked shared so they can never be donated out from under the
+    cache or any other holder."""
+    from dbcsr_tpu.core import mempool
+
+    mempool.adopt_aliased_bins(c, ent.keys, ent.bins)
+
+
+def store(key: tuple, c: BlockSparseMatrix, tenant: str,
+          flops: int) -> None:
+    """Bank a freshly served product.  Bounded by config
+    (``serve_product_cache_entries`` / ``_bytes``); eviction is LRU
+    and simply drops references (aliased buffers are freed by the
+    device runtime when the last holder lets go — they are never
+    banked into the memory pool, exclusivity being unprovable)."""
+    global _bytes_total
+    from dbcsr_tpu.core.config import get_config
+
+    cfg = get_config()
+    ent = _Entry(c, tenant, flops)
+    if ent.nbytes > cfg.serve_product_cache_bytes:
+        return  # cannot fit even alone
+    c._bins_shared = True  # the cache aliases these buffers now
+    with _lock:
+        old = _entries.pop(key, None)
+        if old is not None:
+            _drop_locked(old)
+        _entries[key] = ent
+        _bytes_total += ent.nbytes
+        _bytes_by_tenant[tenant] = \
+            _bytes_by_tenant.get(tenant, 0) + ent.nbytes
+        while _entries and (
+                len(_entries) > cfg.serve_product_cache_entries
+                or _bytes_total > cfg.serve_product_cache_bytes):
+            if len(_entries) == 1 and \
+                    _bytes_total <= cfg.serve_product_cache_bytes:
+                break
+            _, evicted = _entries.popitem(last=False)
+            _drop_locked(evicted)
+            _counter("evict", tenant=evicted.tenant)
+    _counter("store", tenant=tenant)
+    _bytes_gauges()
+
+
+def _drop_locked(ent: _Entry) -> None:
+    global _bytes_total
+    _bytes_total -= ent.nbytes
+    t = ent.tenant
+    _bytes_by_tenant[t] = max(0, _bytes_by_tenant.get(t, 0) - ent.nbytes)
+    if not _bytes_by_tenant[t]:
+        _bytes_by_tenant.pop(t, None)
+
+
+def invalidate(key: tuple, tenant: str = "?") -> None:
+    """Drop one entry (an ABFT probe condemned it on a hit)."""
+    with _lock:
+        ent = _entries.pop(key, None)
+        if ent is not None:
+            _drop_locked(ent)
+    if ent is not None:
+        _counter("invalidated", tenant=tenant)
+        _bytes_gauges()
+
+
+def clear() -> None:
+    """Drop everything (tests / drain)."""
+    global _bytes_total
+    with _lock:
+        _entries.clear()
+        _bytes_total = 0
+        _bytes_by_tenant.clear()
+    _bytes_gauges()
+
+
+def snapshot() -> dict:
+    """Machine-readable cache state (doctor / timeseries / tests)."""
+    with _lock:
+        return {
+            "entries": len(_entries),
+            "bytes": _bytes_total,
+            "bytes_by_tenant": dict(_bytes_by_tenant),
+            "hits": sum(e.hits for e in _entries.values()),
+        }

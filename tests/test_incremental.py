@@ -1,0 +1,456 @@
+"""Delta-aware incremental multiply and the content-addressed caches.
+
+Covers the PR's value-reuse contracts end to end:
+
+* mutation-epoch / dirty-block journal semantics at every funnel
+  (same-pattern finalize records exactly the staged keys; structure
+  changes, journal truncation, pool restore and `free` degrade to
+  "unknown" — never to a wrong delta);
+* copied matrices never alias delta state;
+* `chain.restore` keeps the epoch monotone and marks everything
+  dirty (a rolled-back C is never served as current);
+* incremental multiply: bitwise identity against full recompute for
+  partial-delta, zero-delta, and fault/ABFT-fallback paths; the
+  `DBCSR_TPU_INCREMENTAL` kill switch; the breaker degrade;
+* `core.digests` content/identity keying (the ONE convention);
+* the serve-layer content-addressed product cache: zero-dispatch
+  hits, epoch-driven invalidation, per-tenant byte accounting,
+  capacity eviction, and the ABFT re-certification of served hits.
+"""
+
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+jax.config.update("jax_enable_x64", True)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import dbcsr_tpu as dt  # noqa: E402
+from dbcsr_tpu.core import digests, mempool  # noqa: E402
+from dbcsr_tpu.core.config import get_config, set_config  # noqa: E402
+from dbcsr_tpu.mm import incremental as inc  # noqa: E402
+from dbcsr_tpu.mm.multiply import multiply  # noqa: E402
+from dbcsr_tpu.ops.operations import add, add_on_diag, scale  # noqa: E402
+from dbcsr_tpu.ops.test_methods import make_random_matrix, to_dense  # noqa: E402
+from dbcsr_tpu.resilience import faults  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _inc_auto():
+    prev = get_config().incremental
+    set_config(incremental="auto")
+    inc.reset()
+    yield
+    set_config(incremental=prev)
+    inc.reset()
+
+
+def _mat(name, nblk=8, bsz=6, occ=0.5, seed=0):
+    return make_random_matrix(name, [bsz] * nblk, [bsz] * nblk,
+                              occupation=occ,
+                              rng=np.random.default_rng(seed))
+
+
+# ------------------------------------------------------------- epochs
+
+def test_same_pattern_finalize_records_staged_keys():
+    m = _mat("M")
+    rows, cols = m.entry_coords()
+    e0 = m.mutation_epoch
+    m.put_block(int(rows[0]), int(cols[0]), np.ones((6, 6)))
+    m.put_block(int(rows[2]), int(cols[2]), np.ones((6, 6)))
+    m.finalize()
+    dk = m.dirty_keys_since(e0)
+    assert dk is not None
+    assert set(dk) == {int(m.keys[0]), int(m.keys[2])}
+    assert m.mutation_epoch > e0
+
+
+def test_structure_change_resets_dirty_state():
+    m = _mat("M")
+    e0 = m.mutation_epoch
+    # a NEW block key changes the pattern: delta must become unknown
+    rows, cols = m.entry_coords()
+    free = next(
+        (r, c) for r in range(m.nblkrows) for c in range(m.nblkcols)
+        if m._find_entry(r, c) < 0)
+    m.put_block(free[0], free[1], np.ones((6, 6)))
+    m.finalize()
+    assert m.dirty_keys_since(e0) is None
+
+
+def test_value_funnels_bump_epoch():
+    m = _mat("M")
+    add_on_diag(m, 1.0)  # first call may RESERVE missing diag blocks
+    for fn in (lambda: scale(m, 2.0),
+               lambda: add_on_diag(m, 1.0),  # pattern now steady
+               lambda: m.zero_data()):
+        e = m.mutation_epoch
+        fn()
+        assert m.mutation_epoch > e
+        assert m.dirty_keys_since(e) is not None  # value-only: known
+
+
+def test_add_on_diag_records_only_diag_keys():
+    m = _mat("M", occ=0.8)
+    rows, cols = m.entry_coords()
+    diag_keys = set(m.keys[rows == cols])
+    assert diag_keys  # occ 0.8 on 8 blocks: diagonal present
+    e = m.mutation_epoch
+    add_on_diag(m, 0.5)
+    dk = m.dirty_keys_since(e)
+    # reserve_blocks kept the pattern (all diagonal blocks present),
+    # so the journal records exactly the touched diagonal keys
+    if dk is not None:
+        assert set(dk) <= diag_keys | set(
+            m.keys[(m.keys // m.nblkcols) == (m.keys % m.nblkcols)])
+
+
+def test_journal_truncation_degrades_to_unknown():
+    m = _mat("M")
+    e0 = m.mutation_epoch
+    for _ in range(m._DELTA_LOG_MAX + 2):
+        m.zero_data()
+    assert m.dirty_keys_since(e0) is None
+    assert m.dirty_keys_since(m.mutation_epoch) is not None
+
+
+def test_copy_never_aliases_delta_state():
+    m = _mat("M")
+    m2 = m.copy("M2")
+    e_m, e_m2 = m.mutation_epoch, m2.mutation_epoch
+    scale(m, 2.0)
+    assert m2.mutation_epoch == e_m2  # untouched by m's mutation
+    scale(m2, 3.0)
+    assert m.mutation_epoch == e_m + 1  # only its own scale
+
+
+def test_restore_bumps_epoch_and_marks_all_dirty():
+    m = _mat("M")
+    snap = mempool.snapshot_matrix(m)
+    e_snap = m.mutation_epoch
+    scale(m, 2.0)
+    mempool.restore_matrix(snap)
+    assert m.mutation_epoch > e_snap  # monotone through rollback
+    assert m.dirty_keys_since(e_snap) is None  # never "unchanged"
+
+
+def test_free_marks_unknown():
+    m = _mat("M")
+    e0 = m.mutation_epoch
+    m.free()
+    assert m.dirty_keys_since(e0) is None
+
+
+def test_rolled_back_epoch_is_unknown():
+    m = _mat("M")
+    future = m.mutation_epoch + 5
+    assert m.dirty_keys_since(future) is None
+
+
+# ------------------------------------------------------------ digests
+
+def test_digest_convention():
+    a = np.arange(6, dtype=np.int64)
+    assert digests.host_digest(a) == digests.host_digest(a.copy())
+    assert digests.host_digest(a) != digests.host_digest(a.reshape(2, 3))
+    assert digests.index_digest(a, a) == digests.index_digest(a, a)
+    assert digests.scalar_key(np.float64(2.0)) == digests.scalar_key(2)
+
+
+def test_matrix_value_digest_tracks_epochs():
+    m = _mat("M")
+    d0 = digests.matrix_value_digest(m)
+    assert digests.matrix_value_digest(m) == d0  # memo hit, unchanged
+    scale(m, 2.0)
+    assert digests.matrix_value_digest(m) != d0
+    m2 = _mat("M2")  # same seed/pattern/values
+    assert digests.matrix_value_digest(m2) == d0
+
+
+# ------------------------------------------- incremental multiply
+
+def _ref_full(a, b, bs):
+    c = dt.create("Cref", bs, bs)
+    set_config(incremental="full")
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    set_config(incremental="auto")
+    return np.asarray(to_dense(c))
+
+
+def _delta_loop(iters=5, nblk=8, bsz=6, dirty=2, check=True, seed=3):
+    bs = [bsz] * nblk
+    a = make_random_matrix("A", bs, bs, occupation=0.5,
+                           rng=np.random.default_rng(seed))
+    b = make_random_matrix("B", bs, bs, occupation=0.5,
+                           rng=np.random.default_rng(seed + 1))
+    c = dt.create("C", bs, bs)
+    rows, cols = a.entry_coords()
+    for it in range(iters):
+        if it:
+            r2 = np.random.default_rng(100 + it)
+            a.put_blocks(rows[:dirty], cols[:dirty],
+                         r2.standard_normal((dirty, bsz, bsz)))
+            a.finalize()
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+        if check:
+            assert (np.asarray(to_dense(c)) == _ref_full(a, b, bs)).all()
+    return a, b, c, bs
+
+
+def test_incremental_bitwise_identical_and_engages():
+    _delta_loop(iters=6)
+    st = inc.stats_snapshot()
+    assert st["products"] >= 1
+    assert st["reused_blocks"] > 0
+    assert st["saved_flops"] > 0
+
+
+def test_incremental_zero_delta_full_reuse():
+    a, b, c, bs = _delta_loop(iters=5, check=False)
+    ref = _ref_full(a, b, bs)
+    p0 = inc.stats_snapshot()["products"]
+    multiply("N", "N", 1.0, a, b, 0.0, c)  # unchanged operands
+    assert inc.stats_snapshot()["products"] == p0 + 1
+    assert (np.asarray(to_dense(c)) == ref).all()
+
+
+def test_incremental_off_kill_switch():
+    set_config(incremental="off")
+    inc.reset()
+    _delta_loop(iters=5, check=False)
+    assert inc.stats_snapshot()["products"] == 0
+
+
+def test_incremental_full_mode_never_splices():
+    set_config(incremental="full")
+    inc.reset()
+    _delta_loop(iters=5, check=False)
+    assert inc.stats_snapshot()["products"] == 0
+
+
+def test_incremental_flip_fault_forces_full_recompute():
+    prev = get_config().abft
+    try:
+        set_config(abft="verify")
+        a, b, c, bs = _delta_loop(iters=5, check=False)
+        rows, cols = a.entry_coords()
+        a.put_blocks(rows[:2], cols[:2],
+                     np.random.default_rng(9).standard_normal((2, 6, 6)))
+        a.finalize()
+        ref = _ref_full(a, b, bs)
+        with faults.inject_faults("incremental:flip,times=1") as specs:
+            multiply("N", "N", 1.0, a, b, 0.0, c)
+        assert specs[0].fired
+        assert (np.asarray(to_dense(c)) == ref).all()
+        from dbcsr_tpu.obs import metrics
+
+        ctr = metrics._counters["dbcsr_tpu_incremental_total"].values
+        assert ctr.get((("result", "fallback_abft"),), 0) >= 1
+    finally:
+        set_config(abft=prev)
+
+
+def test_incremental_raise_fault_falls_back():
+    a, b, c, bs = _delta_loop(iters=5, check=False)
+    rows, cols = a.entry_coords()
+    a.put_blocks(rows[:2], cols[:2],
+                 np.random.default_rng(11).standard_normal((2, 6, 6)))
+    a.finalize()
+    with faults.inject_faults("incremental:raise,times=1") as specs:
+        multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert specs[0].fired
+    assert (np.asarray(to_dense(c)) == _ref_full(a, b, bs)).all()
+
+
+def test_incremental_breaker_degrades_after_repeated_failures():
+    prev = get_config().abft
+    try:
+        set_config(abft="verify")
+        a, b, c, bs = _delta_loop(iters=5, check=False)
+        rows, cols = a.entry_coords()
+        with faults.inject_faults("incremental:flip"):
+            for it in range(inc._BREAKER_THRESHOLD + 1):
+                r2 = np.random.default_rng(50 + it)
+                a.put_blocks(rows[:2], cols[:2],
+                             r2.standard_normal((2, 6, 6)))
+                a.finalize()
+                multiply("N", "N", 1.0, a, b, 0.0, c)
+        assert inc._breaker["open"]
+        # degraded: still correct, just full recompute
+        assert (np.asarray(to_dense(c)) == _ref_full(a, b, bs)).all()
+    finally:
+        set_config(abft=prev)
+
+
+def test_incremental_after_donated_add_stays_correct():
+    """`ops.add`'s donated axpby is a mutation funnel: the delta plane
+    must see B change (all keys) and still match full recompute."""
+    a, b, c, bs = _delta_loop(iters=5, check=False)
+    same = make_random_matrix("B2", bs, bs, occupation=0.5,
+                              rng=np.random.default_rng(4))
+    if np.array_equal(same.keys, b.keys):
+        add(b, same, 1.0, 0.25)  # same-pattern donated path
+    else:
+        scale(b, 1.25)
+    multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert (np.asarray(to_dense(c)) == _ref_full(a, b, bs)).all()
+
+
+# ------------------------------------------------ serve product cache
+
+@pytest.fixture
+def engine():
+    from dbcsr_tpu import serve
+    from dbcsr_tpu.serve import product_cache as pc
+
+    pc.clear()
+    eng = serve.get_engine()
+    yield eng
+    from dbcsr_tpu.serve import engine as engine_mod
+
+    engine_mod.shutdown()
+    pc.clear()
+
+
+def test_product_cache_zero_dispatch_hit(engine):
+    from dbcsr_tpu.core import stats
+    from dbcsr_tpu.serve import product_cache as pc
+
+    bs = [6] * 6
+    a = _mat("A", nblk=6, seed=1)
+    b = _mat("B", nblk=6, seed=2)
+    s = engine.open_session("t-cache")
+    s.put("A", a, adopt=False)
+    s.put("B", b, adopt=False)
+    s.put("C1", dt.create("C1", bs, bs))
+    s.put("C2", dt.create("C2", bs, bs))
+    r1 = engine.submit(s, a="A", b="B", c="C1", beta=0.0)
+    assert r1.wait(timeout=60)
+    m0 = stats._totals["multiplies"]
+    r2 = engine.submit(s, a="A", b="B", c="C2", beta=0.0)
+    assert r2.wait(timeout=60)
+    assert r2.result.get("cached") == 1
+    assert stats._totals["multiplies"] == m0  # zero engine dispatches
+    assert (np.asarray(to_dense(s.get("C1")))
+            == np.asarray(to_dense(s.get("C2")))).all()
+    snap = pc.snapshot()
+    assert snap["entries"] == 1 and snap["bytes"] > 0
+    assert snap["bytes_by_tenant"].get("t-cache", 0) == snap["bytes"]
+    s.close()
+
+
+def test_product_cache_epoch_invalidation(engine):
+    bs = [6] * 6
+    a = _mat("A", nblk=6, seed=1)
+    b = _mat("B", nblk=6, seed=2)
+    s = engine.open_session("t-inval")
+    s.put("A", a, adopt=False)
+    s.put("B", b, adopt=False)
+    for name in ("C1", "C2", "C3"):
+        s.put(name, dt.create(name, bs, bs))
+    assert engine.submit(s, a="A", b="B", c="C1", beta=0.0).wait(60)
+    rows, cols = a.entry_coords()
+    a.put_block(int(rows[0]), int(cols[0]), np.ones((6, 6)))
+    a.finalize()  # mutation epoch bump -> new value digest
+    r = engine.submit(s, a="A", b="B", c="C2", beta=0.0)
+    assert r.wait(timeout=60)
+    assert r.result.get("cached") is None
+    # and the refreshed entry serves the NEW values
+    r3 = engine.submit(s, a="A", b="B", c="C3", beta=0.0)
+    assert r3.wait(timeout=60)
+    assert r3.result.get("cached") == 1
+    assert (np.asarray(to_dense(s.get("C2")))
+            == np.asarray(to_dense(s.get("C3")))).all()
+    s.close()
+
+
+def test_product_cache_ineligible_requests_bypass(engine):
+    bs = [6] * 6
+    a = _mat("A", nblk=6, seed=1)
+    b = _mat("B", nblk=6, seed=2)
+    s = engine.open_session("t-beta")
+    s.put("A", a, adopt=False)
+    s.put("B", b, adopt=False)
+    s.put("C", dt.create("C", bs, bs))
+    for _ in range(2):  # beta != 0 accumulates: never cacheable
+        r = engine.submit(s, a="A", b="B", c="C", beta=0.5)
+        assert r.wait(timeout=60)
+        assert r.result.get("cached") is None
+    s.close()
+
+
+def test_product_cache_capacity_eviction(engine):
+    from dbcsr_tpu.serve import product_cache as pc
+
+    prev = get_config().serve_product_cache_entries
+    try:
+        set_config(serve_product_cache_entries=2)
+        bs = [6] * 6
+        b = _mat("B", nblk=6, seed=2)
+        s = engine.open_session("t-evict")
+        s.put("B", b, adopt=False)
+        for i in range(4):
+            s.put(f"A{i}", _mat(f"A{i}", nblk=6, seed=10 + i),
+                  adopt=False)
+            s.put(f"C{i}", dt.create(f"C{i}", bs, bs))
+            assert engine.submit(
+                s, a=f"A{i}", b="B", c=f"C{i}", beta=0.0).wait(60)
+        assert pc.snapshot()["entries"] <= 2
+        s.close()
+    finally:
+        set_config(serve_product_cache_entries=prev)
+
+
+def test_product_cache_abft_condemns_corrupted_hit(engine):
+    """An injected flip on a served (cached) product must be caught by
+    the per-request probe, the entry dropped, and a real dispatch must
+    produce the correct C — a stale or corrupted C is never served."""
+    prev = get_config().abft
+    try:
+        set_config(abft="verify")
+        bs = [6] * 6
+        a = _mat("A", nblk=6, seed=1)
+        b = _mat("B", nblk=6, seed=2)
+        s = engine.open_session("t-abft")
+        s.put("A", a, adopt=False)
+        s.put("B", b, adopt=False)
+        s.put("C1", dt.create("C1", bs, bs))
+        s.put("C2", dt.create("C2", bs, bs))
+        assert engine.submit(s, a="A", b="B", c="C1", beta=0.0).wait(60)
+        ref = np.asarray(to_dense(s.get("C1")))
+        with faults.inject_faults(
+                "serve_execute:flip,times=1") as specs:
+            r2 = engine.submit(s, a="A", b="B", c="C2", beta=0.0)
+            assert r2.wait(timeout=60)
+        assert specs[0].fired
+        assert r2.state == "done"
+        # the corrupted hit was condemned and re-dispatched for real
+        assert r2.result.get("cached") is None
+        assert (np.asarray(to_dense(s.get("C2"))) == ref).all()
+        from dbcsr_tpu.obs import metrics
+
+        ctr = metrics._counters["dbcsr_tpu_product_cache_total"].values
+        assert any(("result", "invalidated") in k for k in ctr)
+        s.close()
+    finally:
+        set_config(abft=prev)
+
+
+def test_models_publish_reuse_events():
+    from dbcsr_tpu.models.purify import make_test_density, mcweeny_purify
+    from dbcsr_tpu.obs import events
+
+    if not events.enabled():
+        pytest.skip("event bus disabled")
+    events.clear()
+    p = make_test_density(6, 4, occ=0.4, seed=0)
+    mcweeny_purify(p, steps=2)
+    reuse_evts = events.records(kind="model_reuse")
+    assert len(reuse_evts) == 2
+    assert all("reuse_fraction" in e for e in reuse_evts)

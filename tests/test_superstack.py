@@ -21,18 +21,22 @@ requires_native = pytest.mark.skipif(
 
 @pytest.fixture(autouse=True)
 def _clean_slate():
+    from dbcsr_tpu.mm import incremental as _inc
+
     cfg0 = {f: getattr(get_config(), f)
             for f in ("mm_driver", "superstack", "mm_dense", "use_pallas",
-                      "flat_gather")}
+                      "flat_gather", "incremental")}
     faults.clear()
     breaker.reset_board()
     metrics.reset()
     mm._plan_cache.clear()
+    _inc.reset()
     yield
     faults.clear()
     breaker.reset_board()
     metrics.reset()
     mm._plan_cache.clear()
+    _inc.reset()
     set_config(**cfg0)
 
 
@@ -336,8 +340,11 @@ def test_fault_corruption_in_fused_launch_decomposes():
 
 def test_repeated_fused_failures_open_breaker():
     """Persistent fused failures trip the bin's 'fused' breaker: later
-    multiplies route per-span WITHOUT attempting the fused launch."""
-    set_config(superstack="fused")
+    multiplies route per-span WITHOUT attempting the fused launch.
+    Incremental reuse is pinned off: a zero-delta repeat would
+    legitimately serve the cached result without launching, and this
+    test needs every multiply to actually execute."""
+    set_config(superstack="fused", incremental="off")
     a, b, _ = _mats()
     with faults.inject_faults("execute_superstack:raise"):
         for _ in range(4):

@@ -81,7 +81,10 @@ def run_ab(nblk: int, bsize: int, occ: float, reps: int, seed: int):
     denses = {}
     checks = 0
     for mode in ("off", "verify"):
-        set_config(abft=mode)
+        # incremental off: rep 2+ of the identical product would be a
+        # zero-delta cache hit in BOTH legs, measuring the cache
+        # instead of the probe overhead this A/B exists for
+        set_config(abft=mode, incremental="off")
         flops_rep[mode] = multiply("N", "N", 1.0, a, b, 0.0, c)  # warm
         _sync(c)
         metrics.reset()  # count probe checks over the timed reps only

@@ -52,7 +52,7 @@ _hostdev.ensure_virtual_devices(8)
 
 SITES = ("execute_stack", "prepare_stack", "dense", "xla", "xla_group",
          "host", "pallas", "mesh_shift", "gather_chunk", "tas_tick",
-         "serve_admit", "serve_execute")
+         "serve_admit", "serve_execute", "incremental")
 KINDS = ("raise", "oom", "nan", "flip")
 # targets whose OUTPUT a nan/flip spec can corrupt: the faults.corrupt
 # call sites plus the driver labels they carry (a ``pallas:nan`` spec
@@ -61,7 +61,7 @@ KINDS = ("raise", "oom", "nan", "flip")
 # must be detected and recovered like any other fault.
 CORRUPTIBLE = ("execute_stack", "dense", "mesh_shift", "gather_chunk",
                "tas_tick", "serve_execute", "xla", "xla_group", "host",
-               "pallas")
+               "pallas", "incremental")
 
 
 def corpus():
@@ -119,6 +119,14 @@ def corpus():
         # to the returned checksum leg like every other case)
         ("sdc_chain", dict(bs=[4] * 6, dtype=np.float64, occ=0.4,
                            purify_steps=3)),
+        # delta-aware incremental multiply case: an SCF-shaped loop
+        # (same pattern, ~25% of A's blocks updated per iteration)
+        # whose repeated products splice from the cached result —
+        # flip/raise faults injected mid-incremental-multiply must
+        # force the fallback full recompute, bitwise-identical to a
+        # clean run (the mm.incremental safety-ladder contract)
+        ("delta_chain", dict(bs=[4] * 6, dtype=np.float64, occ=0.5,
+                             delta_iters=3)),
     ]
 
 
@@ -386,6 +394,103 @@ def _sdc_chain(entry: dict, seed: int) -> float:
     return float(np.sum(run()))
 
 
+def _delta_chain(entry: dict, seed: int) -> float:
+    """The delta-aware incremental multiply under injected faults,
+    pinned BITWISE.  Paired legs run in a pristine inner fault context
+    (the outer schedule is suspended and restored on exit):
+
+    * reference — ``incremental=full``: every product recomputed from
+      scratch (the control semantics);
+    * clean — ``incremental=auto``: the delta path must ENGAGE
+      (reuse counters advance) and every iterate must be bitwise-equal
+      to the reference;
+    * faulted — ``incremental:flip`` then ``incremental:raise``: a
+      fault mid-incremental-multiply forces the fallback full
+      recompute (flip via the ABFT probe, raise via the splice abort),
+      again bitwise-equal — a reused product never serves a stale or
+      corrupted C.
+
+    The returned checksum comes from a final leg under the OUTER
+    schedule, so the case also participates in the ordinary chaos
+    contract."""
+    import numpy as np
+
+    import dbcsr_tpu as dt
+    from dbcsr_tpu.core.config import get_config, set_config
+    from dbcsr_tpu.mm import incremental as inc
+    from dbcsr_tpu.ops.test_methods import make_random_matrix, to_dense
+    from dbcsr_tpu.resilience import faults
+
+    iters = int(entry["delta_iters"])
+    bs = entry["bs"]
+    bsz = int(bs[0])
+
+    def run():
+        rng = np.random.default_rng(seed)
+        a = make_random_matrix("A", bs, bs, dtype=entry["dtype"],
+                               occupation=entry["occ"], rng=rng)
+        b = make_random_matrix("B", bs, bs, dtype=entry["dtype"],
+                               occupation=entry["occ"], rng=rng)
+        c = dt.create("C", bs, bs, dtype=entry["dtype"])
+        rows, cols = a.entry_coords()
+        sub = np.arange(max(1, len(rows) // 4))
+        for _ in range(3):  # warm: plan + result caches prime
+            dt.multiply("N", "N", 1.0, a, b, 0.0, c)
+        outs = []
+        for it in range(iters):
+            r2 = np.random.default_rng(seed * 1000 + it)
+            for i in sub:
+                a.put_block(int(rows[i]), int(cols[i]),
+                            r2.standard_normal((bsz, bsz)))
+            a.finalize()
+            dt.multiply("N", "N", 1.0, a, b, 0.0, c)
+            outs.append(np.asarray(to_dense(c)))
+        return outs
+
+    prev_abft = get_config().abft
+    prev_inc = get_config().incremental
+    with faults.inject_faults(""):  # pristine inner context
+        try:
+            set_config(abft="verify", incremental="full")
+            inc.reset()
+            ref = run()
+            set_config(incremental="auto")
+            inc.reset()
+            clean = run()
+            if inc.stats_snapshot()["products"] < 1:
+                raise RuntimeError(
+                    "delta_chain: incremental plane never engaged")
+            for i, (r, g) in enumerate(zip(ref, clean)):
+                if not (r == g).all():
+                    raise RuntimeError(
+                        f"delta_chain iter {i}: incremental result not "
+                        f"bitwise-equal to full recompute")
+            for kind in ("flip", "raise"):
+                inc.reset()
+                spec = f"incremental:{kind},seed={seed % 997},times=1"
+                with faults.inject_faults(spec) as specs:
+                    faulted = run()
+                if not specs[0].fired:
+                    raise RuntimeError(
+                        f"delta_chain: {kind} spec never fired")
+                for i, (r, g) in enumerate(zip(ref, faulted)):
+                    if not (r == g).all():
+                        raise RuntimeError(
+                            f"delta_chain iter {i}: {kind}-faulted run "
+                            f"not bitwise-equal to the clean reference")
+        finally:
+            set_config(abft=prev_abft, incremental=prev_inc)
+            inc.reset()
+    # the paired legs' own fault_injected events are not part of the
+    # OUTER schedule's correlation count
+    from dbcsr_tpu.obs import events as obs_events
+
+    if obs_events.enabled():
+        obs_events.clear()
+    # final leg under the outer schedule: the ordinary chaos contract
+    return float(sum(float(np.sum(o)) for o in run()))
+
+
 def _one_product(entry: dict, seed: int):
     import numpy as np
 
@@ -394,6 +499,8 @@ def _one_product(entry: dict, seed: int):
 
     if entry.get("serve_tenants"):
         return _serve_storm(entry, seed)
+    if entry.get("delta_iters"):
+        return _delta_chain(entry, seed)
     if entry.get("purify_steps"):
         return _sdc_chain(entry, seed)
     if entry.get("contract_mesh"):

@@ -73,7 +73,11 @@ def run(m: int = 6000, n: int = 6000, k: int = 6000, block: int = 23,
     modes = {}
     try:
         for mode in ("per_span", "fused"):
-            set_config(superstack=mode, mm_driver=mm_driver)
+            # incremental off: rep 2+ of the identical product would
+            # otherwise be a zero-delta cache hit with no dispatches —
+            # this A/B measures the dispatch machinery, not the cache
+            set_config(superstack=mode, mm_driver=mm_driver,
+                       incremental="off")
             mm._plan_cache.clear()
             metrics.reset()
 

@@ -93,6 +93,12 @@ HINTS = {
         "queued requests are expiring before execution; shorten the "
         "coalescing window, raise worker capacity, or relax deadlines",
         SERVE_RUNBOOK + "#deadlines--the-watchdog-taxonomy"),
+    "incremental_degrade": (
+        "the delta-aware incremental multiply breaker opened after "
+        "repeated probe/fault failures and the plane degraded to full "
+        "recompute; inspect the abft_mismatch events, then reset with "
+        "DBCSR_TPU_INCREMENTAL=off->auto or a process restart",
+        "#incremental-multiply--product-cache"),
     "abft_mismatch": (
         "an ABFT probe checksum disagreed: the device produced a wrong "
         "but FINITE answer (silent data corruption) — the engine "
@@ -319,6 +325,35 @@ def analyze(health: dict | None, prom: dict, events: list,
             .get("pool") or {}
     report["pool"] = pool
 
+    # value reuse: the delta-aware incremental multiply plane and the
+    # serve-layer content-addressed product cache
+    reuse: dict = {}
+    inc_outcomes = collections.Counter()
+    for labels, v in prom.get("dbcsr_tpu_incremental_total", []):
+        inc_outcomes[labels.get("result", "?")] += int(v)
+    if inc_outcomes:
+        reuse["incremental"] = dict(inc_outcomes)
+    saved = prom.get("dbcsr_tpu_incremental_saved_flops_total")
+    if saved:
+        reuse["incremental_saved_flops"] = int(sum(v for _, v in saved))
+    pc_outcomes = collections.Counter()
+    for labels, v in prom.get("dbcsr_tpu_product_cache_total", []):
+        pc_outcomes[labels.get("result", "?")] += int(v)
+    if pc_outcomes:
+        reuse["product_cache"] = dict(pc_outcomes)
+    pcb = [v for labels, v in
+           prom.get("dbcsr_tpu_product_cache_bytes", [])
+           if not labels.get("tenant")]
+    if pcb:
+        reuse["product_cache_bytes"] = int(pcb[-1])
+    if reuse:
+        report["value_reuse"] = reuse
+    degr = prom.get("dbcsr_tpu_incremental_degrade_total")
+    if degr and sum(v for _, v in degr):
+        report["hints"].append(_hint(
+            "incremental_degrade",
+            detail=f"{int(sum(v for _, v in degr))} breaker open(s)"))
+
     # serving plane: live counters/gauge first (prometheus), else the
     # serve_* bus events — queue depth, per-tenant shed/admit, and the
     # top deadline-miss offenders by tenant
@@ -536,6 +571,24 @@ def render(report: dict, out=print) -> None:
             if k in p:
                 parts.append(f"{k.split('_')[0]}={p[k] / 1e6:.1f}MB")
         out(" memory pool: " + ", ".join(parts))
+    if report.get("value_reuse"):
+        vr = report["value_reuse"]
+        parts = []
+        if vr.get("incremental"):
+            parts.append("incremental[" + ", ".join(
+                f"{k}={v}" for k, v in sorted(vr["incremental"].items()))
+                + "]")
+        if "incremental_saved_flops" in vr:
+            parts.append(
+                f"saved_gflop={vr['incremental_saved_flops'] / 1e9:.2f}")
+        if vr.get("product_cache"):
+            parts.append("product_cache[" + ", ".join(
+                f"{k}={v}" for k, v in sorted(vr["product_cache"].items()))
+                + "]")
+        if "product_cache_bytes" in vr:
+            parts.append(
+                f"cache_held={vr['product_cache_bytes'] / 1e6:.1f}MB")
+        out(" value reuse: " + ", ".join(parts))
     if report.get("serving"):
         sv = report["serving"]
         head = " serving:"

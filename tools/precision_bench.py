@@ -76,7 +76,10 @@ def main() -> int:
     # family), not a driver-selection difference — on CPU device kinds
     # the auto dispatch would otherwise hand the native leg to the
     # tuned C++ host driver, which demotion deliberately never preempts
-    set_config(mm_driver="xla")
+    # incremental off for the same reason the driver is held constant:
+    # repeated identical reps would become zero-delta cache hits and
+    # the legs would measure the delta plane, not the precision axis
+    set_config(mm_driver="xla", incremental="off")
 
     def _run_leg(precision: str, abft: str, timed: bool = True):
         set_config(precision=precision, abft=abft)
@@ -132,7 +135,8 @@ def main() -> int:
         denses[name] = dense
         legs[name] = dict(stamps, metric=metric, value=res["gflops"],
                           precision=prec, abft=abft, **res)
-    set_config(precision="native", abft="off", mm_driver="auto")
+    set_config(precision="native", abft="off", mm_driver="auto",
+               incremental="auto")
     spec = ("float32", True)
     try:
         dspec = precision_mod.default_spec(np.float64)
