@@ -1,0 +1,325 @@
+"""Checked registry of every non-config ``DBCSR_TPU_*`` environment knob.
+
+Pure data, import-free: `tools/lint` parses this file with stdlib
+``ast`` (never importing dbcsr_tpu), so the registry stays checkable
+even when jax is broken.  The static analyzer enforces two directions:
+
+* every literal ``DBCSR_TPU_*`` string in source must be either a
+  `core/config.py` Config field knob (``DBCSR_TPU_<FIELD>``, validated
+  by `Config.validate`) or an entry here (rule ``knob-registry``);
+* every entry here must have a row in the generated `docs/knobs.md`
+  (regenerate with ``python -m tools.lint --gen-docs``) — the docs
+  table is EMITTED from this registry plus the Config fields, so the
+  three previously hand-kept lists cannot drift again.
+
+Each entry: ``owner`` (the module that reads it — informational) and
+``doc`` (the one-line operator-facing description that lands in
+docs/knobs.md).  Keep entries alphabetical.
+"""
+
+KNOBS = {
+    "DBCSR_TPU_BENCH_CPU_DRIVER": {
+        "owner": "bench.py",
+        "doc": "stack driver forced when a bench run lands on the CPU "
+               "backend instead of a real TPU (default: config mm_driver).",
+    },
+    "DBCSR_TPU_BENCH_DTYPE": {
+        "owner": "bench.py",
+        "doc": "dtype of the bench.py north-star multiply "
+               "(f64/f32/bf16; default f64).",
+    },
+    "DBCSR_TPU_BENCH_FLIGHT": {
+        "owner": "bench.py",
+        "doc": "path to write the bench run's flight-recorder dump.",
+    },
+    "DBCSR_TPU_BENCH_METRICS": {
+        "owner": "bench.py",
+        "doc": "path to write the bench run's Prometheus metrics snapshot.",
+    },
+    "DBCSR_TPU_BENCH_NREP": {
+        "owner": "bench.py",
+        "doc": "repetitions of the bench north-star multiply (median "
+               "reported).",
+    },
+    "DBCSR_TPU_BENCH_PROBE_TIMEOUT": {
+        "owner": "bench.py",
+        "doc": "seconds before the TPU availability probe is declared "
+               "wedged (watchdog deadline).",
+    },
+    "DBCSR_TPU_BENCH_TIMINGS": {
+        "owner": "bench.py",
+        "doc": "emit the bench per-phase timing report (1 = stdout, "
+               "path = file).",
+    },
+    "DBCSR_TPU_BREAKER_COOLDOWN_S": {
+        "owner": "resilience/breaker.py",
+        "doc": "circuit-breaker open -> half-open cooldown seconds "
+               "(doubles on failed half-open trials).",
+    },
+    "DBCSR_TPU_BREAKER_THRESHOLD": {
+        "owner": "resilience/breaker.py",
+        "doc": "consecutive classified failures before a per-(driver, "
+               "shape) breaker opens.",
+    },
+    "DBCSR_TPU_CHAIN_BLOCKS": {
+        "owner": "bench.py",
+        "doc": "chained-workload bench (--chain): blocks per matrix "
+               "dimension.",
+    },
+    "DBCSR_TPU_CHAIN_FILTER_EPS": {
+        "owner": "bench.py",
+        "doc": "chained-workload bench: inter-iteration filter threshold.",
+    },
+    "DBCSR_TPU_CHAIN_ITERS": {
+        "owner": "bench.py",
+        "doc": "chained-workload bench: iteration count.",
+    },
+    "DBCSR_TPU_CHECK_OUTPUTS": {
+        "owner": "acc/smm.py",
+        "doc": "=1 forces the per-launch finite-output check (always on "
+               "under fault injection).",
+    },
+    "DBCSR_TPU_DENSE_CARVE": {
+        "owner": "mm/multiply.py",
+        "doc": "dense-path operand carve lowering: 'gather' (default) or "
+               "'reshape'; read outside jit and threaded as a static arg.",
+    },
+    "DBCSR_TPU_DENSE_PROFILE": {
+        "owner": "mm/multiply.py",
+        "doc": "=1 emits the dense-path per-phase timing breakdown.",
+    },
+    "DBCSR_TPU_EVENTS": {
+        "owner": "obs/events.py",
+        "doc": "event bus control: '0'/'off' disables the bus, a path "
+               "enables the JSONL sink.",
+    },
+    "DBCSR_TPU_EVENTS_N": {
+        "owner": "obs/events.py",
+        "doc": "bounded event-bus ring capacity (records).",
+    },
+    "DBCSR_TPU_FAULTS": {
+        "owner": "resilience/faults.py",
+        "doc": "fault-injection DSL: 'target:kind[@stack>=N][,prob=]"
+               "[,seed=][,times=][,sleep=]', ';'-separated "
+               "(docs/resilience.md).",
+    },
+    "DBCSR_TPU_FLIGHT_DUMP": {
+        "owner": "obs/flight.py",
+        "doc": "path the flight recorder dumps to at process exit.",
+    },
+    "DBCSR_TPU_FLIGHT_N": {
+        "owner": "obs/flight.py",
+        "doc": "flight-recorder ring capacity (per-product records).",
+    },
+    "DBCSR_TPU_HEALTH_BREAKER_CRITICAL_N": {
+        "owner": "obs/health.py",
+        "doc": "open breakers before the drivers component degrades to "
+               "CRITICAL.",
+    },
+    "DBCSR_TPU_HEALTH_COLLAPSE_RATIO": {
+        "owner": "obs/health.py",
+        "doc": "roofline-collapse detector: fraction of the baseline "
+               "roofline below which perf health degrades.",
+    },
+    "DBCSR_TPU_HEALTH_FALLBACK_RATE": {
+        "owner": "obs/health.py",
+        "doc": "driver-fallback rate per window that counts as a "
+               "fallback storm.",
+    },
+    "DBCSR_TPU_HEALTH_LATENCY_RELTOL": {
+        "owner": "obs/health.py",
+        "doc": "relative dispatch-latency spike tolerance of the health "
+               "model.",
+    },
+    "DBCSR_TPU_HEALTH_POOL_EVICTIONS": {
+        "owner": "obs/health.py",
+        "doc": "pool evictions per window that count as pool thrash.",
+    },
+    "DBCSR_TPU_HEALTH_RECOMPILE_RATE": {
+        "owner": "obs/health.py",
+        "doc": "jit recompiles per window that count as a recompile storm.",
+    },
+    "DBCSR_TPU_HEALTH_SDC_CRITICAL": {
+        "owner": "obs/health.py",
+        "doc": "ABFT/SDC detections per window before integrity health "
+               "goes CRITICAL.",
+    },
+    "DBCSR_TPU_HEALTH_SHED_RATE": {
+        "owner": "obs/health.py",
+        "doc": "serving-plane shed fraction per window that counts as a "
+               "shed storm.",
+    },
+    "DBCSR_TPU_HEALTH_WINDOW": {
+        "owner": "obs/health.py",
+        "doc": "sliding-window length (samples) of the health anomaly "
+               "detectors.",
+    },
+    "DBCSR_TPU_ICI_GBS": {
+        "owner": "obs/costmodel.py",
+        "doc": "inter-chip-interconnect GB/s override for the comm cost "
+               "model.",
+    },
+    "DBCSR_TPU_LOCKCHECK": {
+        "owner": "utils/lockcheck.py",
+        "doc": "=1 enables the dynamic lock-order checker: per-thread "
+               "acquisition order across the instrumented locks is "
+               "recorded and an order inversion raises LockOrderError "
+               "(docs/static_analysis.md).",
+    },
+    "DBCSR_TPU_MP_PLATFORM": {
+        "owner": "perf/driver.py",
+        "doc": "jax_platforms value handed to spawned multi-process perf "
+               "workers (default cpu).",
+    },
+    "DBCSR_TPU_MULTIHOST_TIMEOUT_S": {
+        "owner": "parallel/multihost.py",
+        "doc": "multihost world-join timeout seconds before degraded "
+               "single-host fallback.",
+    },
+    "DBCSR_TPU_NATIVE": {
+        "owner": "native/__init__.py",
+        "doc": "=0 disables loading the native C++ host stack library.",
+    },
+    "DBCSR_TPU_OBS_HOST": {
+        "owner": "obs/server.py",
+        "doc": "observability HTTP server bind host.",
+    },
+    "DBCSR_TPU_OBS_PORT": {
+        "owner": "obs/server.py",
+        "doc": "observability HTTP server port (0 = ephemeral).",
+    },
+    "DBCSR_TPU_PARAMS_DIR": {
+        "owner": "acc/params.py",
+        "doc": "directory holding autotuned kernel parameter tables.",
+    },
+    "DBCSR_TPU_PEAK_GBS": {
+        "owner": "obs/costmodel.py",
+        "doc": "device HBM GB/s override for the roofline model.",
+    },
+    "DBCSR_TPU_PEAK_GFLOPS": {
+        "owner": "obs/costmodel.py",
+        "doc": "device peak GFLOP/s override for the roofline model.",
+    },
+    "DBCSR_TPU_PERF_DEVICES": {
+        "owner": "perf/driver.py",
+        "doc": "device count the multi-process perf driver spawns.",
+    },
+    "DBCSR_TPU_POOL": {
+        "owner": "core/mempool.py",
+        "doc": "=0/false/no disables the device memory pool (default on).",
+    },
+    "DBCSR_TPU_POOL_BYTES": {
+        "owner": "core/mempool.py",
+        "doc": "device memory pool budget in bytes (evicts LRU beyond it).",
+    },
+    "DBCSR_TPU_PREC_BENCH_BS": {
+        "owner": "tools/precision_bench.py",
+        "doc": "precision bench: block size.",
+    },
+    "DBCSR_TPU_PREC_BENCH_M": {
+        "owner": "tools/precision_bench.py",
+        "doc": "precision bench: matrix dimension (blocks).",
+    },
+    "DBCSR_TPU_PREC_BENCH_OCC": {
+        "owner": "tools/precision_bench.py",
+        "doc": "precision bench: block occupancy.",
+    },
+    "DBCSR_TPU_PREC_BENCH_REPS": {
+        "owner": "tools/precision_bench.py",
+        "doc": "precision bench: repetitions per case.",
+    },
+    "DBCSR_TPU_ROOFLINE": {
+        "owner": "obs/costmodel.py",
+        "doc": "JSON peak-table override for the roofline model "
+               "(per-device-kind peaks).",
+    },
+    "DBCSR_TPU_SERVE_JOURNAL": {
+        "owner": "serve/engine.py",
+        "doc": "serving-plane request journal path (drain/restart "
+               "recovery, docs/serving.md).",
+    },
+    "DBCSR_TPU_SLO_CRITICAL_BURN": {
+        "owner": "obs/slo.py",
+        "doc": "burn-rate multiple at which an SLO objective goes "
+               "CRITICAL.",
+    },
+    "DBCSR_TPU_SLO_LONG_S": {
+        "owner": "obs/slo.py",
+        "doc": "long SLO burn window seconds.",
+    },
+    "DBCSR_TPU_SLO_ROOFLINE_BUDGET": {
+        "owner": "obs/slo.py",
+        "doc": "error budget (fraction of samples) for the roofline-floor "
+               "objective.",
+    },
+    "DBCSR_TPU_SLO_ROOFLINE_FLOOR": {
+        "owner": "obs/slo.py",
+        "doc": "roofline fraction below which a sample burns the "
+               "roofline objective.",
+    },
+    "DBCSR_TPU_SLO_SDC_BUDGET": {
+        "owner": "obs/slo.py",
+        "doc": "error budget for silent-data-corruption detections.",
+    },
+    "DBCSR_TPU_SLO_SERVE_ERR_BUDGET": {
+        "owner": "obs/slo.py",
+        "doc": "error budget for serving-plane request failures.",
+    },
+    "DBCSR_TPU_SLO_SERVE_P95_BUDGET": {
+        "owner": "obs/slo.py",
+        "doc": "error budget for serve-latency p95 breaches.",
+    },
+    "DBCSR_TPU_SLO_SERVE_P95_MS": {
+        "owner": "obs/slo.py",
+        "doc": "serve-latency p95 objective in milliseconds.",
+    },
+    "DBCSR_TPU_SLO_SHORT_S": {
+        "owner": "obs/slo.py",
+        "doc": "short SLO burn window seconds.",
+    },
+    "DBCSR_TPU_SYNC_TIMING": {
+        "owner": "core/stats.py",
+        "doc": "=1 enables synchronized per-stack/per-tick timing (the "
+               "documented sync seam; adds device fences to hot paths).",
+    },
+    "DBCSR_TPU_TRACE": {
+        "owner": "obs/tracer.py",
+        "doc": "trace control: path writes the Perfetto/Chrome JSON "
+               "trace, '1' enables in-memory tracing.",
+    },
+    "DBCSR_TPU_TS": {
+        "owner": "obs/timeseries.py",
+        "doc": "telemetry history store: '0'/'off' disables, a path "
+               "enables the JSONL shard sink.",
+    },
+    "DBCSR_TPU_TS_10M_N": {
+        "owner": "obs/timeseries.py",
+        "doc": "10-minute rollup ring capacity.",
+    },
+    "DBCSR_TPU_TS_1M_N": {
+        "owner": "obs/timeseries.py",
+        "doc": "1-minute rollup ring capacity.",
+    },
+    "DBCSR_TPU_TS_INTERVAL_S": {
+        "owner": "obs/timeseries.py",
+        "doc": "minimum seconds between telemetry samples.",
+    },
+    "DBCSR_TPU_TS_RAW_N": {
+        "owner": "obs/timeseries.py",
+        "doc": "raw-resolution telemetry ring capacity.",
+    },
+    "DBCSR_TPU_WATCHDOG_LOG_MAX_BYTES": {
+        "owner": "resilience/watchdog.py",
+        "doc": "watchdog JSONL log rotation bound in bytes.",
+    },
+    "DBCSR_TPU_WATCHDOG_STATE": {
+        "owner": "resilience/watchdog.py",
+        "doc": "path persisting watchdog wedge-streak state across "
+               "processes.",
+    },
+    "DBCSR_TPU_XLA_COST": {
+        "owner": "obs/costmodel.py",
+        "doc": "=1 captures XLA-reported cost analysis into the cost "
+               "model.",
+    },
+}

@@ -52,7 +52,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_lock = threading.RLock()
+from dbcsr_tpu.utils import lockcheck as _lockcheck  # noqa: E402
+
+_lock = _lockcheck.wrap("core.mempool", threading.RLock())
 
 # --------------------------------------------------------------- enable
 

@@ -1466,7 +1466,7 @@ def _superstack_mode() -> str:
     return "fused" if mode == "auto" else mode
 
 
-def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,
+def _run_stacks(c, a, b, cand_keys, a_ent, b_ent, alpha, plan_key=None,  # lint: disable=mutation-epoch (the caller stamps `c._note_mutation(c.keys)` once after the run — per-launch bin swaps and ABFT rollbacks are interior states of one funnel)
                 c_zero=False) -> int:
     """Group candidate triples by (m,n,k) shape-bin, sort by C block,
     and execute: spans sharing a destination C bin fuse into a single

@@ -61,6 +61,7 @@ import threading
 import time
 
 from dbcsr_tpu.obs import shard as _shard
+from dbcsr_tpu.utils import lockcheck as _lockcheck
 
 GAUGE = "gauge"
 COUNTER = "counter"
@@ -68,7 +69,7 @@ COUNTER = "counter"
 # downsample tier widths, seconds (raw -> 1-min -> 10-min)
 TIERS = (60.0, 600.0)
 
-_lock = threading.Lock()
+_lock = _lockcheck.wrap("obs.timeseries", threading.Lock())
 
 
 def _env_int(name: str, default: int) -> int:
@@ -565,7 +566,10 @@ def sample(now: float | None = None, reason: str = "manual") -> dict | None:
                     pass  # a full disk must not fail the multiply
         return rec
     finally:
-        _sampling = False
+        # clear the guard UNDER the lock like the check-and-set above:
+        # an unlocked store is unordered against a concurrent CAS
+        with _lock:
+            _sampling = False
 
 
 def ingest_points(t: float, points, persist: bool = True,

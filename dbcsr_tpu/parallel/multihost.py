@@ -30,6 +30,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from dbcsr_tpu.obs import events as _events
 from dbcsr_tpu.obs import tracer as _trace
 from dbcsr_tpu.resilience import faults as _faults
 
@@ -73,7 +74,7 @@ def _trace_clock_align() -> None:
 
         client = distributed.global_state.client
         if client is not None:
-            client.wait_at_barrier("dbcsr_tpu_trace_clock_align", 60_000)
+            client.wait_at_barrier("dbcsr_tpu_trace_clock_align", 60_000)  # lint: disable=metric-docs (coordination-service barrier tag, not a metric family)
             barrier = "coordination_service"
     except Exception:
         try:  # fall back to a device collective where one exists
@@ -84,7 +85,7 @@ def _trace_clock_align() -> None:
             barrier = "sync_global_devices"
         except Exception:
             pass  # best-effort; t_unix still allows coarse alignment
-    _trace.instant("clock_align", {
+    _events.publish("clock_align", {
         "barrier": barrier,
         "t_unix": time.time(),
         "process": int(jax.process_index()),

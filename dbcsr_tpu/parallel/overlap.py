@@ -160,7 +160,7 @@ def use_split_pipeline(mode: str, why: str, measure: bool) -> bool:
     return mode == "double_buffer" or (measure and why != "breaker-open")
 
 
-def run_ticks(nticks: int, a, b, c, shift_fn, tick_fn, *,
+def run_ticks(nticks: int, a, b, c, shift_fn, tick_fn, *,  # lint: disable=hot-sync (measure= threads the DBCSR_TPU_SYNC_TIMING seam in via `measuring()`; every fence below is behind it)
               mode: str, engine: str, measure: bool = False,
               driver: str = DRIVER, site: str = "mesh_shift"):
     """Drive the Cannon metronome tick-by-tick at host level.
@@ -397,11 +397,11 @@ def publish_decision(engine: str, grid: str, mode: str, why: str) -> None:
     flight record, and the bounded event bus."""
     _trace.annotate(cannon_mode=mode, cannon_mode_why=why)
     _flight.note("cannon_mode", mode)
-    _flight.note_event("cannon_overlap", engine=engine, grid=grid,
-                       mode=mode, why=why)
+    # flight=True fans the same (kind, fields) out to the flight
+    # recorder — one bus publish carries all three emissions
     _events.publish("cannon_overlap",
                     {"engine": engine, "grid": grid, "mode": mode,
-                     "why": why})
+                     "why": why}, flight=True)
     # rollup mode = the resolved decision; `guarded` overwrites it with
     # "serial" if the pipeline later degrades, so evidence stamps
     # (tools/mesh_perf.py) always read what actually ran

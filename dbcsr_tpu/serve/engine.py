@@ -37,6 +37,7 @@ from dbcsr_tpu.resilience.watchdog import WEDGED
 from dbcsr_tpu.serve import coalesce as _coalesce
 from dbcsr_tpu.serve.queue import AdmissionQueue, Rejected, Request, classify
 from dbcsr_tpu.serve.session import Session
+from dbcsr_tpu.utils import lockcheck as _lockcheck
 
 
 def default_journal_path() -> str:
@@ -47,7 +48,7 @@ def default_journal_path() -> str:
     return os.environ.get("DBCSR_TPU_SERVE_JOURNAL",
                           f"serve_journal-{os.getpid()}.jsonl")
 
-_lock = threading.Lock()
+_lock = _lockcheck.wrap("serve.engine", threading.Lock())
 _engine: "ServeEngine | None" = None
 
 # request ops the engine executes; "multiply" is the only coalescable
@@ -63,7 +64,7 @@ class ServeEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._inflight = 0
-        self._slock = threading.Lock()
+        self._slock = _lockcheck.wrap("serve.engine.stats", threading.Lock())
         # finished-request lookup for /serve/status (bounded)
         self._requests: "collections.OrderedDict[str, Request]" = \
             collections.OrderedDict()

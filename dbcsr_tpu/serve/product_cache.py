@@ -36,8 +36,9 @@ import numpy as np
 
 from dbcsr_tpu.core import digests
 from dbcsr_tpu.core.matrix import NO_SYMMETRY, BlockSparseMatrix
+from dbcsr_tpu.utils import lockcheck as _lockcheck
 
-_lock = threading.Lock()
+_lock = _lockcheck.wrap("serve.product_cache", threading.Lock())
 
 
 class _Entry:

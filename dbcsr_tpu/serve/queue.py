@@ -35,6 +35,7 @@ from typing import Optional
 
 from dbcsr_tpu.resilience import faults as _faults
 from dbcsr_tpu.resilience.watchdog import OK, SLOW, TRANSIENT, WEDGED
+from dbcsr_tpu.utils import lockcheck as _lockcheck
 
 _req_seq = itertools.count(1)
 _TOKEN = uuid.uuid4().hex[:6]
@@ -174,7 +175,7 @@ class AdmissionQueue:
     ties pop in submit order."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.wrap("serve.queue", threading.Lock())
         self._cond = threading.Condition(self._lock)
         self._heap: list = []
         self._seq = itertools.count()

@@ -1,0 +1,413 @@
+"""Tier-1 gate for the project invariant analyzer (tools/lint).
+
+Three layers: (1) the WHOLE TREE runs clean against the committed
+baseline — a new contract violation fails CI here; (2) fixture-driven
+unit tests per rule family — a seeded violation must fire, the
+compliant twin must not; (3) suppression and baseline mechanics
+round-trip.
+
+The analyzer itself never imports dbcsr_tpu; these tests import the
+analyzer (stdlib-only), so this module stays runnable even when jax
+is broken — by design, like the analyzer.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.lint import engine, registry  # noqa: E402
+from tools.lint import (rules_conformance, rules_donation, rules_hotpath,  # noqa: E402
+                        rules_knobs, rules_locks, rules_mutation)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------ fixture plumbing
+
+def _ctx(tmp_path, relpath, source):
+    """A FileCtx for ``source`` planted at ``relpath`` under a temp
+    root, plus a RepoCtx with the registry caches stubbed so rule
+    logic is tested in isolation."""
+    full = tmp_path / relpath
+    full.parent.mkdir(parents=True, exist_ok=True)
+    full.write_text(source)
+    ctx = engine.FileCtx(str(tmp_path), relpath)
+    repo = engine.RepoCtx(str(tmp_path), [ctx])
+    repo._knobs_registered = {"DBCSR_TPU_REGISTERED"}
+    repo._sites_registry = {"known_site": {
+        "boundary": "b", "corruptible": True, "chaos": True,
+        "dynamic": False}}
+    repo._doc_metrics = {"dbcsr_tpu_documented_total"}
+    return ctx, repo
+
+
+def _run(check, ctx, repo):
+    return [f for f in check(ctx, repo) if f is not None]
+
+
+# ------------------------------------------------- rule 1: mutation-epoch
+
+BAD_MUTATION = """
+def forget(m, new):
+    for b in m.bins:
+        b.data = new
+"""
+
+GOOD_MUTATION = """
+def remember(m, new):
+    for b in m.bins:
+        b.data = new
+    m._note_mutation(m.keys)
+"""
+
+FRESH_MUTATION = """
+def build(sizes):
+    out = BlockSparseMatrix("x", sizes, sizes, float)
+    out.bins = []
+    return out
+"""
+
+
+def test_mutation_epoch_fires(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/mm/bad.py", BAD_MUTATION)
+    fs = _run(rules_mutation._check, ctx, repo)
+    assert [f.rule for f in fs] == ["mutation-epoch"]
+
+
+def test_mutation_epoch_clean_on_noter_and_fresh(tmp_path):
+    for src in (GOOD_MUTATION, FRESH_MUTATION):
+        ctx, repo = _ctx(tmp_path, "dbcsr_tpu/mm/good.py", src)
+        assert _run(rules_mutation._check, ctx, repo) == []
+
+
+def test_mutation_epoch_scoped_to_funnel_dirs(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/obs/elsewhere.py", BAD_MUTATION)
+    assert _run(rules_mutation._check, ctx, repo) == []
+
+
+# ------------------------------------------------- rule 2: donation-read
+
+BAD_DONATION = """
+import functools, jax
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _axpby_donated(c, a):
+    return c + a
+
+def use(c, a):
+    out = _axpby_donated(c, a)
+    return c.sum()
+"""
+
+GOOD_DONATION = """
+import functools, jax
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _axpby_donated(c, a):
+    return c + a
+
+def rebind(c, a):
+    c = _axpby_donated(c, a)
+    return c.sum()
+
+def branches(c, a, flag):
+    if flag:
+        out = _axpby_donated(c, a)
+    else:
+        out = c * 2
+    return out
+"""
+
+
+def test_donation_read_fires(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/acc/bad.py", BAD_DONATION)
+    fs = _run(rules_donation._check, ctx, repo)
+    assert len(fs) == 1 and fs[0].rule == "donation-read"
+    assert "`c` read after being donated" in fs[0].message
+
+
+def test_donation_read_rebind_and_branches_clean(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/acc/good.py", GOOD_DONATION)
+    assert _run(rules_donation._check, ctx, repo) == []
+
+
+# --------------------------------------------- rule 3: lock rules
+
+BAD_LOCKS = """
+import threading
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._depth = 0
+
+    def locked_write(self):
+        with self._lock:
+            self._depth = 1
+
+    def racy_write(self):
+        self._depth = 2
+
+    def bad_callback(self, events):
+        with self._lock:
+            events.publish("kind", {})
+"""
+
+GOOD_LOCKS = """
+import threading
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._depth = 0
+
+    def locked_write(self):
+        with self._lock:
+            self._depth = 1
+
+    def _bump_locked(self):
+        self._depth += 1
+
+    def good_callback(self, events):
+        with self._lock:
+            payload = {"depth": self._depth}
+        events.publish("kind", payload)
+"""
+
+
+def test_lock_rules_fire(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/serve/bad.py", BAD_LOCKS)
+    rules = sorted(f.rule for f in _run(rules_locks._check, ctx, repo))
+    assert rules == ["lock-callback", "lock-mixed-write"]
+
+
+def test_lock_rules_clean(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/serve/good.py", GOOD_LOCKS)
+    assert _run(rules_locks._check, ctx, repo) == []
+
+
+# --------------------------------------------- rule 4: knob-registry
+
+BAD_KNOB = """
+import os
+flag = os.environ.get("DBCSR_TPU_UNREGISTERED")
+"""
+
+GOOD_KNOB = """
+import os
+flag = os.environ.get("DBCSR_TPU_REGISTERED")
+"""
+
+
+def test_knob_registry_fires(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/core/bad.py", BAD_KNOB)
+    fs = _run(rules_knobs._check, ctx, repo)
+    assert len(fs) == 1 and "DBCSR_TPU_UNREGISTERED" in fs[0].message
+
+
+def test_knob_registry_clean(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/core/good.py", GOOD_KNOB)
+    assert _run(rules_knobs._check, ctx, repo) == []
+
+
+# ------------------------------------- rule 5: conformance (3 checks)
+
+BAD_SITE = """
+from dbcsr_tpu.resilience import faults as _faults
+
+def f():
+    _faults.maybe_inject("rogue_site")
+"""
+
+GOOD_SITE = """
+from dbcsr_tpu.resilience import faults as _faults
+
+def f(site):
+    _faults.maybe_inject("known_site")
+    _faults.maybe_inject(site)  # dynamic: registry covers it
+"""
+
+BAD_METRIC = """
+def f(metrics):
+    metrics.counter("dbcsr_tpu_undocumented_total", "h").inc()
+"""
+
+BAD_BYPASS = """
+from dbcsr_tpu.obs import tracer as _trace
+from dbcsr_tpu.obs import flight as _flight
+
+def f():
+    _trace.instant("kind", {})
+    _flight.note_event("kind", a=1)
+"""
+
+GOOD_BYPASS = """
+from dbcsr_tpu.obs import events as _events
+from dbcsr_tpu.obs import tracer as _trace
+
+def f():
+    _events.publish("kind", {"a": 1}, flight=True)
+    _trace.annotate(span_attr=1)  # span attributes are not events
+"""
+
+
+def test_fault_site_registry_fires(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/acc/bad.py", BAD_SITE)
+    fs = _run(rules_conformance._check_sites, ctx, repo)
+    assert len(fs) == 1 and "rogue_site" in fs[0].message
+
+
+def test_fault_site_registry_clean(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/acc/good.py", GOOD_SITE)
+    assert _run(rules_conformance._check_sites, ctx, repo) == []
+
+
+def test_metric_docs_fires_and_clean(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/obs2/bad.py", BAD_METRIC)
+    fs = _run(rules_conformance._check_metrics, ctx, repo)
+    assert len(fs) == 1 and "dbcsr_tpu_undocumented_total" in fs[0].message
+    good = BAD_METRIC.replace("undocumented", "documented")
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/obs2/good.py", good)
+    assert _run(rules_conformance._check_metrics, ctx, repo) == []
+
+
+def test_event_bypass_fires_and_clean(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/serve/bad2.py", BAD_BYPASS)
+    rules = [f.rule for f in _run(rules_conformance._check_bypass, ctx, repo)]
+    assert rules == ["event-bypass", "event-bypass"]
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/serve/good2.py", GOOD_BYPASS)
+    assert _run(rules_conformance._check_bypass, ctx, repo) == []
+
+
+def test_event_bypass_allowed_inside_obs(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/obs/events_impl.py", BAD_BYPASS)
+    assert _run(rules_conformance._check_bypass, ctx, repo) == []
+
+
+# ------------------------------------------------- rule 6: hot-sync
+
+BAD_SYNC = """
+import jax
+
+def timed_hot_region(out):
+    jax.block_until_ready(out)
+    return out
+"""
+
+GOOD_SYNC = """
+import jax
+from dbcsr_tpu.core import stats
+
+def seamed(out):
+    if stats.sync_timing_enabled():
+        jax.block_until_ready(out)
+    return out
+"""
+
+
+def test_hot_sync_fires(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/mm/bad2.py", BAD_SYNC)
+    fs = _run(rules_hotpath._check, ctx, repo)
+    assert [f.rule for f in fs] == ["hot-sync"]
+
+
+def test_hot_sync_seam_and_scope_clean(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/mm/good2.py", GOOD_SYNC)
+    assert _run(rules_hotpath._check, ctx, repo) == []
+    # outside the hot dirs the fence is fine (bench/serve code)
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/serve/ok.py", BAD_SYNC)
+    assert _run(rules_hotpath._check, ctx, repo) == []
+
+
+# ------------------------------------- suppression + baseline mechanics
+
+def test_inline_suppression(tmp_path):
+    src = BAD_SYNC.replace(
+        "jax.block_until_ready(out)",
+        "jax.block_until_ready(out)  # lint: disable=hot-sync (fixture)")
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/mm/supp.py", src)
+    assert _run(rules_hotpath._check, ctx, repo) == []
+
+
+def test_def_line_suppression(tmp_path):
+    src = BAD_SYNC.replace(
+        "def timed_hot_region(out):",
+        "def timed_hot_region(out):  # lint: disable=hot-sync (fixture)")
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/mm/supp2.py", src)
+    assert _run(rules_hotpath._check, ctx, repo) == []
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    src = BAD_SYNC.replace(
+        "jax.block_until_ready(out)",
+        "jax.block_until_ready(out)  # lint: disable=other-rule")
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/mm/supp3.py", src)
+    assert len(_run(rules_hotpath._check, ctx, repo)) == 1
+
+
+def test_baseline_round_trip(tmp_path):
+    ctx, repo = _ctx(tmp_path, "dbcsr_tpu/mm/bad3.py", BAD_SYNC)
+    findings = _run(rules_hotpath._check, ctx, repo)
+    bl_path = str(tmp_path / "baseline.json")
+    engine.write_baseline(bl_path, findings, "fixture grandfathering")
+    baseline = engine.load_baseline(bl_path)
+    new, old = engine.split_baselined(findings, baseline)
+    assert new == [] and len(old) == 1
+    # fingerprints survive line drift (a comment above the finding)
+    ctx2, repo2 = _ctx(tmp_path, "dbcsr_tpu/mm/bad3.py",
+                       "# moved down a line\n" + BAD_SYNC)
+    findings2 = _run(rules_hotpath._check, ctx2, repo2)
+    new2, old2 = engine.split_baselined(findings2, baseline)
+    assert new2 == [] and len(old2) == 1
+
+
+# --------------------------------------------- registries stay checked
+
+def test_chaos_suite_derives_from_registry():
+    sites = registry.load_sites(REPO)
+    drivers = registry.load_driver_targets(REPO)
+    chaos = {s for s, m in sites.items() if m["chaos"]} | set(drivers)
+    corrupt = {s for s, m in sites.items()
+               if m["chaos"] and m["corruptible"]} | set(drivers)
+    # the historical schedule draw, now derived — a registry edit that
+    # silently changes the chaos surface must be a conscious one
+    assert chaos >= {"execute_stack", "prepare_stack", "dense",
+                     "mesh_shift", "gather_chunk", "tas_tick",
+                     "incremental", "serve_admit", "serve_execute",
+                     "xla", "xla_group", "host", "pallas"}
+    assert "probe" not in corrupt and "multihost_init" not in corrupt
+
+
+def test_generated_docs_fresh():
+    assert registry.gen_knobs_md(REPO) == open(
+        os.path.join(REPO, registry.KNOBS_DOC)).read()
+    text = open(os.path.join(REPO, registry.RESILIENCE_DOC)).read()
+    assert registry.sites_block_of(text) == registry.gen_sites_block(REPO)
+
+
+# --------------------------------------------------- the tier-1 gate
+
+def test_tree_is_clean():
+    """The whole tree, against the committed baseline: any new
+    contract violation fails HERE."""
+    findings, repo = engine.run_analysis(REPO)
+    assert repo.parse_errors == []
+    baseline = engine.load_baseline(engine.baseline_path(REPO))
+    new, _ = engine.split_baselined(findings, baseline)
+    assert new == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule}: {f.message}" for f in new)
+
+
+@pytest.mark.slow
+def test_cli_exit_codes():
+    """`python -m tools.lint` speaks perf_gate's exit-code dialect."""
+    r = subprocess.run([sys.executable, "-m", "tools.lint", "--json"],
+                       cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["counts"]["new"] == 0

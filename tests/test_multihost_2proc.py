@@ -77,6 +77,10 @@ def _run_world(worker, attempt_timeout):
     s.close()
     env = dict(os.environ, XLA_FLAGS="--xla_force_host_platform_device_count=4")
     env.pop("JAX_PLATFORMS", None)  # worker sets the platform itself
+    # run the worker processes with the dynamic lock-order checker on:
+    # the 2-process world exercises the mempool/serve/timeseries locks
+    # under real concurrency (dbcsr_tpu/utils/lockcheck.py)
+    env.setdefault("DBCSR_TPU_LOCKCHECK", "1")
     procs = [
         subprocess.Popen(
             [sys.executable, str(worker), str(port), str(i)],

@@ -370,7 +370,8 @@ def test_doctor_runbook_anchors_exist():
 
     docs = {"resilience.md": anchors_of("resilience.md"),
             "serving.md": anchors_of("serving.md"),
-            "observability.md": anchors_of("observability.md")}
+            "observability.md": anchors_of("observability.md"),
+            "static_analysis.md": anchors_of("static_analysis.md")}
     for kind, (_, anchor) in doctor.HINTS.items():
         if anchor.startswith("docs/"):
             doc, frag = anchor[len("docs/"):].split("#", 1)

@@ -1136,10 +1136,42 @@ def _past_deadline() -> bool:
     return False
 
 
+def run_lint() -> bool:
+    """Tier 0: the project invariant analyzer (tools/lint) — CPU-only
+    and tunnel-independent, so it runs FIRST: a capture window spent
+    benchmarking a tree that violates its own contracts is wasted
+    evidence.  The JSON report is banked as LINT.json for the doctor;
+    a finding never blocks the perf tiers (CI blocks the PR instead,
+    tests/test_lint.py)."""
+    res = _guarded_run(
+        "tier0_lint",
+        [sys.executable, "-m", "tools.lint", "--json"],
+        300, capture_output=True, text=True, cwd=REPO,
+    )
+    if res.value is None:
+        log(f"tier0 lint: {res.outcome} ({res.error})")
+        return False
+    r = res.value
+    try:
+        report = json.loads(r.stdout)
+    except json.JSONDecodeError:
+        log(f"tier0 lint: rc={r.returncode}, no JSON "
+            f"({(r.stderr or '')[-300:]})")
+        return False
+    with open(os.path.join(REPO, "LINT.json"), "w") as fh:
+        json.dump(dict(report, rc=r.returncode), fh, indent=2)
+    counts = report.get("counts", {})
+    log(f"tier0 lint: rc={r.returncode} new={counts.get('new')} "
+        f"baselined={counts.get('baselined')} "
+        f"errors={counts.get('errors')}")
+    return r.returncode == 0
+
+
 def attempt() -> dict:
     """One full capture attempt.  Returns status flags."""
     st = {"probe": False, "tier1": 0, "tier2": False, "tier3": False,
           "tier4": 0}
+    st["lint"] = run_lint()
     if not probe():
         log("probe failed: tunnel unreachable/wedged")
         return st

@@ -42,6 +42,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # CPU-only by design: chaos runs must be schedulable in CI without
 # hardware (and must never be pointed at a live tunnel).
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the dynamic lock-order checker rides every chaos schedule: the
+# randomized fault timing is exactly the interleaving explorer that
+# surfaces an A->B / B->A inversion (dbcsr_tpu/utils/lockcheck.py)
+os.environ.setdefault("DBCSR_TPU_LOCKCHECK", "1")
 # the mesh_overlap corpus case needs a real 2x2 grid, the tas_contract
 # case a rectangular 1x2x3 one plus a (2,2,2) grouped world: give the
 # CPU backend 8 virtual devices (no-op when XLA_FLAGS already set them)
@@ -50,18 +54,32 @@ import _hostdev  # noqa: E402
 
 _hostdev.ensure_virtual_devices(8)
 
-SITES = ("execute_stack", "prepare_stack", "dense", "xla", "xla_group",
-         "host", "pallas", "mesh_shift", "gather_chunk", "tas_tick",
-         "serve_admit", "serve_execute", "incremental")
+# the schedule draw and corruption targets derive from the checked
+# fault-site registry (the analyzer's `fault-site-docs` rule rejects a
+# hand-kept tuple here — registry drift was exactly the failure mode).
+# Loaded standalone by file path, like the watchdog in the capture
+# loop: the registry is pure data and must stay readable before the
+# package (and jax) come up.
+import importlib.util  # noqa: E402
+
+_sites_spec = importlib.util.spec_from_file_location(
+    "_chaos_sites", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "dbcsr_tpu", "resilience", "sites.py"))
+_sites = importlib.util.module_from_spec(_sites_spec)
+_sites_spec.loader.exec_module(_sites)
+
+# NOTE: a logged --seed replays exactly only against the same tree —
+# the draw order (and the corpus) are part of the schedule, and the
+# registry derivation reordered the draw relative to pre-PR-14 logs
+SITES = _sites.chaos_sites()
 KINDS = ("raise", "oom", "nan", "flip")
 # targets whose OUTPUT a nan/flip spec can corrupt: the faults.corrupt
 # call sites plus the driver labels they carry (a ``pallas:nan`` spec
 # fires on the execute_stack corrupt hook via its driver label).  The
 # whole suite runs with DBCSR_TPU_ABFT=verify, so a finite flip here
 # must be detected and recovered like any other fault.
-CORRUPTIBLE = ("execute_stack", "dense", "mesh_shift", "gather_chunk",
-               "tas_tick", "serve_execute", "xla", "xla_group", "host",
-               "pallas", "incremental")
+CORRUPTIBLE = _sites.chaos_corrupt_targets()
 
 
 def corpus():

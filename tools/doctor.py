@@ -45,6 +45,7 @@ import json
 import os
 import re
 import sys
+import time
 
 RUNBOOK = "docs/resilience.md"
 SERVE_RUNBOOK = "docs/serving.md"
@@ -125,6 +126,12 @@ HINTS = {
         "and long windows — sustained, not a spike; shed load, raise "
         "capacity, or roll back the regressing change",
         "docs/observability.md#slo-objectives--error-budget-burn"),
+    "lint_findings": (
+        "the tree violates its own contracts (mutation-epoch, "
+        "donation, lock, knob/site/metric registry invariants); run "
+        "`python -m tools.lint` and fix or suppress-with-reason "
+        "before trusting any capture from this tree",
+        "docs/static_analysis.md#rule-catalog"),
 }
 
 # the telemetry cells --trend tables by default (history worth eyes:
@@ -1013,6 +1020,25 @@ def main(argv=None) -> int:
 
     report = analyze(health, prom, events, flight, probe, captures,
                      top=args.top)
+    # tier-0 lint artifact (tools/capture_tiered.py banks LINT.json):
+    # a tree that fails its own invariant analyzer taints every other
+    # number this report vouches for
+    lint_path = os.path.join(repo_root, "LINT.json")
+    if os.path.exists(lint_path):
+        try:
+            age_h = (time.time() - os.path.getmtime(lint_path)) / 3600.0
+            with open(lint_path) as fh:
+                lint = json.load(fh)
+            n = int(lint.get("counts", {}).get("new", 0))
+        except (ValueError, OSError):
+            n = 0
+            age_h = 0.0
+        # a day-old report says nothing about TODAY's tree — the next
+        # capture window re-banks it; don't nag off stale evidence
+        if n and age_h <= 24.0:
+            report["hints"].append(_hint(
+                "lint_findings",
+                detail=f"{n} new finding(s), report {age_h:.1f}h old"))
     if args.as_json:
         print(json.dumps(report, default=str))
     else:

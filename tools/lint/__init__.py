@@ -1,0 +1,17 @@
+"""Project invariant analyzer (`python -m tools.lint`).
+
+AST-based contract checker for the load-bearing conventions thirteen
+PRs of growth accumulated: mutation funnels must bump the dirty-block
+epoch, donated buffers must never be read after donation, lock-owned
+state must stay under its lock, every ``DBCSR_TPU_*`` knob / fault
+site / metric name must live in its checked registry and its docs.
+
+Stdlib-only and **no dbcsr_tpu import**: the analyzer must keep
+running when jax (or the package itself) is broken — registries are
+read by parsing their pure-data modules with ``ast``.
+
+Rule catalog, suppression policy (`# lint: disable=RULE`), and
+baseline mechanics: docs/static_analysis.md.
+"""
+
+from tools.lint.engine import run_analysis  # noqa: F401

@@ -1,0 +1,85 @@
+"""CLI: ``python -m tools.lint [paths...] [options]``.
+
+Options:
+  --json            machine-readable report (findings/baselined/errors)
+  --changed-only    only files touched vs HEAD (staged+unstaged+untracked)
+  --baseline PATH   baseline file (default tools/lint/baseline.json)
+  --no-baseline     ignore the baseline (report everything)
+  --write-baseline  rewrite the baseline from the current findings
+                    (requires --reason explaining the grandfathering)
+  --reason TEXT     per-entry reason recorded by --write-baseline
+  --gen-docs        regenerate docs/knobs.md and the resilience.md
+                    fault-site table from the registries, then exit
+
+Exit codes (perf_gate conventions): 0 clean, 1 findings, 2 analyzer
+trouble (unparseable file, missing markers, bad baseline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.lint import engine, registry
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="Project invariant analyzer (docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*", help="restrict to these files/dirs")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--changed-only", action="store_true")
+    ap.add_argument("--baseline", default=None)
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--reason", default=None)
+    ap.add_argument("--gen-docs", action="store_true")
+    args = ap.parse_args(argv)
+
+    root = engine.repo_root()
+    if args.gen_docs:
+        try:
+            changed = registry.apply_gen_docs(root)
+        except KeyError as exc:
+            print(f"lint: {exc}", file=sys.stderr)
+            return 2
+        for path in changed:
+            print(f"regenerated {path}")
+        if not changed:
+            print("generated docs already up to date")
+        return 0
+
+    try:
+        findings, repo = engine.run_analysis(
+            root, paths=args.paths or None, changed_only=args.changed_only)
+    except Exception as exc:  # analyzer bug, not a lint finding
+        print(f"lint: internal error: {exc}", file=sys.stderr)
+        return 2
+
+    bl_path = args.baseline or engine.baseline_path(root)
+    if args.write_baseline:
+        if not args.reason:
+            print("lint: --write-baseline requires --reason "
+                  "(docs/static_analysis.md suppression policy)",
+                  file=sys.stderr)
+            return 2
+        engine.write_baseline(bl_path, findings, args.reason)
+        print(f"wrote {len(findings)} finding(s) to {bl_path}")
+        return 0
+
+    try:
+        baseline = {} if args.no_baseline else engine.load_baseline(bl_path)
+    except (ValueError, KeyError) as exc:
+        print(f"lint: bad baseline {bl_path}: {exc}", file=sys.stderr)
+        return 2
+    new, old = engine.split_baselined(findings, baseline)
+    render = engine.render_json if args.json else engine.render_human
+    render(new, old, repo.parse_errors)
+    if repo.parse_errors:
+        return 2
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

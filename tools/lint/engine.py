@@ -1,0 +1,310 @@
+"""Analyzer core: file contexts, suppressions, baseline, reporting.
+
+Exit-code contract (matches `tools/perf_gate.py` conventions): 0 =
+clean (or every finding baselined/suppressed), 1 = at least one new
+finding, 2 = analyzer-level trouble (unparseable file, bad baseline,
+stale generated docs treated as findings still exit 1 — only *our own*
+failures exit 2).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO_MARKERS = ("dbcsr_tpu", "tools")
+
+# scanned roots, repo-relative.  tools/lint itself is excluded: rule
+# messages legitimately carry knob/metric spellings.
+SCAN_ROOTS = ("dbcsr_tpu", "tools", "bench.py")
+SCAN_EXCLUDE = ("tools/lint/",)
+
+_DISABLE_RE = re.compile(r"#\s*lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*lint:\s*disable-file=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+    symbol: str = ""   # enclosing qualname, "" at module level
+
+    def fingerprint(self) -> str:
+        # line numbers deliberately excluded: a baselined finding must
+        # survive unrelated edits above it
+        key = f"{self.rule}|{self.path}|{self.symbol}|{self.message}"
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def as_dict(self) -> dict:
+        return dict(rule=self.rule, path=self.path, line=self.line,
+                    symbol=self.symbol, message=self.message,
+                    fingerprint=self.fingerprint())
+
+
+def walk_scope(fn):
+    """Yield ``fn``'s own nodes WITHOUT descending into nested
+    function/class scopes (unlike ast.walk) — per-scope rules must not
+    attribute a closure's statements to its parent."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class FileCtx:
+    """One parsed source file plus suppression and parent-map info."""
+
+    def __init__(self, root: str, relpath: str):
+        self.root = root
+        self.path = relpath.replace(os.sep, "/")
+        with open(os.path.join(root, relpath), encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=relpath)
+        self.parents: dict = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.line_disables: dict = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _DISABLE_RE.search(line)
+            if m:
+                self.line_disables[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+        self.file_disables: set = set()
+        for line in self.lines[:10]:
+            m = _DISABLE_FILE_RE.search(line)
+            if m:
+                self.file_disables |= {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+
+    # ------------------------------------------------------- scoping
+
+    def enclosing(self, node, kinds=(ast.FunctionDef, ast.AsyncFunctionDef)):
+        """Ancestors of ``node`` of the given kinds, innermost first."""
+        out = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                out.append(cur)
+            cur = self.parents.get(cur)
+        return out
+
+    def qualname(self, node) -> str:
+        parts = [
+            n.name for n in self.enclosing(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))]
+        return ".".join(reversed(parts))
+
+    def func_source(self, fn) -> str:
+        return "\n".join(self.lines[fn.lineno - 1:fn.end_lineno])
+
+    # -------------------------------------------------- suppressions
+
+    def suppressed(self, rule: str, node) -> bool:
+        if rule in self.file_disables:
+            return True
+        lines = {getattr(node, "lineno", 0)}
+        # a disable on the enclosing def/class line covers the body
+        for fn in self.enclosing(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            lines.add(fn.lineno)
+        return any(rule in self.line_disables.get(ln, ()) for ln in lines)
+
+    def finding(self, rule: str, node, message: str):
+        """Build a Finding unless suppressed (returns None then)."""
+        if self.suppressed(rule, node):
+            return None
+        return Finding(rule=rule, path=self.path,
+                       line=getattr(node, "lineno", 1),
+                       message=message, symbol=self.qualname(node))
+
+
+class RepoCtx:
+    """Repo-level context shared by every rule: scanned files plus
+    lazily loaded registries (see tools/lint/registry.py)."""
+
+    def __init__(self, root: str, files: list):
+        self.root = root
+        self.files = files          # list[FileCtx]
+        self.parse_errors: list = []
+
+    def read(self, relpath: str) -> str:
+        p = os.path.join(self.root, relpath)
+        if not os.path.exists(p):
+            return ""
+        with open(p, encoding="utf-8") as f:
+            return f.read()
+
+
+# ----------------------------------------------------------- scanning
+
+def repo_root() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def scan_paths(root: str) -> list:
+    out = []
+    for base in SCAN_ROOTS:
+        full = os.path.join(root, base)
+        if os.path.isfile(full):
+            out.append(base)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for f in sorted(filenames):
+                if not f.endswith(".py"):
+                    continue
+                rel = os.path.relpath(
+                    os.path.join(dirpath, f), root).replace(os.sep, "/")
+                if any(rel.startswith(x) for x in SCAN_EXCLUDE):
+                    continue
+                out.append(rel)
+    return sorted(set(out))
+
+
+def changed_paths(root: str) -> list:
+    """Repo-relative .py paths touched vs HEAD (staged, unstaged,
+    untracked) — the `--changed-only` working set.  A git failure
+    RAISES: silently scanning zero files would report a clean tree
+    that was never checked."""
+    paths: set = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True, timeout=30)
+        except Exception as exc:
+            raise RuntimeError(
+                f"--changed-only needs git ({' '.join(cmd)}: "
+                f"{type(exc).__name__}: {exc})") from exc
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"--changed-only needs git ({' '.join(cmd)}: rc="
+                f"{res.returncode}: {res.stderr.strip()[:200]})")
+        paths |= {line.strip() for line in res.stdout.splitlines()
+                  if line.strip()}
+    return [p for p in sorted(paths) if p.endswith(".py")]
+
+
+# ------------------------------------------------------------ running
+
+def _all_rules():
+    from tools.lint import (rules_conformance, rules_donation, rules_hotpath,
+                            rules_knobs, rules_locks, rules_mutation)
+
+    mods = (rules_mutation, rules_donation, rules_locks, rules_knobs,
+            rules_conformance, rules_hotpath)
+    file_rules, repo_rules = [], []
+    for m in mods:
+        file_rules.extend(getattr(m, "FILE_RULES", ()))
+        repo_rules.extend(getattr(m, "REPO_RULES", ()))
+    return file_rules, repo_rules
+
+
+def run_analysis(root: str | None = None, paths: list | None = None,
+                 changed_only: bool = False) -> tuple:
+    """Run every rule; returns (findings, repo_ctx)."""
+    root = root or repo_root()
+    selected = scan_paths(root)
+    if changed_only:
+        changed = set(changed_paths(root))
+        selected = [p for p in selected if p in changed]
+    if paths:
+        wanted = [p.replace(os.sep, "/").rstrip("/") for p in paths]
+        selected = [p for p in selected
+                    if any(p == w or p.startswith(w + "/") for w in wanted)]
+    files = []
+    repo = RepoCtx(root, files)
+    for rel in selected:
+        try:
+            files.append(FileCtx(root, rel))
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            repo.parse_errors.append(f"{rel}: {exc}")
+    file_rules, repo_rules = _all_rules()
+    findings: list = []
+    for ctx in files:
+        for check in file_rules:
+            findings.extend(f for f in check(ctx, repo) if f is not None)
+    # repo-level registry/doc rules reason over the WHOLE tree (a
+    # "registered but unused" check against a partial file set would
+    # lie), so they only run on full scans
+    if not changed_only and not paths:
+        for check in repo_rules:
+            findings.extend(f for f in check(repo) if f is not None)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, repo
+
+
+# ----------------------------------------------------------- baseline
+
+def baseline_path(root: str) -> str:
+    return os.path.join(root, "tools", "lint", "baseline.json")
+
+
+def load_baseline(path: str) -> dict:
+    """fingerprint -> entry.  Missing file = empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    out = {}
+    for entry in doc.get("findings", []):
+        out[entry["fingerprint"]] = entry
+    return out
+
+
+def write_baseline(path: str, findings: list, reason: str) -> None:
+    doc = {
+        "comment": "Grandfathered analyzer findings. Every entry needs "
+                   "a per-finding reason; new code must not be added "
+                   "here — fix or `# lint: disable=` with a rationale "
+                   "instead (docs/static_analysis.md).",
+        "findings": [
+            dict(f.as_dict(), reason=reason) for f in findings],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def split_baselined(findings: list, baseline: dict) -> tuple:
+    new, old = [], []
+    for f in findings:
+        (old if f.fingerprint() in baseline else new).append(f)
+    return new, old
+
+
+# ---------------------------------------------------------- reporting
+
+def render_human(new: list, old: list, errors: list, out=print) -> None:
+    for f in new:
+        sym = f" [{f.symbol}]" if f.symbol else ""
+        out(f"{f.path}:{f.line}: {f.rule}: {f.message}{sym}")
+    for e in errors:
+        out(f"PARSE ERROR: {e}")
+    out(f"lint: {len(new)} finding(s), {len(old)} baselined, "
+        f"{len(errors)} parse error(s)")
+
+
+def render_json(new: list, old: list, errors: list, out=print) -> None:
+    out(json.dumps({
+        "findings": [f.as_dict() for f in new],
+        "baselined": [f.as_dict() for f in old],
+        "parse_errors": errors,
+        "counts": {"new": len(new), "baselined": len(old),
+                   "errors": len(errors)},
+    }, indent=2))

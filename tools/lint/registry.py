@@ -1,0 +1,182 @@
+"""Registry loaders and doc generators.
+
+The knob registry (`dbcsr_tpu/core/knobs.py`) and the fault-site
+registry (`dbcsr_tpu/resilience/sites.py`) are pure-data modules; the
+analyzer reads them by PARSING, never importing, so it works when jax
+— or dbcsr_tpu itself — is broken.  Config-backed knobs come from the
+`Config` dataclass fields in `dbcsr_tpu/core/config.py` the same way.
+
+Doc generation (`python -m tools.lint --gen-docs`) emits:
+
+* `docs/knobs.md` — the whole file, from KNOBS + Config fields;
+* the fault-site table block of `docs/resilience.md`, between the
+  ``lint:sites`` markers.
+
+The conformance rules re-generate both in memory and flag any drift,
+so the docs cannot silently diverge from the registries again.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+KNOBS_MODULE = "dbcsr_tpu/core/knobs.py"
+SITES_MODULE = "dbcsr_tpu/resilience/sites.py"
+CONFIG_MODULE = "dbcsr_tpu/core/config.py"
+KNOBS_DOC = "docs/knobs.md"
+RESILIENCE_DOC = "docs/resilience.md"
+
+SITES_BEGIN = ("<!-- lint:sites:begin — GENERATED from "
+               "dbcsr_tpu/resilience/sites.py; regenerate with "
+               "`python -m tools.lint --gen-docs` -->")
+SITES_END = "<!-- lint:sites:end -->"
+
+
+def _module_dict(root: str, relpath: str, name: str):
+    """literal_eval the module-level ``name = {...}`` assignment."""
+    src = open(os.path.join(root, relpath), encoding="utf-8").read()
+    for node in ast.parse(src).body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name):
+            return ast.literal_eval(node.value)
+    raise KeyError(f"{relpath}: no module-level `{name} = ...` literal")
+
+
+def load_knobs(root: str) -> dict:
+    return _module_dict(root, KNOBS_MODULE, "KNOBS")
+
+
+def load_sites(root: str) -> dict:
+    return _module_dict(root, SITES_MODULE, "SITES")
+
+
+def load_driver_targets(root: str) -> tuple:
+    return tuple(_module_dict(root, SITES_MODULE, "DRIVER_TARGETS"))
+
+
+def config_fields(root: str) -> list:
+    """(field_name, default_repr) per Config dataclass field."""
+    src = open(os.path.join(root, CONFIG_MODULE), encoding="utf-8").read()
+    for node in ast.parse(src).body:
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            out = []
+            for stmt in node.body:
+                if (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)):
+                    default = (ast.unparse(stmt.value)
+                               if stmt.value is not None else "")
+                    out.append((stmt.target.id, default))
+            return out
+    raise KeyError(f"{CONFIG_MODULE}: no Config dataclass")
+
+
+def config_knob_names(root: str) -> set:
+    return {f"DBCSR_TPU_{name.upper()}" for name, _ in config_fields(root)}
+
+
+def registered_knob_names(root: str) -> set:
+    return set(load_knobs(root)) | config_knob_names(root)
+
+
+# ------------------------------------------------------ doc generation
+
+def gen_knobs_md(root: str) -> str:
+    knobs = load_knobs(root)
+    fields = config_fields(root)
+    lines = [
+        "<!-- GENERATED FILE — do not edit by hand.",
+        "     Sources: dbcsr_tpu/core/knobs.py (runtime/tooling knobs)",
+        "     and dbcsr_tpu/core/config.py (Config-backed knobs).",
+        "     Regenerate: python -m tools.lint --gen-docs -->",
+        "",
+        "# Environment knobs",
+        "",
+        "Every `DBCSR_TPU_*` environment variable the tree reads.  The",
+        "static analyzer (rule `knob-registry`, docs/static_analysis.md)",
+        "fails CI when source grows a knob that is missing here.",
+        "",
+        "## Config-backed knobs",
+        "",
+        "`DBCSR_TPU_<FIELD>` overrides the matching `Config` field",
+        "(`dbcsr_tpu/core/config.py`); values are type-coerced and the",
+        "whole config re-validates, so a typo'd value fails fast.  See",
+        "the field comments in `core/config.py` for full semantics.",
+        "",
+        "| knob | config field | default |",
+        "|---|---|---|",
+    ]
+    for name, default in fields:
+        lines.append(f"| `DBCSR_TPU_{name.upper()}` | `{name}` "
+                     f"| `{default}` |")
+    lines += [
+        "",
+        "## Runtime and tooling knobs",
+        "",
+        "Read directly (outside the `Config` dataclass) by the module",
+        "in the *owner* column.",
+        "",
+        "| knob | owner | description |",
+        "|---|---|---|",
+    ]
+    for name in sorted(knobs):
+        meta = knobs[name]
+        doc = " ".join(meta["doc"].split())
+        lines.append(f"| `{name}` | `{meta['owner']}` | {doc} |")
+    return "\n".join(lines) + "\n"
+
+
+def gen_sites_block(root: str) -> str:
+    sites = load_sites(root)
+    lines = [
+        SITES_BEGIN,
+        "",
+        "| site | boundary | corrupts output | chaos draw |",
+        "|---|---|---|---|",
+    ]
+    for name, meta in sites.items():
+        boundary = " ".join(meta["boundary"].split())
+        corrupt = "yes" if meta["corruptible"] else "no"
+        chaos = "yes" if meta["chaos"] else "no"
+        lines.append(f"| `{name}` | {boundary} | {corrupt} | {chaos} |")
+    lines += ["", SITES_END]
+    return "\n".join(lines)
+
+
+def sites_block_of(text: str):
+    """Extract the generated block from resilience.md, or None."""
+    try:
+        start = text.index(SITES_BEGIN)
+        end = text.index(SITES_END) + len(SITES_END)
+    except ValueError:
+        return None
+    return text[start:end]
+
+
+def apply_gen_docs(root: str) -> list:
+    """Rewrite docs/knobs.md and the resilience.md sites block.
+    Returns the list of files actually changed."""
+    changed = []
+    knobs_path = os.path.join(root, KNOBS_DOC)
+    new = gen_knobs_md(root)
+    old = (open(knobs_path, encoding="utf-8").read()
+           if os.path.exists(knobs_path) else None)
+    if old != new:
+        with open(knobs_path, "w", encoding="utf-8") as f:
+            f.write(new)
+        changed.append(KNOBS_DOC)
+
+    res_path = os.path.join(root, RESILIENCE_DOC)
+    text = open(res_path, encoding="utf-8").read()
+    block = sites_block_of(text)
+    if block is None:
+        raise KeyError(
+            f"{RESILIENCE_DOC}: lint:sites markers not found — cannot "
+            "place the generated fault-site table")
+    new_block = gen_sites_block(root)
+    if block != new_block:
+        with open(res_path, "w", encoding="utf-8") as f:
+            f.write(text.replace(block, new_block))
+        changed.append(RESILIENCE_DOC)
+    return changed

@@ -1,0 +1,163 @@
+"""Rule family 2 — donation safety (``donation-read``).
+
+A buffer passed at a donated position of a ``jax.jit(...,
+donate_argnums=...)`` function is CONSUMED: the runtime may reuse its
+memory for the output, so any later read of that name observes
+garbage (or raises on deleted-buffer access).  The engine's donated
+axpby/superstack helpers all follow this contract; the rule catches a
+new call site that keeps using the donated operand.
+
+Per module: donating callables are resolved from ``jax.jit``
+definitions with ``donate_argnums`` (decorator or assignment form),
+plus the ``*_donated`` naming convention (first argument donated).
+Within each function, a plain-name argument at a donated position is
+marked consumed at the call line; a later load of that name in the
+same function — with no intervening rebind — is flagged.  The check
+is lexical (line order, not CFG): suppress with a reason in the rare
+legitimate case.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import walk_scope
+
+RULE = "donation-read"
+PATH_PREFIXES = ("dbcsr_tpu/",)
+
+
+def _donated_positions(call: ast.Call):
+    """For a `jax.jit(...)`/`functools.partial(jax.jit, ...)` call,
+    the donated argument positions, or None."""
+    fn = call.func
+    is_jit = (isinstance(fn, ast.Attribute) and fn.attr == "jit")
+    is_partial_jit = (
+        isinstance(fn, ast.Attribute) and fn.attr == "partial"
+        and call.args
+        and isinstance(call.args[0], ast.Attribute)
+        and call.args[0].attr == "jit")
+    if not (is_jit or is_partial_jit):
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            try:
+                val = ast.literal_eval(kw.value)
+            except ValueError:
+                return ()
+            if isinstance(val, int):
+                return (val,)
+            if isinstance(val, (tuple, list)):
+                return tuple(v for v in val if isinstance(v, int))
+    return None
+
+
+def _module_donators(tree) -> dict:
+    """name -> donated positions, for module/class-level definitions."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    pos = _donated_positions(dec)
+                    if pos:
+                        out[node.name] = pos
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            pos = _donated_positions(node.value)
+            if pos:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = pos
+    return out
+
+
+def _callee_name(call: ast.Call):
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _branch_arms(ctx, node):
+    """(id(If/Try node), arm) pairs on the path from ``node`` to the
+    module — two nodes diverging at the same branch are mutually
+    exclusive at run time."""
+    arms = []
+    child, cur = node, ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.If, ast.Try)):
+            for arm in ("body", "orelse", "handlers", "finalbody"):
+                sub = getattr(cur, arm, None) or ()
+                if child in sub:
+                    arms.append((id(cur), arm))
+                    break
+        child, cur = cur, ctx.parents.get(cur)
+    return arms
+
+
+def _exclusive(ctx, a, b) -> bool:
+    arms_a = dict(_branch_arms(ctx, a))
+    return any(arms_a.get(k, arm) != arm for k, arm in _branch_arms(ctx, b))
+
+
+def _check(ctx, repo):
+    if not ctx.path.startswith(PATH_PREFIXES):
+        return []
+    donators = _module_donators(ctx.tree)
+    out = []
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        consumed: dict = {}   # name -> (call node, callee)
+        rebinds: dict = {}    # name -> rebind lines
+        loads: list = []      # (name, node)
+        for node in walk_scope(fn):
+            if isinstance(node, ast.Call):
+                callee = _callee_name(node)
+                pos = donators.get(callee)
+                if pos is None and callee and callee.endswith("_donated"):
+                    pos = (0,)
+                if pos:
+                    for p in pos:
+                        if p < len(node.args) and isinstance(
+                                node.args[p], ast.Name):
+                            name = node.args[p].id
+                            prev = consumed.get(name)
+                            if prev is None or node.lineno < prev[0].lineno:
+                                consumed[name] = (node, callee)
+            elif isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    loads.append((node.id, node))
+                elif isinstance(node.ctx, ast.Store):
+                    rebinds.setdefault(node.id, []).append(node.lineno)
+        for name, (call, callee) in consumed.items():
+            cline = call.lineno
+            # a rebind at/after the call line (`x = f(x)` included)
+            # ends the consumed window
+            rebound = [ln for ln in rebinds.get(name, ()) if ln >= cline]
+            barrier = min(rebound) if rebound else None
+            for lname, node in loads:
+                # reads inside the donating call itself (multi-line
+                # argument lists) are the donation, not a use-after
+                if lname != name or node.lineno <= call.end_lineno:
+                    continue
+                if barrier is not None and node.lineno >= barrier:
+                    continue
+                if _exclusive(ctx, call, node):
+                    continue  # donating branch never reaches this read
+                # no line numbers in the message: it feeds the
+                # baseline fingerprint, which must survive line drift
+                f = ctx.finding(
+                    RULE, node,
+                    f"`{name}` read after being donated to `{callee}` "
+                    "earlier in this function: the buffer may already "
+                    "be reused for the output — copy before donating, "
+                    "or rebind the name")
+                if f is not None:
+                    out.append(f)
+                break  # one finding per consumed name is enough
+    return out
+
+
+FILE_RULES = [_check]

@@ -1,0 +1,53 @@
+"""Rule family 6 — hot-path sync lint (``hot-sync``).
+
+A `jax.block_until_ready` (or a device-array ``.item()``) inside the
+timed hot regions of ``mm/``, ``acc/``, ``parallel/`` serializes the
+dispatch pipeline: the whole async-dispatch design (and every number
+perf_gate trusts) assumes the engine never fences mid-multiply.  The
+ONE sanctioned seam is the documented sync-timing machinery
+(``DBCSR_TPU_SYNC_TIMING`` via `core.stats.sync_timing_enabled`, and
+`utils.sync.fetch_fence` for honest benchmark fencing).
+
+A fence call is allowed when an enclosing function (any level up)
+references the seam — ``sync_timing_enabled`` / ``_sync_timing`` /
+``fetch_fence`` — i.e. the fence is behind the opt-in gate; anything
+else is flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+RULE = "hot-sync"
+PATH_PREFIXES = ("dbcsr_tpu/mm/", "dbcsr_tpu/acc/", "dbcsr_tpu/parallel/")
+SEAM_TOKENS = ("sync_timing_enabled", "_sync_timing", "fetch_fence")
+FENCES = {"block_until_ready", "item"}
+
+
+def _check(ctx, repo):
+    if not ctx.path.startswith(PATH_PREFIXES):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in FENCES):
+            continue
+        if node.func.attr == "item" and node.args:
+            continue  # .item(i) on host containers, not a device fetch
+        chain = ctx.enclosing(node)
+        if any(tok in ctx.func_source(fn)
+               for fn in chain for tok in SEAM_TOKENS):
+            continue
+        f = ctx.finding(
+            RULE, node,
+            f"`{node.func.attr}` fences the device inside a timed hot "
+            "region: gate it behind `stats.sync_timing_enabled()` (the "
+            "DBCSR_TPU_SYNC_TIMING seam) or fence through "
+            "`utils.sync.fetch_fence` in benchmark code")
+        if f is not None:
+            out.append(f)
+    return out
+
+
+FILE_RULES = [_check]

@@ -1,0 +1,90 @@
+"""Rule family 4 — knob registry (``knob-registry`` / ``knob-docs``).
+
+Every exact ``DBCSR_TPU_*`` string in source (env read, setdefault, a
+helper like ``_env_float("DBCSR_TPU_X", d)``) must be either a
+Config-field knob (``DBCSR_TPU_<FIELD>``, validated by
+`Config.validate`) or an entry in the checked registry
+`dbcsr_tpu/core/knobs.py`.  An unregistered knob is invisible to
+operators and to the generated docs — the ~47-env-read drift this PR
+closes.
+
+Repo-level ``knob-docs`` keeps the generated artifacts honest:
+`docs/knobs.md` must byte-match regeneration from the registries, and
+a registry entry whose knob no longer appears anywhere in source is
+dead weight.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint import registry
+from tools.lint.engine import Finding
+
+RULE = "knob-registry"
+RULE_DOCS = "knob-docs"
+KNOB_RE = re.compile(r"^DBCSR_TPU_[A-Z0-9_]+$")
+
+
+def knob_constants(tree):
+    """Every exact-knob string Constant in the tree, with its node."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and KNOB_RE.match(node.value)):
+            yield node.value, node
+
+
+def _check(ctx, repo):
+    registered = _registered(repo)
+    out = []
+    seen = set()
+    for name, node in knob_constants(ctx.tree):
+        if name in registered or name in seen:
+            continue
+        seen.add(name)  # one finding per knob per file
+        f = ctx.finding(
+            RULE, node,
+            f"`{name}` is not a registered knob: add an entry to "
+            "dbcsr_tpu/core/knobs.py (or a Config field) and run "
+            "`python -m tools.lint --gen-docs`")
+        if f is not None:
+            out.append(f)
+    return out
+
+
+def _registered(repo):
+    cached = getattr(repo, "_knobs_registered", None)
+    if cached is None:
+        cached = registry.registered_knob_names(repo.root)
+        repo._knobs_registered = cached
+    return cached
+
+
+def _check_docs(repo):
+    out = []
+    # generated docs freshness
+    want = registry.gen_knobs_md(repo.root)
+    have = repo.read(registry.KNOBS_DOC)
+    if have != want:
+        out.append(Finding(
+            rule=RULE_DOCS, path=registry.KNOBS_DOC, line=1,
+            message="stale generated file: run "
+                    "`python -m tools.lint --gen-docs`"))
+    # dead registry entries (scanned-tree knob spellings, incl. those
+    # only referenced through env helpers)
+    in_source = set()
+    for ctx in repo.files:
+        for name, _ in knob_constants(ctx.tree):
+            in_source.add(name)
+    for name in sorted(set(registry.load_knobs(repo.root)) - in_source):
+        out.append(Finding(
+            rule=RULE_DOCS, path=registry.KNOBS_MODULE, line=1,
+            symbol=name,
+            message=f"registry entry `{name}` is read nowhere in the "
+                    "scanned tree: remove it (or wire the knob up)"))
+    return out
+
+
+FILE_RULES = [_check]
+REPO_RULES = [_check_docs]

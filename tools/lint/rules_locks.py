@@ -1,0 +1,210 @@
+"""Rule family 3 — concurrency hygiene.
+
+``lock-mixed-write``: in a lock-owning class (or a module with a
+module-level lock), state that is written under the lock in one place
+and without it in another is a race by construction — one of the two
+sites is wrong.  Helpers the caller invokes with the lock already held
+are exempted by convention: name them ``*_locked`` (or say "caller
+holds"/"lock held" in the docstring).
+
+``lock-callback``: a callback that can re-enter the event bus
+(publish/note_event/instant/maybe_sample/observe_serve) invoked while
+holding a lock is the PR-11 deferred-sample deadlock class — the bus
+fan-out takes its own locks and may call back into the sampling path.
+Move the emission outside the critical section (collect under the
+lock, publish after release).
+"""
+
+from __future__ import annotations
+
+import ast
+
+RULE_MIXED = "lock-mixed-write"
+RULE_CALLBACK = "lock-callback"
+PATH_PREFIXES = ("dbcsr_tpu/",)
+CALLBACK_SINKS = {"publish", "_publish", "note_event", "instant",
+                  "maybe_sample", "observe_serve"}
+LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _is_lock_ctor(node) -> bool:
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, (ast.Attribute, ast.Name))):
+        return False
+    name = (node.func.attr if isinstance(node.func, ast.Attribute)
+            else node.func.id)
+    if name == "wrap":  # utils.lockcheck.wrap("name", Lock())
+        return any(_is_lock_ctor(a) for a in node.args)
+    return name in LOCK_CTORS
+
+
+def _module_lock_names(tree) -> set:
+    out = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            out |= {t.id for t in node.targets if isinstance(t, ast.Name)}
+    return out
+
+
+def _class_lock_attrs(cls) -> set:
+    out = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    out.add(t.attr)
+    return out
+
+
+def _locked_item(item, lock_attrs: set, module_locks: set) -> bool:
+    e = item.context_expr
+    if (isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name)
+            and e.value.id == "self" and e.attr in lock_attrs):
+        return True
+    return isinstance(e, ast.Name) and e.id in module_locks
+
+
+def _classify(node, held, lock_attrs, module_locks, visit):
+    """DFS calling ``visit(node, held)`` on every node, with ``held``
+    tracking whether a registered lock's ``with`` block encloses it.
+    Nested function/class scopes are skipped — they get their own
+    top-level pass (and a closure does not inherit the caller's
+    critical section at run time anyway)."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    if isinstance(node, ast.With):
+        now = held or any(
+            _locked_item(i, lock_attrs, module_locks) for i in node.items)
+        for item in node.items:
+            _classify(item, held, lock_attrs, module_locks, visit)
+        for stmt in node.body:
+            _classify(stmt, now, lock_attrs, module_locks, visit)
+        return
+    visit(node, held)
+    for child in ast.iter_child_nodes(node):
+        _classify(child, held, lock_attrs, module_locks, visit)
+
+
+def _caller_holds(fn, src: str) -> bool:
+    return (fn.name.endswith("_locked") or "caller holds" in src
+            or "lock held" in src or "holding the" in src)
+
+
+def _function_sites(fn, lock_attrs, module_locks):
+    """(self-attr stores, module-global stores, callback calls under a
+    lock); stores are (node, name, held)."""
+    globals_declared: set = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            globals_declared |= set(node.names)
+    attr_stores, global_stores, callbacks = [], [], []
+
+    def visit(node, held):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self" and t.attr not in lock_attrs):
+                attr_stores.append((node, t.attr, held))
+            if isinstance(t, ast.Name) and t.id in globals_declared:
+                global_stores.append((node, t.id, held))
+        if (held and isinstance(node, ast.Call)
+                and isinstance(node.func, (ast.Attribute, ast.Name))):
+            callee = (node.func.attr if isinstance(node.func, ast.Attribute)
+                      else node.func.id)
+            if callee in CALLBACK_SINKS:
+                callbacks.append((node, callee))
+
+    for stmt in fn.body:
+        _classify(stmt, False, lock_attrs, module_locks, visit)
+    return attr_stores, global_stores, callbacks
+
+
+def _check(ctx, repo):
+    if not ctx.path.startswith(PATH_PREFIXES):
+        return []
+    out = []
+    module_locks = _module_lock_names(ctx.tree)
+
+    def flag_callbacks(callbacks, where):
+        for node, callee in callbacks:
+            f = ctx.finding(
+                RULE_CALLBACK, node,
+                f"`{callee}` invoked while holding a lock of {where}: "
+                "event-bus re-entry can deadlock or re-enter sampling "
+                "(the PR-11 deferred-sample bug class) — emit after "
+                "releasing the lock")
+            if f is not None:
+                out.append(f)
+
+    # ---- class-owned state -----------------------------------------
+    class_spans = []
+    for cls in [n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)]:
+        class_spans.append((cls.lineno, cls.end_lineno))
+        lock_attrs = _class_lock_attrs(cls)
+        if not lock_attrs:
+            continue
+        locked_attrs: set = set()
+        unlocked: list = []
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stores, _, callbacks = _function_sites(
+                fn, lock_attrs, module_locks)
+            flag_callbacks(callbacks, f"`{cls.name}`")
+            if fn.name == "__init__":
+                continue
+            exempt = _caller_holds(fn, ctx.func_source(fn))
+            for node, attr, held in stores:
+                if held:
+                    locked_attrs.add(attr)
+                elif not exempt:
+                    unlocked.append((node, attr))
+        for node, attr in unlocked:
+            if attr not in locked_attrs:
+                continue
+            f = ctx.finding(
+                RULE_MIXED, node,
+                f"`self.{attr}` written without the lock here but under "
+                f"it elsewhere in `{cls.name}`: take the lock, or name "
+                "the helper `*_locked` if the caller holds it")
+            if f is not None:
+                out.append(f)
+
+    # ---- module-level state ----------------------------------------
+    if module_locks:
+        locked_globals: set = set()
+        unlocked_g: list = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if any(a <= fn.lineno <= b for a, b in class_spans):
+                continue  # methods handled above
+            _, gstores, callbacks = _function_sites(fn, set(), module_locks)
+            flag_callbacks(callbacks, f"module `{ctx.path}`")
+            exempt = _caller_holds(fn, ctx.func_source(fn))
+            for node, name, held in gstores:
+                if held:
+                    locked_globals.add(name)
+                elif not exempt:
+                    unlocked_g.append((node, name))
+        for node, name in unlocked_g:
+            if name not in locked_globals:
+                continue
+            f = ctx.finding(
+                RULE_MIXED, node,
+                f"module global `{name}` written without the module "
+                "lock here but under it elsewhere: take the lock, or "
+                "note \"caller holds\" in the helper's docstring")
+            if f is not None:
+                out.append(f)
+    return out
+
+
+FILE_RULES = [_check]

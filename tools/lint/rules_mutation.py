@@ -1,0 +1,135 @@
+"""Rule family 1 — mutation-funnel contract (``mutation-epoch``).
+
+Every consumer of the dirty-block machinery (the incremental multiply
+of `mm/incremental.py`, the serve-layer product cache, value digests)
+trusts that any code writing matrix bin storage also records a
+mutation epoch (`BlockSparseMatrix._note_mutation`).  A funnel that
+forgets the bump serves STALE cached products — a silent-corruption
+class, not a style nit.
+
+Heuristic (scope-granular, not path-sensitive): inside
+``dbcsr_tpu/{core,ops,mm,serve}``, a function that stores to a
+``.data`` attribute while also touching ``bins``, or stores to a
+``.bins`` attribute/element, must contain (or be nested inside a
+function containing) a `_note_mutation` / `map_bin_data` call.
+
+Exemptions: constructors (`__init__`, `copy`) and stores to objects
+PROVABLY fresh in the same function — assigned from
+``BlockSparseMatrix(...)`` or ``copy(...)``, or loop variables over a
+fresh object's ``.bins`` — no consumer can hold an epoch snapshot of
+a matrix that did not exist when the function began.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import walk_scope
+
+RULE = "mutation-epoch"
+PATH_PREFIXES = ("dbcsr_tpu/core/", "dbcsr_tpu/ops/", "dbcsr_tpu/mm/",
+                 "dbcsr_tpu/serve/")
+EXEMPT_FUNCS = {"__init__", "copy"}
+NOTERS = {"_note_mutation", "map_bin_data"}
+FRESH_CTORS = {"BlockSparseMatrix", "copy"}
+
+
+def _base_name(node):
+    """The root Name of an attribute/subscript chain, or None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _fresh_names(fn) -> set:
+    """Names bound in ``fn`` to objects that did not exist at entry."""
+    fresh: set = set()
+    # source order matters: a loop over `fresh.bins` can only be
+    # recognized after the ctor assign that made the base fresh
+    for node in sorted(walk_scope(fn), key=lambda n: getattr(n, "lineno", 0)):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = node.value.func
+            name = (callee.id if isinstance(callee, ast.Name)
+                    else callee.attr if isinstance(callee, ast.Attribute)
+                    else None)
+            if name in FRESH_CTORS:
+                fresh |= {t.id for t in node.targets
+                          if isinstance(t, ast.Name)}
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            # for b in fresh.bins / for i, b in enumerate(fresh.bins)
+            it = node.iter
+            if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                    and it.func.id == "enumerate" and it.args):
+                it = it.args[0]
+            if (isinstance(it, ast.Attribute) and it.attr == "bins"
+                    and _base_name(it) in fresh):
+                targets = (node.target.elts
+                           if isinstance(node.target, ast.Tuple)
+                           else [node.target])
+                fresh |= {t.id for t in targets if isinstance(t, ast.Name)}
+    return fresh
+
+
+def _bin_data_store(node, func_src: str, fresh: set):
+    """The store target if ``node`` writes bin storage of a
+    non-fresh object, else None."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    for t in targets:
+        hit = False
+        if isinstance(t, ast.Attribute) and t.attr == "bins":
+            hit = True
+        elif (isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Attribute)
+                and t.value.attr == "bins"):
+            hit = True
+        elif (isinstance(t, ast.Attribute) and t.attr == "data"
+                and "bins" in func_src):
+            hit = True
+        if hit and _base_name(t) not in fresh:
+            return t
+    return None
+
+
+def _notes(fn) -> bool:
+    return any(
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in NOTERS
+        for node in walk_scope(fn))
+
+
+def _check(ctx, repo):
+    if not ctx.path.startswith(PATH_PREFIXES):
+        return []
+    out = []
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name in EXEMPT_FUNCS or fn.name in NOTERS:
+            continue
+        src = ctx.func_source(fn)
+        fresh = _fresh_names(fn)
+        store = None
+        for node in walk_scope(fn):
+            store = _bin_data_store(node, src, fresh)
+            if store is not None:
+                break
+        if store is None:
+            continue
+        if _notes(fn) or any(_notes(outer) for outer in ctx.enclosing(fn)):
+            continue
+        out.append(ctx.finding(
+            RULE, store,
+            "bin data written without recording a mutation epoch: "
+            "call `_note_mutation(keys)` (or funnel through "
+            "`map_bin_data`) on every path that stores bin data, or "
+            "the incremental-multiply/product-cache planes serve "
+            "stale results"))
+    return out
+
+
+FILE_RULES = [_check]
