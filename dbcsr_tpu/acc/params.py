@@ -98,6 +98,34 @@ def _key(m: int, n: int, k: int, dtype, stack_size) -> str:
     return f"{m}x{n}x{k}:{np.dtype(dtype).name}:{int(stack_size)}"
 
 
+def generation() -> int:
+    """The parameter-table generation counter: bumped by `save_entry`,
+    `delete_entry` and `invalidate`.  Plan caches that bake tuned
+    parameters into a cached plan (``mm/multiply``'s `_plan_cache`, the
+    fused superstack decisions cached next to it) key on this value, so
+    a promotion/demotion by the online tuner (`dbcsr_tpu.tune`) retires
+    every stale plan at its next lookup — no plan ever serves old
+    parameters."""
+    return _table_gen
+
+
+def invalidate() -> int:
+    """Drop the module-level table caches and bump the generation.
+
+    The promotion seam for writers that bypass `save_entry` (the tune
+    store's atomic file replace, an external tuner process updating the
+    params dir): without it a process keeps serving the in-memory table
+    it loaded at import forever.  Returns the new generation."""
+    global _table_gen
+    with _lock:
+        _cache.clear()
+        _shape_index.clear()
+        _onchip_flag.clear()
+        _predict_cache.clear()
+        _table_gen += 1
+        return _table_gen
+
+
 def _load(kind: Optional[str] = None) -> Dict:
     # keyed by the RESOLVED path, so redirecting DBCSR_TPU_PARAMS_DIR
     # mid-process is honored without manual cache clearing
@@ -299,3 +327,28 @@ def save_entry(entry: Dict, kind: Optional[str] = None) -> str:
         _table_gen += 1
         _predict_cache.clear()
     return path
+
+
+def delete_entry(m: int, n: int, k: int, dtype, stack_size,
+                 kind: Optional[str] = None) -> bool:
+    """Remove one row from the device's parameter file (the tune
+    store's demotion path — `save_entry`'s mirror).  Returns whether a
+    row was actually removed; the generation bumps either way only on a
+    real removal."""
+    kind = kind or device_kind()
+    table = _load(kind)
+    key = _key(m, n, k, dtype, stack_size)
+    with _lock:
+        if key not in table:
+            return False
+        del table[key]
+        os.makedirs(_params_dir(), exist_ok=True)
+        path = params_path(kind)
+        with open(path, "w") as f:
+            json.dump(sorted(table.values(),
+                             key=lambda e: (e["m"], e["n"], e["k"])),
+                      f, indent=1)
+        global _table_gen
+        _table_gen += 1
+        _predict_cache.clear()
+    return True

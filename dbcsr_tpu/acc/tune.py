@@ -54,8 +54,18 @@ def _time_config(fn, nrep: int) -> float:
 
 
 def tune_smm(m: int, n: int, k: int, dtype_enum: int = 1,
-             stack_size: int = 30000, nrep: int = 3, out=print, seed=7):
-    """Tune one (m, n, k, dtype); returns and persists the best entry."""
+             stack_size: int = 30000, nrep: int = 3, out=print, seed=7,
+             persist: bool = True, candidates_out=None):
+    """Tune one (m, n, k, dtype); returns (and, with ``persist``, saves
+    into the device table) the best entry.
+
+    ``persist=False`` runs the identical candidate sweep without
+    touching the parameter table — the online tuner's trial mode
+    (`dbcsr_tpu.tune.trials`), where the PROMOTION STORE decides what
+    lands.  ``candidates_out``, when a list, receives every timed
+    candidate dict (driver/grouping/precision/gflops) so the caller can
+    re-rank them under its own policy (breaker-aware winner selection).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -63,26 +73,35 @@ def tune_smm(m: int, n: int, k: int, dtype_enum: int = 1,
     # calling tune_smm() keeps its global x64 setting
     with _enable_x64(True):
         return _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed,
-                             jax, jnp)
+                             jax, jnp, persist, candidates_out)
 
 
 class _Candidates(list):
     """Candidate list that persists the best row after every append: a
     later candidate that crashes the PROCESS (a Mosaic fatal error
     aborts before Python sees an exception) must not lose the timings
-    already measured — the sweep's resumability contract."""
+    already measured — the sweep's resumability contract.  With
+    ``persist=False`` (trial mode) nothing is written; the caller owns
+    promotion."""
 
-    def __init__(self, m, n, k, dtype, stack_size, out):
+    def __init__(self, m, n, k, dtype, stack_size, out, persist=True,
+                 mirror=None):
         super().__init__()
         self._row = {"m": m, "n": n, "k": k, "dtype": np.dtype(dtype).name,
                      "stack_size": stack_size, "env": _measure_env()}
         self._out = out
         self._best = None
+        self._persist = persist
+        self._mirror = mirror
 
     def append(self, cand) -> None:
         super().append(cand)
+        if self._mirror is not None:
+            self._mirror.append(dict(cand))
         if self._best is None or cand["gflops"] > self._best:
             self._best = cand["gflops"]
+            if not self._persist:
+                return
             entry = {**self._row, **cand,
                      "gflops": round(cand["gflops"], 2)}
             try:
@@ -91,7 +110,8 @@ class _Candidates(list):
                 self._out(f"  (best-so-far persist failed: {exc})")
 
 
-def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
+def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp,
+                  persist=True, candidates_out=None):
 
     from dbcsr_tpu.acc import pallas_smm
     from dbcsr_tpu.acc.smm import _process_stack_xla, _process_stack_xla_flat
@@ -107,7 +127,8 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
     bi = rng.integers(0, nb - 1, stack_size).astype(np.int32)
     ci = np.sort(rng.integers(0, nc, stack_size)).astype(np.int32)
     flops = 2.0 * m * n * k * stack_size
-    candidates = _Candidates(m, n, k, dtype, stack_size, out)
+    candidates = _Candidates(m, n, k, dtype, stack_size, out,
+                             persist=persist, mirror=candidates_out)
 
     # XLA gather/segment-sum path (always available)
     chunk = bucket_size(min(stack_size, 30000))
@@ -351,9 +372,13 @@ def _tune_smm_x64(m, n, k, dtype_enum, stack_size, nrep, out, seed, jax, jnp):
         "stack_size": stack_size, "env": _measure_env(), **best,
         "gflops": round(best["gflops"], 2),
     }
-    path = params_mod.save_entry(entry)
-    out(f"best: {entry['driver']} grouping={entry['grouping']} "
-        f"{entry['gflops']} GFLOP/s -> {path}")
+    if persist:
+        path = params_mod.save_entry(entry)
+        out(f"best: {entry['driver']} grouping={entry['grouping']} "
+            f"{entry['gflops']} GFLOP/s -> {path}")
+    else:
+        out(f"best (trial, not persisted): {entry['driver']} "
+            f"grouping={entry['grouping']} {entry['gflops']} GFLOP/s")
     return entry
 
 

@@ -287,6 +287,58 @@ KNOBS = {
         "doc": "trace control: path writes the Perfetto/Chrome JSON "
                "trace, '1' enables in-memory tracing.",
     },
+    "DBCSR_TPU_TUNE": {
+        "owner": "tune/service.py",
+        "doc": "=1 starts the online autotuning service alongside the "
+               "serving plane (serve engine start/shutdown own its "
+               "lifecycle); unset/0 leaves tuning manual "
+               "(docs/autotuning.md).",
+    },
+    "DBCSR_TPU_TUNE_BUDGET_BYTES": {
+        "owner": "tune/trials.py",
+        "doc": "per-trial operand byte budget: the trial stack size is "
+               "clamped so staged A/B/C temporaries stay under it "
+               "(default 64 MiB).",
+    },
+    "DBCSR_TPU_TUNE_BUDGET_S": {
+        "owner": "tune/trials.py",
+        "doc": "wall budget for one tuning trial's candidate sweep, "
+               "seconds: checked after every timed leg (the sweep "
+               "stops, keeping the legs already measured) and doubling "
+               "as the tune_trial watchdog deadline.",
+    },
+    "DBCSR_TPU_TUNE_DEMOTE_RATIO": {
+        "owner": "tune/store.py",
+        "doc": "demotion-on-regression judge: a promoted row is demoted "
+               "when its driver's live roofline fraction falls below "
+               "this fraction of the at-promotion value (default 0.5).",
+    },
+    "DBCSR_TPU_TUNE_FLOOR": {
+        "owner": "tune/miner.py",
+        "doc": "per-device roofline-fraction floor below which a live "
+               "(driver, mnk, dtype) cell counts as underperforming "
+               "(default 0.25).",
+    },
+    "DBCSR_TPU_TUNE_INTERVAL_S": {
+        "owner": "tune/service.py",
+        "doc": "background tuner cycle cadence, seconds (default 60).",
+    },
+    "DBCSR_TPU_TUNE_MARGIN": {
+        "owner": "tune/service.py",
+        "doc": "minimum relative GFLOP/s uplift over the incumbent "
+               "row/prediction before a trial winner is promoted "
+               "(default 0.05).",
+    },
+    "DBCSR_TPU_TUNE_MAX_CELLS": {
+        "owner": "tune/miner.py",
+        "doc": "bound on the mined candidate-cell queue per cycle "
+               "(default 32).",
+    },
+    "DBCSR_TPU_TUNE_NREP": {
+        "owner": "tune/trials.py",
+        "doc": "timing repetitions per candidate leg inside a tuning "
+               "trial (default 2).",
+    },
     "DBCSR_TPU_TS": {
         "owner": "obs/timeseries.py",
         "doc": "telemetry history store: '0'/'off' disables, a path "

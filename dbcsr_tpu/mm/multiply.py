@@ -293,7 +293,10 @@ def _multiply_body(a, b, c, alpha, beta, retain_sparsity, filter_eps,
             (cfg_.mm_driver, cfg_.use_pallas, cfg_.flat_gather,
              cfg_.mm_stack_size, cfg_.max_kernel_dim,
              cfg_.validate_kernels),
-            params_mod._table_gen,
+            # params-table generation: a tuner promotion/demotion
+            # (dbcsr_tpu.tune, or any save_entry/invalidate) bumps it,
+            # so a cached plan can never serve superseded parameters
+            params_mod.generation(),
             # executed-precision state: an adaptive promotion or a
             # chain-scope transition must never be served a cached
             # demoted plan (acc.precision bumps its generation on both)

@@ -462,9 +462,43 @@ def _collect_value_reuse() -> list:
     return pts
 
 
+def _collect_tune() -> list:
+    """Online-autotuner plane (dbcsr_tpu.tune): trial/promotion/
+    demotion counters, the mined-queue depth and cycle duration, and
+    the params-table generation (a counter: every promotion/demotion
+    bumps it, so `doctor --trend` can line parameter changes up
+    against the roofline cells they were meant to move)."""
+    import sys
+
+    pts: list = []
+    from dbcsr_tpu.obs import metrics
+
+    for name in ("dbcsr_tpu_tune_trials_total",
+                 "dbcsr_tpu_tune_promotions_total",
+                 "dbcsr_tpu_tune_demotions_total"):
+        for labels, v in metrics.counter_items(name):
+            pts.append((name, labels, v, COUNTER))
+    svc_mod = sys.modules.get("dbcsr_tpu.tune.service")
+    svc = svc_mod.current_service() if svc_mod is not None else None
+    if svc is not None:  # never CREATE a service just to sample it
+        snap = svc.snapshot()
+        pts.append(("dbcsr_tpu_tune_queue_depth", {},
+                    snap["queue_depth"], GAUGE))
+        pts.append(("dbcsr_tpu_tune_cycle_seconds", {},
+                    snap["last_cycle_s"], GAUGE))
+    pm = sys.modules.get("dbcsr_tpu.acc.params")
+    if pm is not None:
+        try:
+            pts.append(("dbcsr_tpu_params_generation", {},
+                        pm.generation(), COUNTER))
+        except Exception:
+            pass
+    return pts
+
+
 _COLLECTORS = (_collect_engine, _collect_serve, _collect_breakers,
                _collect_pool, _collect_integrity, _collect_precision,
-               _collect_value_reuse, _collect_health)
+               _collect_value_reuse, _collect_tune, _collect_health)
 
 
 # ------------------------------------------------------------ sampling

@@ -98,6 +98,18 @@ SITES = {
                     "`request_id`, `n`)",
         "corruptible": True, "chaos": True, "dynamic": False,
     },
+    "tune_trial": {
+        "boundary": "the online autotuner's trial boundary "
+                    "(`tune.trials`, one per candidate sweep; labels "
+                    "`mnk`, `dtype`) — a fault aborts the trial and NO "
+                    "promotion may land from it "
+                    "(`docs/autotuning.md` § trial runner)",
+        # off the hot path by construction: a faulted trial is absorbed
+        # by the tuner (counted, never promoted); in the randomized
+        # chaos draw the spec simply never fires outside the dedicated
+        # tune_storm corpus case, which also drives it deterministically
+        "corruptible": False, "chaos": True, "dynamic": False,
+    },
 }
 
 # driver labels a fault spec's *target* may also match at a site

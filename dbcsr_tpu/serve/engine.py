@@ -104,6 +104,24 @@ class ServeEngine:
                 self.replay_journal(path)
             except Exception:
                 pass  # the journal survives; replay can be re-invoked
+        # the online autotuner rides the serving plane's lifecycle
+        # (DBCSR_TPU_TUNE=1): its cycles defer themselves whenever
+        # admission is not OK, so it can never compete with traffic.
+        # Ownership is recorded: only the engine whose start() actually
+        # STARTED the service stops it at shutdown — a second engine
+        # (diagnostic tool, drain/restart overlap) or an explicitly
+        # started embedder service must not lose its tuner to a
+        # bystander's shutdown.
+        self._tuner_owned = False
+        try:
+            from dbcsr_tpu.tune import service as _tune_service
+
+            svc = _tune_service.current_service()
+            already = svc is not None and svc.running
+            started = _tune_service.maybe_start_from_env()
+            self._tuner_owned = started is not None and not already
+        except Exception:
+            pass  # a broken tuner must never block serving
 
     # ------------------------------------------------------ drain/restart
 
@@ -322,6 +340,18 @@ class ServeEngine:
             self.queue.release(req)
             req._finish("failed", outcome=WEDGED,
                         error="serving plane shut down")
+        # tuner THIS engine started (see start()): dies with the plane
+        # it rode; a tuner started elsewhere is left running
+        try:
+            if getattr(self, "_tuner_owned", False):
+                import sys
+
+                ts_mod = sys.modules.get("dbcsr_tpu.tune.service")
+                if ts_mod is not None:
+                    ts_mod.stop_service()
+                self._tuner_owned = False
+        except Exception:
+            pass
 
     # --------------------------------------------------------------- submit
 

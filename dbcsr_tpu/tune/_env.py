@@ -1,0 +1,24 @@
+"""One env-knob parse helper pair for the tune package.
+
+miner/trials/store/service each read DBCSR_TPU_TUNE_* knobs; this is
+their single coercion implementation (a malformed value falls back to
+the default, the registry/docs convention) instead of four drifting
+private copies."""
+
+from __future__ import annotations
+
+import os
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default

@@ -156,7 +156,7 @@ def test_endpoint_serves_metrics_and_healthz(endpoint):
     v = json.loads(body)
     assert v["status"] in ("OK", "DEGRADED")
     assert set(v["components"]) == {"drivers", "watchdog", "engine",
-                                    "perf", "integrity", "slo"}
+                                    "perf", "integrity", "slo", "tune"}
 
 
 def test_endpoint_serves_flight_and_filtered_events(endpoint):
@@ -371,7 +371,8 @@ def test_doctor_runbook_anchors_exist():
     docs = {"resilience.md": anchors_of("resilience.md"),
             "serving.md": anchors_of("serving.md"),
             "observability.md": anchors_of("observability.md"),
-            "static_analysis.md": anchors_of("static_analysis.md")}
+            "static_analysis.md": anchors_of("static_analysis.md"),
+            "autotuning.md": anchors_of("autotuning.md")}
     for kind, (_, anchor) in doctor.HINTS.items():
         if anchor.startswith("docs/"):
             doc, frag = anchor[len("docs/"):].split("#", 1)

@@ -159,6 +159,69 @@ def test_tuner_stamps_real_platform_env():
         set_config(platform_override="")
 
 
+# ------------------------------------------------- promoted rows (tune)
+
+def test_promoted_row_outranks_donor_prediction(table):
+    """A tuner-promoted exact row is real evidence: it must win over a
+    nearest-donor prediction from a neighboring shape of equal
+    provenance quality."""
+    from dbcsr_tpu.tune import store
+
+    donor = {"m": 32, "n": 32, "k": 32, "dtype": "float64",
+             "stack_size": 30000, "driver": "xla_group", "r0": 8,
+             "grouping": None, "gflops": 2.0, "env": "cpu"}
+    _write(table, [donor])
+    got = params_mod.predict(23, 23, 23, np.float64, stack_size=30000)
+    assert got["predicted_from"] == (32, 32, 32)  # donor before tuning
+    store.promote({"m": 23, "n": 23, "k": 23, "dtype": "float64",
+                   "stack_size": 30000, "driver": "host",
+                   "grouping": None, "gflops": 4.0, "env": "cpu"})
+    got = params_mod.predict(23, 23, 23, np.float64, stack_size=30000)
+    assert got["driver"] == "host" and "predicted_from" not in got
+
+
+def test_promoted_row_never_outranks_fresher_real_evidence(table):
+    """Fresher real evidence at the same key (a later offline tune, a
+    newer on-chip sweep) overwrites a promoted row — the promotion
+    must not pin the cell against better measurement."""
+    from dbcsr_tpu.tune import store
+
+    _write(table, [])
+    store.promote({"m": 23, "n": 23, "k": 23, "dtype": "float64",
+                   "stack_size": 30000, "driver": "xla_flat",
+                   "grouping": None, "gflops": 1.5, "env": "cpu"})
+    assert params_mod.lookup(
+        23, 23, 23, np.float64, stack_size=30000)["driver"] == "xla_flat"
+    # fresher real evidence: the offline tuner re-measures the key
+    params_mod.save_entry({"m": 23, "n": 23, "k": 23, "dtype": "float64",
+                           "stack_size": 30000, "driver": "host",
+                           "grouping": None, "gflops": 6.0, "env": "cpu"})
+    got = params_mod.lookup(23, 23, 23, np.float64, stack_size=30000)
+    assert got["driver"] == "host" and "tuned_by" not in got
+    got = params_mod.predict(23, 23, 23, np.float64, stack_size=30000)
+    assert got["driver"] == "host"
+
+
+def test_promoted_row_quarantined_like_any_row_across_generations(table):
+    """Provenance quarantine holds across generations: a CPU-measured
+    promoted row is muted by an on-chip donor exactly like a
+    hand-tuned CPU row would be."""
+    from dbcsr_tpu.tune import store
+
+    onchip_donor = dict(ROW_ONCHIP, m=32, n=32, k=32, gflops=8.03)
+    _write(table, [onchip_donor])
+    store.promote({"m": 23, "n": 23, "k": 23, "dtype": "float64",
+                   "stack_size": 30000, "driver": "pallas",
+                   "grouping": 4, "gflops": 0.2, "env": "cpu"})
+    got = params_mod.predict(23, 23, 23, np.float64, stack_size=30000)
+    assert got["env"] == "onchip"
+    assert got["predicted_from"] == (32, 32, 32)
+    # with no on-chip evidence anywhere the promoted row serves
+    params_mod.delete_entry(32, 32, 32, "float64", 100000)
+    got = params_mod.predict(23, 23, 23, np.float64, stack_size=30000)
+    assert got["driver"] == "pallas" and got.get("tuned_by")
+
+
 def test_committed_table_rows_all_tagged():
     import glob
     import os

@@ -145,6 +145,17 @@ def corpus():
         # clean run (the mm.incremental safety-ladder contract)
         ("delta_chain", dict(bs=[4] * 6, dtype=np.float64, occ=0.5,
                              delta_iters=3)),
+        # online-autotuner case: the tuner promoting a trial winner
+        # MID-TRAFFIC while a serve workload runs, against a temp
+        # params dir seeded with a mistuned row.  Paired legs in a
+        # pristine inner fault context pin the contract: a clean cycle
+        # must promote (and the serve results stay equal), and a
+        # tune_trial-faulted cycle must promote NOTHING while the
+        # workload's checksums still match.  Integer-valued operands
+        # make every driver's accumulation exact, so the checksum is
+        # bitwise-stable whatever row dispatch picks up
+        ("tune_storm", dict(bs=[4] * 6, dtype=np.float64, occ=0.5,
+                            tune_requests=2)),
     ]
 
 
@@ -509,12 +520,194 @@ def _delta_chain(entry: dict, seed: int) -> float:
     return float(sum(float(np.sum(o)) for o in run()))
 
 
+def _tune_storm(entry: dict, seed: int) -> float:
+    """The online tuner promoting winners mid-traffic.  A temp params
+    dir is seeded with a mistuned row for the workload's (4,4,4,f64)
+    cell; a serve client streams requests while a tuner cycle runs on
+    another thread.  Paired legs in a pristine inner fault context:
+
+    * clean — the cycle must PROMOTE (the trial winner beats the
+      mistuned row) and every request's checksum must equal the
+      no-tuner reference (integer-valued operands: exact, so bitwise
+      across whatever driver the promotion steers dispatch onto);
+    * faulted — ``tune_trial:raise`` aborts the trial: the spec must
+      fire, NO promotion may land, and the checksums must still match.
+
+    The returned checksum comes from a final leg under the OUTER
+    schedule (which may itself draw tune_trial), so the case also
+    participates in the ordinary chaos contract."""
+    import contextlib
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from dbcsr_tpu import serve
+    from dbcsr_tpu.acc import params as params_mod
+    from dbcsr_tpu.obs import metrics
+    from dbcsr_tpu.ops.test_methods import checksum, make_random_matrix
+    from dbcsr_tpu.resilience import faults
+    from dbcsr_tpu.tune import service as tune_service
+    from dbcsr_tpu.tune import store as tune_store
+
+    # the tuner defers whenever admission is not OK — earlier corpus
+    # cases legitimately leave DEGRADED residue (ABFT mismatch
+    # counters, wedge-streak gauges), so the pinned promotion legs
+    # start from a clean health slate (the resets are case-local:
+    # every other case's assertions are delta- or bus-based)
+    from dbcsr_tpu.obs import health as obs_health
+
+    metrics.reset()
+    obs_health.reset()
+
+    bs = entry["bs"]
+    n_req = int(entry["tune_requests"])
+    cell = dict(m=int(bs[0]), n=int(bs[0]), k=int(bs[0]),
+                dtype="float64", stack_size=512, driver="xla",
+                observed_gflops=0.01, target_gflops=10.0,
+                wasted_flop_seconds=1e3, flops=1e9,
+                source="chaos", reason="seeded mistuned cell")
+
+    def _promotions() -> float:
+        c = metrics._counters.get("dbcsr_tpu_tune_promotions_total")
+        return float(sum(c.values.values())) if c is not None else 0.0
+
+    @contextlib.contextmanager
+    def _temp_params():
+        prev = os.environ.get("DBCSR_TPU_PARAMS_DIR")
+        prev_knobs = {k: os.environ.get(k) for k in
+                      ("DBCSR_TPU_TUNE_NREP", "DBCSR_TPU_TUNE_BUDGET_BYTES")}
+        with tempfile.TemporaryDirectory() as td:
+            os.environ["DBCSR_TPU_PARAMS_DIR"] = td
+            os.environ["DBCSR_TPU_TUNE_NREP"] = "1"
+            os.environ["DBCSR_TPU_TUNE_BUDGET_BYTES"] = str(1 << 20)
+            params_mod.invalidate()
+            params_mod.save_entry({
+                "m": cell["m"], "n": cell["n"], "k": cell["k"],
+                "dtype": "float64", "stack_size": 512,
+                "driver": "xla_group", "r0": 4, "grouping": None,
+                "gflops": 0.01, "env": "cpu"})
+            try:
+                yield td
+            finally:
+                for k, v in dict(DBCSR_TPU_PARAMS_DIR=prev,
+                                 **prev_knobs).items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+                params_mod.invalidate()
+
+    def _serve_run(tag: str, with_cycle: bool) -> float:
+        svc = tune_service.TuneService(interval_s=3600)
+        eng = serve.ServeEngine(start=True)
+        sess = eng.open_session(f"chaos-tune-{tag}")
+        cycle_out: dict = {}
+
+        def _cycle():
+            cycle_out.update(svc.cycle(cells=[dict(cell)]))
+
+        tuner = threading.Thread(target=_cycle) if with_cycle else None
+        total = 0.0
+        try:
+            if tuner is not None:
+                tuner.start()
+            for rep in range(n_req):
+                rng = np.random.default_rng(seed + 31 * rep)
+                a = make_random_matrix("A", bs, bs, dtype=entry["dtype"],
+                                       occupation=entry["occ"], rng=rng)
+                b = make_random_matrix("B", bs, bs, dtype=entry["dtype"],
+                                       occupation=entry["occ"], rng=rng)
+                c = make_random_matrix("C", bs, bs, dtype=entry["dtype"],
+                                       occupation=0.3, rng=rng)
+                # integer-valued operands: every driver's accumulation
+                # is exact, so the checksum is driver-independent
+                for mat in (a, b, c):
+                    mat.map_bin_data(lambda d: np.trunc(d * 4.0))
+                sess.put(f"A{rep}", a)
+                sess.put(f"B{rep}", b)
+                sess.put(f"C{rep}", c)
+                for _attempt in range(60):
+                    t = eng.submit(sess, a=f"A{rep}", b=f"B{rep}",
+                                   c=f"C{rep}", alpha=1.0, beta=0.0)
+                    if t.wait(timeout=120) and t.state == "done":
+                        break
+                    time.sleep(0.02)
+                else:
+                    raise RuntimeError(
+                        f"tune_storm request never served: {t.info()}")
+                total += checksum(c)
+            if tuner is not None:
+                tuner.join(timeout=600)
+                if tuner.is_alive():
+                    raise RuntimeError("tune_storm: tuner cycle hung")
+        finally:
+            eng.shutdown()
+            sess.close()
+        if with_cycle:
+            _serve_run.last_cycle = dict(cycle_out)
+        return total
+
+    _serve_run.last_cycle = {}
+
+    with faults.inject_faults(""):  # pristine inner context
+        # reference: no tuner at all, mistuned table in force
+        with _temp_params():
+            ref = _serve_run("ref", with_cycle=False)
+        # clean leg: the cycle must land a promotion mid-traffic and
+        # the request results must be unchanged (bitwise: exact data)
+        with _temp_params():
+            p0 = _promotions()
+            out = _serve_run("clean", with_cycle=True)
+            if out != ref:
+                raise RuntimeError(
+                    f"tune_storm clean leg: checksum {out} != ref {ref} "
+                    f"(promotion changed results, not just speed)")
+            if _serve_run.last_cycle.get("outcome") != "promoted" \
+                    or _promotions() != p0 + 1:
+                raise RuntimeError(
+                    "tune_storm clean leg: cycle did not promote "
+                    f"({_serve_run.last_cycle})")
+            if not tune_store.live_promotions():
+                raise RuntimeError(
+                    "tune_storm clean leg: promotion missing from the "
+                    "ledger")
+        # faulted leg: an injected trial fault must abort the trial
+        # with NO promotion, results still equal
+        with _temp_params():
+            p0 = _promotions()
+            with faults.inject_faults(
+                    f"tune_trial:raise,seed={seed % 997},times=1") as sp:
+                out = _serve_run("faulted", with_cycle=True)
+            if not sp[0].fired:
+                raise RuntimeError("tune_storm: tune_trial spec never "
+                                   "fired")
+            if _promotions() != p0 or tune_store.live_promotions():
+                raise RuntimeError(
+                    "tune_storm faulted leg: a promotion landed from a "
+                    f"faulted trial ({_serve_run.last_cycle})")
+            if out != ref:
+                raise RuntimeError(
+                    f"tune_storm faulted leg: checksum {out} != ref "
+                    f"{ref}")
+    from dbcsr_tpu.obs import events as obs_events
+
+    if obs_events.enabled():
+        obs_events.clear()  # inner legs' faults are not the outer
+        #                     schedule's correlation count
+    # final leg under the outer schedule: the ordinary chaos contract
+    with _temp_params():
+        return _serve_run("outer", with_cycle=True)
+
+
 def _one_product(entry: dict, seed: int):
     import numpy as np
 
     from dbcsr_tpu.mm.multiply import multiply
     from dbcsr_tpu.ops.test_methods import checksum, make_random_matrix
 
+    if entry.get("tune_requests"):
+        return _tune_storm(entry, seed)
     if entry.get("serve_tenants"):
         return _serve_storm(entry, seed)
     if entry.get("delta_iters"):

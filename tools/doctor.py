@@ -132,6 +132,18 @@ HINTS = {
         "`python -m tools.lint` and fix or suppress-with-reason "
         "before trusting any capture from this tree",
         "docs/static_analysis.md#rule-catalog"),
+    "tune_demotion": (
+        "the online tuner demoted a promoted parameter row: its live "
+        "roofline cell regressed after promotion (workload shift, "
+        "device throttle, or a trial that measured an unrepresentative "
+        "stack) — the displaced row is restored; check the ledger's "
+        "trial stats before re-tuning the cell",
+        "docs/autotuning.md#demotion-on-regression"),
+    "tune_trial_failures": (
+        "tuning trials keep failing; the tuner is deferring but "
+        "burning cycles — check the trial watchdog channel "
+        "(tune_trial) and the last_error in the tune health component",
+        "docs/autotuning.md#runbook-failing-trials"),
 }
 
 # the telemetry cells --trend tables by default (history worth eyes:
@@ -142,6 +154,8 @@ TREND_METRICS = (
     "dbcsr_tpu_cell_flops_total",
     "dbcsr_tpu_precision_cell_demoted",
     "dbcsr_tpu_precision_promotions_total",
+    "dbcsr_tpu_tune_promotions_total",
+    "dbcsr_tpu_params_generation",
     "dbcsr_tpu_serve_queue_depth",
     "dbcsr_tpu_serve_latency_p95_ms",
     "dbcsr_tpu_serve_shed_total",
@@ -465,6 +479,48 @@ def analyze(health: dict | None, prom: dict, events: list,
             f"{integrity['drains']} drain(s), "
             f"{integrity.get('replayed', 0)} replayed")))
 
+    # autotuner plane: live counters first (prometheus), else the
+    # tune_promotion / tune_demotion / tune_trial bus events; the
+    # health verdict's tune component carries queue depth and streaks
+    tune: dict = {}
+    tr = collections.Counter()
+    for labels, v in prom.get("dbcsr_tpu_tune_trials_total", []):
+        tr[labels.get("outcome", "?")] += int(v)
+    for labels, v in prom.get("dbcsr_tpu_tune_promotions_total", []):
+        tune["promotions"] = tune.get("promotions", 0) + int(v)
+    dem = collections.Counter()
+    for labels, v in prom.get("dbcsr_tpu_tune_demotions_total", []):
+        dem[labels.get("reason", "?")] += int(v)
+    if not tr and not tune and not dem:
+        for e in events:
+            if e.get("event") == "tune_trial":
+                tr[e.get("outcome", "?")] += 1
+            elif e.get("event") == "tune_promotion":
+                tune["promotions"] = tune.get("promotions", 0) + 1
+            elif e.get("event") == "tune_demotion":
+                dem[e.get("reason", "?")] += 1
+    if tr:
+        tune["trials"] = dict(tr)
+    if dem:
+        tune["demotions"] = dict(dem)
+    if health:
+        tcomp = (health.get("components") or {}).get("tune") or {}
+        for f in ("queue_depth", "params_generation", "running"):
+            if tcomp.get(f) is not None:
+                tune[f] = tcomp[f]
+    if tune:
+        report["tune"] = tune
+    if dem:
+        report["hints"].append(_hint("tune_demotion", detail=", ".join(
+            f"{r}={n}" for r, n in sorted(dem.items()))))
+    failed = sum(n for o, n in tr.items()
+                 if o in ("failed", "faulted", "wedged"))
+    if failed >= 3:
+        report["hints"].append(_hint(
+            "tune_trial_failures",
+            detail=f"{failed} non-OK trial(s): " + ", ".join(
+                f"{o}={n}" for o, n in sorted(tr.items()))))
+
     # SLO burn: the live verdict's slo component first, else slo_burn
     # bus events (the telemetry history plane, obs/slo.py)
     slo_burning: dict = {}
@@ -630,6 +686,23 @@ def render(report: dict, out=print) -> None:
         if ig.get("replayed"):
             parts.append(f"replayed={ig['replayed']}")
         out(" integrity: " + ", ".join(parts))
+    if report.get("tune"):
+        tn = report["tune"]
+        parts = []
+        if tn.get("trials"):
+            parts.append("trials[" + ", ".join(
+                f"{k}={v}" for k, v in sorted(tn["trials"].items()))
+                + "]")
+        if tn.get("promotions"):
+            parts.append(f"promotions={tn['promotions']}")
+        if tn.get("demotions"):
+            parts.append("demotions[" + ", ".join(
+                f"{k}={v}" for k, v in sorted(tn["demotions"].items()))
+                + "]")
+        for f in ("queue_depth", "params_generation"):
+            if tn.get(f) is not None:
+                parts.append(f"{f}={tn[f]}")
+        out(" autotuner: " + (", ".join(parts) or "idle"))
     if report.get("slo_burning"):
         out(" slo burning: " + ", ".join(
             f"{n} ({b}x)" for n, b in
