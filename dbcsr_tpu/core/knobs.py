@@ -18,6 +18,22 @@ docs/knobs.md).  Keep entries alphabetical.
 """
 
 KNOBS = {
+    "DBCSR_TPU_ATTRIBUTION": {
+        "owner": "obs/attribution.py",
+        "doc": "=0 disables per-request cost attribution / tenant usage "
+               "metering (every hook becomes an early return).",
+    },
+    "DBCSR_TPU_ATTRIBUTION_N": {
+        "owner": "obs/attribution.py",
+        "doc": "attribution ledger capacity (per-request rows, LRU; "
+               "default 1024).",
+    },
+    "DBCSR_TPU_ATTRIBUTION_TENANTS": {
+        "owner": "obs/attribution.py",
+        "doc": "per-tenant usage rollup row cap (default 512); evicted "
+               "rows fold into the '(evicted)' aggregate so conservation "
+               "survives tenant churn.",
+    },
     "DBCSR_TPU_BENCH_CPU_DRIVER": {
         "owner": "bench.py",
         "doc": "stack driver forced when a bench run lands on the CPU "
@@ -159,6 +175,21 @@ KNOBS = {
         "doc": "inter-chip-interconnect GB/s override for the comm cost "
                "model.",
     },
+    "DBCSR_TPU_INCIDENTS": {
+        "owner": "obs/incidents.py",
+        "doc": "incident-bundle directory ('0' keeps bundles in memory "
+               "only; default 'incidents/' under the working directory).",
+    },
+    "DBCSR_TPU_INCIDENT_INTERVAL_S": {
+        "owner": "obs/incidents.py",
+        "doc": "minimum seconds between captured incident bundles "
+               "(default 60).",
+    },
+    "DBCSR_TPU_INCIDENT_N": {
+        "owner": "obs/incidents.py",
+        "doc": "maximum incident bundles captured per process "
+               "(default 8).",
+    },
     "DBCSR_TPU_LOCKCHECK": {
         "owner": "utils/lockcheck.py",
         "doc": "=1 enables the dynamic lock-order checker: per-thread "
@@ -237,6 +268,18 @@ KNOBS = {
         "owner": "serve/engine.py",
         "doc": "serving-plane request journal path (drain/restart "
                "recovery, docs/serving.md).",
+    },
+    "DBCSR_TPU_SERVE_TENANT_MAX": {
+        "owner": "serve/engine.py",
+        "doc": "cap on the engine's per-tenant latency/outcome "
+               "accounting rows (least recently active evicted; "
+               "default 256).",
+    },
+    "DBCSR_TPU_SERVE_TENANT_TTL_S": {
+        "owner": "serve/engine.py",
+        "doc": "idle seconds before a tenant's engine accounting rows "
+               "(rolling latency window, outcome tallies) expire "
+               "(default 3600).",
     },
     "DBCSR_TPU_SLO_CRITICAL_BURN": {
         "owner": "obs/slo.py",

@@ -220,6 +220,14 @@ def _fire(kind: str, state_key, args: dict) -> None:
         _ts.request_sample(f"anomaly:{kind}")
     except Exception:
         pass
+    try:
+        # ...and arms an incident-bundle capture at that same boundary
+        # (flag-set only — safe under the detector locks this runs in)
+        from dbcsr_tpu.obs import incidents as _incidents
+
+        _incidents.trigger(f"anomaly:{kind}", args)
+    except Exception:
+        pass
 
 
 def _clear_state(state_key) -> None:

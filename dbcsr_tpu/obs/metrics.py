@@ -217,6 +217,21 @@ def reset(include_stats: bool = True) -> None:
             mempool.reset_stats()
         except Exception:
             pass  # jax-free contexts (doctor --selftest parses only)
+        # the attribution ledger and the incident-capture budget follow
+        # the same include_stats contract (docs/observability.md §
+        # Reset semantics) — AFTER stats.reset() above, so the
+        # attribution re-baseline snapshots the freshly zeroed rollup
+        try:
+            import sys as _sys
+
+            _attr = _sys.modules.get("dbcsr_tpu.obs.attribution")
+            if _attr is not None:
+                _attr.reset()
+            _inc = _sys.modules.get("dbcsr_tpu.obs.incidents")
+            if _inc is not None:
+                _inc.reset()
+        except Exception:
+            pass
 
 
 def _roofline_rollup() -> dict:

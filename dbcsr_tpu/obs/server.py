@@ -29,6 +29,10 @@ route                 payload
 ``/serve/tenants``    per-tenant serving metrics: admitted/shed/
                       deadline-missed counters, queue load, rolling
                       p50/p95 latency
+``/usage``            tenant cost-attribution rollup (`attribution.
+                      usage()`): per-tenant device-seconds/flops/
+                      bytes + saved credits, top consumers, grand
+                      totals; ``?top=N``
 ``/timeseries``       telemetry history store (`obs.timeseries`):
                       ``?metric=&since=&until=&agg=&tier=`` + any
                       other param as a label matcher; no ``metric``
@@ -140,6 +144,15 @@ class _Handler(BaseHTTPRequestHandler):
                 if eng is None:
                     return
                 self._send_json(eng.tenants())
+            elif route == "/usage":
+                from dbcsr_tpu.obs import attribution
+
+                q = parse_qs(url.query)
+                try:
+                    top = int(q.get("top", ["5"])[0])
+                except ValueError:
+                    top = 5
+                self._send_json(attribution.usage(top=top))
             elif route == "/":
                 self._send_json({
                     "routes": ["/metrics", "/healthz", "/flight",
@@ -149,7 +162,8 @@ class _Handler(BaseHTTPRequestHandler):
                                "/cluster?format=prom|json&ports=&n=",
                                "/serve/submit (POST)",
                                "/serve/status?request_id=",
-                               "/serve/tenants"],
+                               "/serve/tenants",
+                               "/usage?top="],
                     "process_index": _server.process_index
                     if _server else None,
                 })

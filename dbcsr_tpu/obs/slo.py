@@ -323,8 +323,16 @@ def _edge(name: str, row: dict, now: float) -> None:
             "burn_long": row["burn_long"], "budget": row["budget"],
             "detail": str(row.get("detail", ""))[:200]}, flight=True)
         # a burn transition is a health transition: force the next
-        # sample boundary so the shard records the state change
+        # sample boundary so the shard records the state change —
+        # and arm an incident-bundle capture there (flag-set only)
         _ts.request_sample(f"slo_burn:{name}")
+        try:
+            from dbcsr_tpu.obs import incidents as _incidents
+
+            _incidents.trigger(f"slo_burn:{name}",
+                               {"burn": row["burn"]})
+        except Exception:
+            pass
 
 
 def burning() -> dict:

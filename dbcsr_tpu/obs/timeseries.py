@@ -496,9 +496,27 @@ def _collect_tune() -> list:
     return pts
 
 
+def _collect_attribution() -> list:
+    """Tenant cost-attribution plane (obs.attribution): the per-tenant
+    device-seconds/flops/bytes/saved meters — sampled into shards so
+    tenant usage history replays offline (`doctor --trend`,
+    `tools/usage_report.py` in artifact mode)."""
+    pts: list = []
+    from dbcsr_tpu.obs import metrics
+
+    for name in ("dbcsr_tpu_tenant_device_seconds_total",
+                 "dbcsr_tpu_tenant_flops_total",
+                 "dbcsr_tpu_tenant_bytes_moved_total",
+                 "dbcsr_tpu_tenant_saved_flops_total"):
+        for labels, v in metrics.counter_items(name):
+            pts.append((name, labels, v, COUNTER))
+    return pts
+
+
 _COLLECTORS = (_collect_engine, _collect_serve, _collect_breakers,
                _collect_pool, _collect_integrity, _collect_precision,
-               _collect_value_reuse, _collect_tune, _collect_health)
+               _collect_value_reuse, _collect_tune, _collect_health,
+               _collect_attribution)
 
 
 # ------------------------------------------------------------ sampling
@@ -598,12 +616,24 @@ def sample(now: float | None = None, reason: str = "manual") -> dict | None:
                     _sink.flush()
                 except Exception:
                     pass  # a full disk must not fail the multiply
-        return rec
     finally:
         # clear the guard UNDER the lock like the check-and-set above:
         # an unlocked store is unordered against a concurrent CAS
         with _lock:
             _sampling = False
+    # the incident-capture boundary: an armed anomaly/SLO-burn trigger
+    # (obs.incidents) assembles its bundle HERE — outside the store
+    # lock and the sampling guard, carrying the very sample the rising
+    # edge forced
+    try:
+        import sys as _sys
+
+        _inc = _sys.modules.get("dbcsr_tpu.obs.incidents")
+        if _inc is not None:
+            _inc.on_sample(rec)
+    except Exception:
+        pass  # capture must never fail the boundary that hosts it
+    return rec
 
 
 def ingest_points(t: float, points, persist: bool = True,

@@ -85,6 +85,15 @@ SITES = {
         # bench-only boolean site (fail_probe), not a multiply boundary
         "corruptible": False, "chaos": False, "dynamic": False,
     },
+    "attribution": {
+        "boundary": "the cost-attribution billing boundary "
+                    "(`obs.attribution.bill_window`) — a fault is "
+                    "observed (bus event + counter) but ALWAYS "
+                    "swallowed before any ledger mutation, so the "
+                    "books stay balanced (labels `requests`, "
+                    "`request_id`)",
+        "corruptible": False, "chaos": True, "dynamic": False,
+    },
     "serve_admit": {
         "boundary": "serving-plane admission (`serve.queue`) — a fault "
                     "sheds the submission with a structured rejection "

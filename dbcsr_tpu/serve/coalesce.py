@@ -31,11 +31,13 @@ else runs serialized — correctness never depends on the window.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import numpy as np
 
 from dbcsr_tpu.core import digests
+from dbcsr_tpu.obs import attribution as _attr
 from dbcsr_tpu.core.matrix import (
     NO_SYMMETRY,
     BlockSparseMatrix,
@@ -200,6 +202,7 @@ def execute_coalesced(requests: list) -> List[int]:
             p0.get("transa", "N"), p0.get("transb", "N"),
             p0.get("alpha", 1.0), ca, cb, p0.get("beta", 0.0), cc,
         )
+        t_carve = time.perf_counter()
         try:
             _split_composite(cc, [r.params["c"] for r in requests])
         except Exception as exc:
@@ -208,9 +211,18 @@ def execute_coalesced(requests: list) -> List[int]:
                     f"carve failed mid-group with beta != 0: "
                     f"{type(exc).__name__}: {exc}") from exc
             raise
+        finally:
+            _attr.group_phase(requests, "carve",
+                              time.perf_counter() - t_carve)
         # composite temporaries retire explicitly so their (large)
         # bins feed the next window's checkouts immediately
         for m in (ca, cb, cc):
             ch.retire(m)
-    share = flops // len(requests)
-    return [share] * len(requests)
+    # per-request true-flop shares: every member's product is the same
+    # structure, so the split is even — but it must still SUM EXACTLY
+    # to the composite's measured flops (the attribution conservation
+    # invariant), so the integer remainder lands on the first members
+    n = len(requests)
+    flops = int(flops)
+    share, rem = divmod(flops, n)
+    return [share + (1 if i < rem else 0) for i in range(n)]

@@ -32,6 +32,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from dbcsr_tpu.obs import attribution as _attr
 from dbcsr_tpu.resilience import faults as _faults
 from dbcsr_tpu.resilience.watchdog import WEDGED
 from dbcsr_tpu.serve import coalesce as _coalesce
@@ -69,8 +70,11 @@ class ServeEngine:
         self._requests: "collections.OrderedDict[str, Request]" = \
             collections.OrderedDict()
         # per-tenant rolling latencies (exact p50/p95 for /serve/tenants)
+        # — bounded: idle tenants expire (`_expire_tenants_locked`), so
+        # a high-cardinality fleet cannot leak one entry per tenant
         self._lat: Dict[str, collections.deque] = {}
         self._counts: Dict[str, collections.Counter] = {}
+        self._tenant_seen: Dict[str, float] = {}
         self.t_start = time.time()
         self.draining = False
         # request ids already replayed from a journal (exactly-once)
@@ -415,6 +419,7 @@ class ServeEngine:
             }
         req.nbytes = self._operand_bytes(params)
         req.ckey = _coalesce.coalesce_key(op, params)
+        _attr.on_submit(req)
         from dbcsr_tpu.obs import events as _events
 
         _events.publish("serve_submitted", {
@@ -484,12 +489,23 @@ class ServeEngine:
         from dbcsr_tpu.acc import abft as _abft
 
         ids = [r.request_id for r in group]
+        # attribution: close the pre-execution phases — queued is the
+        # submit -> pop edge, coalesce-wait the pop -> execute edge
+        # (the batching-window gather every popped request sat through)
+        t_exec = time.time()
+        for r in group:
+            if r.t_running is not None:
+                _attr.phase(r.request_id, "queued",
+                            r.t_running - r.t_submit)
+                _attr.phase(r.request_id, "coalesce_wait",
+                            t_exec - r.t_running)
         # under ABFT every request runs serialized: the per-request
         # probe + pre-execution snapshot (the recover path's rollback
         # scope) is per-C, which the composite's carve-last contract
         # cannot provide mid-launch
         coalesced = (len(group) > 1 and not _abft.enabled()
                      and self._group_coalescable(group))
+        degraded = False
         _events.publish("serve_execute", {
             "request_ids": ",".join(ids), "n": len(group),
             "tenants": ",".join(sorted({r.tenant for r in group})),
@@ -505,6 +521,7 @@ class ServeEngine:
                 if coalesced:
                     self._degrade(group, exc)
                     coalesced = False
+                    degraded = True
                 else:
                     self._fail(group[0], exc)
                     if len(group) == 1:
@@ -512,12 +529,20 @@ class ServeEngine:
                     # the rest of a serialized group still runs — a
                     # request must never be left non-terminal
         if coalesced:
+            # one billing window brackets the composite launch: on
+            # success the measured cost splits by the per-request true-
+            # flop shares; on failure the shares never materialized, so
+            # the (partial) cost splits equally — either way the window
+            # is billed exactly once, so a degrade replay's serialized
+            # windows can never double-bill the composite's
+            tok = _attr.begin_window()
             try:
                 flops = _coalesce.execute_coalesced(group)
             except _coalesce.Unrecoverable as exc:
                 # the carve already wrote some target Cs and beta != 0:
                 # a serialized replay would re-apply beta to a C that
                 # is no longer the submitted one — fail, never corrupt
+                _attr.bill_window(tok, group)
                 for r in group:
                     self._fail(r, exc)
                 return
@@ -526,8 +551,12 @@ class ServeEngine:
                 # carve is the last step, and a partial carve raises
                 # Unrecoverable above), so the serialized replay is
                 # exact — mid-request failover, not request death
+                _attr.bill_window(tok, group)
                 self._degrade(group, exc)
+                degraded = True
             else:
+                _attr.bill_window(tok, group,
+                                  weights=[int(f) for f in flops])
                 _metrics.counter(
                     "dbcsr_tpu_serve_coalesced_total",
                     "request groups executed as one block-diagonal "
@@ -537,14 +566,24 @@ class ServeEngine:
                     self._finish_ok(r, {"flops": int(f),
                                         "coalesced": len(group)})
                 return
+        # a degrade replay's serialized windows land in the "serialize"
+        # phase; first-try serialized execution is the "execute" phase
+        pname = "serialize" if degraded else "execute"
         for r in group:
             if r.done:
                 continue  # already failed by a group-level fault
+            tok = _attr.begin_window()
             try:
                 result = self._execute_one(r)
-                self._finish_ok(r, result)
             except Exception as exc:
+                _attr.bill_window(tok, [r], phase_name=pname)
                 self._fail(r, exc)
+            else:
+                _attr.bill_window(tok, [r], phase_name=pname)
+                if result.get("cached"):
+                    _attr.credit_saved(r, result.get("saved_flops", 0),
+                                       result.get("saved_seconds", 0.0))
+                self._finish_ok(r, result)
 
     def _group_coalescable(self, group: List[Request]) -> bool:
         """A group is only safe to assemble when no request's C object
@@ -660,7 +699,8 @@ class ServeEngine:
                 if served:
                     _pcache.note_served(ent, tenant=req.tenant)
                     return {"flops": 0, "coalesced": 0, "cached": 1,
-                            "saved_flops": ent.flops}
+                            "saved_flops": ent.flops,
+                            "saved_seconds": ent.seconds}
         args = (p.get("transa", "N"), p.get("transb", "N"),
                 p.get("alpha", 1.0), p["a"], p["b"],
                 p.get("beta", 0.0), p["c"])
@@ -668,6 +708,7 @@ class ServeEngine:
                   filter_eps=p.get("filter_eps"))
         abft_on = _abft.enabled() and _abft.product_probeable(p)
         if not abft_on:
+            t0 = time.perf_counter()
             flops = multiply(*args, **kw)
             if pckey is not None:
                 # banked BEFORE the fault hook: an injected
@@ -675,7 +716,8 @@ class ServeEngine:
                 # never outlive its window through the cache (the
                 # ABFT path gets the same guarantee from certifying
                 # before it stores)
-                _pcache.store(pckey, p["c"], req.tenant, flops)
+                _pcache.store(pckey, p["c"], req.tenant, flops,
+                              seconds=time.perf_counter() - t0)
             self._maybe_corrupt_result(p["c"], req.request_id)
             return {"flops": int(flops), "coalesced": 0}
         a, b, c = p["a"], p["b"], p["c"]
@@ -685,6 +727,7 @@ class ServeEngine:
         if beta:
             r_old = _abft.matrix_probe(
                 c, _abft.probe_vector(c.nfullcols, c.dtype))
+        t0 = time.perf_counter()
         flops = multiply(*args, **kw)
         self._maybe_corrupt_result(c, req.request_id)
         try:
@@ -713,7 +756,8 @@ class ServeEngine:
         if pckey is not None:
             # banked only AFTER the probe certified the result: the
             # cache can never hold a C the ABFT plane has not accepted
-            _pcache.store(pckey, c, req.tenant, flops)
+            _pcache.store(pckey, c, req.tenant, flops,
+                          seconds=time.perf_counter() - t0)
         return {"flops": int(flops), "coalesced": 0, "verified": 1}
 
     def _maybe_corrupt_result(self, c, request_id: str) -> None:
@@ -757,11 +801,14 @@ class ServeEngine:
         from dbcsr_tpu.obs import metrics as _metrics
 
         lat_ms = (req.t_done - req.t_submit) * 1e3
+        now = time.time()
         with self._slock:
             self._lat.setdefault(
                 req.tenant, collections.deque(maxlen=512)).append(lat_ms)
             self._counts.setdefault(
                 req.tenant, collections.Counter())[outcome] += 1
+            self._tenant_seen[req.tenant] = now
+            self._expire_tenants_locked(now)
         _metrics.counter(
             "dbcsr_tpu_serve_requests_total",
             "serving-plane requests by tenant and admission/terminal "
@@ -772,6 +819,36 @@ class ServeEngine:
             "request latency (submit to terminal state) per tenant",
             buckets=(1, 5, 10, 50, 100, 500, 1000, 5000, 30000),
         ).observe(lat_ms, tenant=req.tenant)
+
+    def _expire_tenants_locked(self, now: float) -> None:
+        """Bound the per-tenant accounting maps (`_slock` held): drop
+        tenants idle past ``DBCSR_TPU_SERVE_TENANT_TTL_S`` and, past
+        ``DBCSR_TPU_SERVE_TENANT_MAX`` rows, the least recently active
+        — a high-cardinality fleet must not grow these dicts forever.
+        Expiry loses only the rolling latency window and local outcome
+        tally; the metrics-registry counters (and the attribution
+        ledger's own bounded rollup) remain the durable record."""
+        try:
+            ttl = float(os.environ.get("DBCSR_TPU_SERVE_TENANT_TTL_S",
+                                       "3600"))
+        except ValueError:
+            ttl = 3600.0
+        try:
+            cap = max(4, int(os.environ.get("DBCSR_TPU_SERVE_TENANT_MAX",
+                                            "256")))
+        except ValueError:
+            cap = 256
+        for t, seen in list(self._tenant_seen.items()):
+            if now - seen > ttl:
+                self._drop_tenant_locked(t)
+        while len(self._tenant_seen) > cap:
+            oldest = min(self._tenant_seen, key=self._tenant_seen.get)
+            self._drop_tenant_locked(oldest)
+
+    def _drop_tenant_locked(self, tenant: str) -> None:
+        self._tenant_seen.pop(tenant, None)
+        self._lat.pop(tenant, None)
+        self._counts.pop(tenant, None)
 
     # -------------------------------------------------------------- surface
 

@@ -43,17 +43,22 @@ _lock = _lockcheck.wrap("serve.product_cache", threading.Lock())
 
 class _Entry:
     """One cached product: the result structure + aliased device bins,
-    byte size, inserting tenant, and the true flops a hit saves."""
+    byte size, inserting tenant, and the true flops (plus measured
+    execute wall seconds) a hit saves — the attribution layer turns
+    both into the tenant's saved-work credit."""
 
-    __slots__ = ("keys", "bins", "nbytes", "tenant", "flops", "hits")
+    __slots__ = ("keys", "bins", "nbytes", "tenant", "flops", "seconds",
+                 "hits")
 
-    def __init__(self, c: BlockSparseMatrix, tenant: str, flops: int):
+    def __init__(self, c: BlockSparseMatrix, tenant: str, flops: int,
+                 seconds: float = 0.0):
         from dbcsr_tpu.core import mempool
 
         self.keys = c.keys
         self.bins, self.nbytes = mempool.alias_bins(c)
         self.tenant = tenant
         self.flops = int(flops)
+        self.seconds = float(seconds)
         self.hits = 0
 
 
@@ -168,7 +173,7 @@ def install(ent: _Entry, c: BlockSparseMatrix) -> None:
 
 
 def store(key: tuple, c: BlockSparseMatrix, tenant: str,
-          flops: int) -> None:
+          flops: int, seconds: float = 0.0) -> None:
     """Bank a freshly served product.  Bounded by config
     (``serve_product_cache_entries`` / ``_bytes``); eviction is LRU
     and simply drops references (aliased buffers are freed by the
@@ -178,7 +183,7 @@ def store(key: tuple, c: BlockSparseMatrix, tenant: str,
     from dbcsr_tpu.core.config import get_config
 
     cfg = get_config()
-    ent = _Entry(c, tenant, flops)
+    ent = _Entry(c, tenant, flops, seconds=seconds)
     if ent.nbytes > cfg.serve_product_cache_bytes:
         return  # cannot fit even alone
     c._bins_shared = True  # the cache aliases these buffers now

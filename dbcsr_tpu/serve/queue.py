@@ -66,8 +66,8 @@ class Request:
 
     __slots__ = (
         "request_id", "session", "op", "params", "priority", "t_submit",
-        "t_deadline", "t_done", "state", "outcome", "error", "result",
-        "ckey", "nbytes", "journal", "replay_journal_path",
+        "t_deadline", "t_done", "t_running", "state", "outcome", "error",
+        "result", "ckey", "nbytes", "journal", "replay_journal_path",
         "on_terminal", "_event",
     )
 
@@ -86,6 +86,9 @@ class Request:
         self.t_deadline = (self.t_submit + float(deadline_s)
                            if deadline_s is not None else None)
         self.t_done: Optional[float] = None
+        self.t_running: Optional[float] = None  # stamped at pop (the
+        #                       queued -> running edge the attribution
+        #                       ledger turns into the "queued" phase)
         self.state = "new"
         self.outcome: Optional[str] = None
         self.error: Optional[str] = None
@@ -128,6 +131,17 @@ class Request:
                 cb(self, state)
             except Exception:
                 pass  # a journal hiccup must never mask the outcome
+        # the attribution ledger's terminal chokepoint — a direct
+        # guarded call, NOT the on_terminal slot (that is the journal
+        # replay's single-consumer tombstone hook)
+        try:
+            import sys
+
+            _attr = sys.modules.get("dbcsr_tpu.obs.attribution")
+            if _attr is not None:
+                _attr.on_terminal(self, state)
+        except Exception:
+            pass  # bookkeeping must never mask the outcome
         self.state = state
         self.outcome = outcome
         self.error = error
@@ -137,7 +151,7 @@ class Request:
 
     def info(self) -> dict:
         """JSON-safe status payload (the ``/serve/status`` shape)."""
-        return {
+        out = {
             "request_id": self.request_id,
             "tenant": self.tenant,
             "session": self.session.session_id,
@@ -152,6 +166,15 @@ class Request:
             "latency_ms": (round((self.t_done - self.t_submit) * 1e3, 3)
                            if self.t_done else None),
         }
+        try:
+            import sys
+
+            _attr = sys.modules.get("dbcsr_tpu.obs.attribution")
+            if _attr is not None:
+                out["attribution"] = _attr.request_info(self.request_id)
+        except Exception:
+            pass  # the base payload stands on its own
+        return out
 
     def __repr__(self):
         return (f"Request({self.request_id}, {self.op}, "
@@ -389,6 +412,7 @@ class AdmissionQueue:
                     self._depth_gauge()
                     for e in expired:
                         self._expire(e)
+                    req.t_running = time.time()
                     req.state = "running"
                     return req
                 self._depth_gauge()
@@ -429,6 +453,7 @@ class AdmissionQueue:
                 for e in expired:
                     self._expire(e)
                 if found is not None:
+                    found.t_running = time.time()
                     found.state = "running"
                     return found
                 remaining = deadline - time.time()
@@ -439,10 +464,20 @@ class AdmissionQueue:
     # ------------------------------------------------------------ accounting
 
     def _release_locked(self, req: Request) -> None:
+        # pop-at-zero: an idle tenant leaves NO residue in the quota
+        # maps — a high-cardinality fleet must not leak one dict entry
+        # per tenant forever (pinned by the many-tenants test)
         t = req.tenant
-        self._tenant_count[t] = max(0, self._tenant_count.get(t, 0) - 1)
-        self._tenant_bytes[t] = max(0, self._tenant_bytes.get(t, 0)
-                                    - req.nbytes)
+        n = max(0, self._tenant_count.get(t, 0) - 1)
+        if n:
+            self._tenant_count[t] = n
+        else:
+            self._tenant_count.pop(t, None)
+        b = max(0, self._tenant_bytes.get(t, 0) - req.nbytes)
+        if b and n:
+            self._tenant_bytes[t] = b
+        else:
+            self._tenant_bytes.pop(t, None)
 
     def release(self, req: Request) -> None:
         """Return a popped request's quota slots (engine calls this

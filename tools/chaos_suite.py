@@ -129,6 +129,15 @@ def corpus():
         # the case, plus --events for fault correlation)
         ("serve_storm", dict(bs=[4] * 6, dtype=np.float64, occ=0.5,
                              serve_tenants=3, serve_requests=2)),
+        # cost-attribution case: the serve storm with the attribution
+        # ledger re-baselined first — beyond the storm contract, the
+        # tenant-cost conservation invariant must hold EXACTLY when
+        # the dust settles: per-tenant billings sum to the grand
+        # totals, and the grand flops/bytes equal the engine rollup
+        # bit-for-bit whatever the schedule shed, degraded, faulted
+        # (including at the `attribution` site itself) or retried
+        ("usage_storm", dict(bs=[4] * 6, dtype=np.float64, occ=0.5,
+                             usage_tenants=3, usage_requests=2)),
         # finite-SDC case: flip faults injected mid-McWeeny chain must
         # be detected (stack ABFT probe with the knob on; chain
         # invariant rollback with it off) and recovered BITWISE-equal
@@ -285,6 +294,118 @@ def _serve_storm(entry: dict, seed: int) -> float:
                 if not e.get("request_id") and not e.get("request_ids"):
                     raise RuntimeError(
                         f"uncorrelated {kind} event on the bus: {e}")
+    return float(sum(results[k] for k in sorted(results)))
+
+
+def _usage_storm(entry: dict, seed: int) -> float:
+    """The serve storm with the books audited: concurrent tenants,
+    bounded retries, and — after every request lands — the tenant-cost
+    conservation invariant asserted EXACTLY (`obs.attribution`).  All
+    operands are uploaded BEFORE the attribution baseline is taken
+    (client-side H2D outside serve billing windows is not serve cost),
+    so the grand flops/bytes must equal the engine rollup bit-for-bit
+    and per-tenant billings must sum to the grand totals, whatever the
+    schedule shed, degraded or faulted."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from dbcsr_tpu import serve
+    from dbcsr_tpu.core.config import set_config
+    from dbcsr_tpu.obs import attribution, metrics
+    from dbcsr_tpu.ops.test_methods import checksum, make_random_matrix
+
+    set_config(serve_coalesce=True, serve_window_ms=20.0)
+    bs = entry["bs"]
+    n_tenants = entry["usage_tenants"]
+    n_req = entry["usage_requests"]
+    eng = serve.ServeEngine(start=True)
+    sessions = []
+    mats: dict = {}
+    for i in range(n_tenants):
+        sess = eng.open_session(f"usage-tenant{i}")
+        sessions.append(sess)
+        for rep in range(n_req):
+            a = make_random_matrix(
+                "A", bs, bs, dtype=entry["dtype"],
+                occupation=entry["occ"],
+                rng=np.random.default_rng(seed + 7 * rep))
+            b = make_random_matrix(
+                "B", bs, bs, dtype=entry["dtype"],
+                occupation=entry["occ"],
+                rng=np.random.default_rng(seed + 7 * rep + 1))
+            c = make_random_matrix(
+                "C", bs, bs, dtype=entry["dtype"], occupation=0.3,
+                rng=np.random.default_rng(seed + 7 * rep + 2))
+            a.map_bin_data(lambda d: d * (1.0 + i))
+            b.map_bin_data(lambda d: d * (1.0 + 0.5 * i))
+            sess.put(f"A{rep}", a)
+            sess.put(f"B{rep}", b)
+            sess.put(f"C{rep}", c)
+            mats[(i, rep)] = c
+    # baseline AFTER the uploads: from here on, every device-side
+    # byte/flop the process spends happens inside a billing window
+    metrics.reset()
+    results: dict = {}
+    failures: list = []
+    lock = threading.Lock()
+
+    def client(i: int) -> None:
+        try:
+            sess = sessions[i]
+            for rep in range(n_req):
+                for _attempt in range(60):
+                    t = eng.submit(sess, a=f"A{rep}", b=f"B{rep}",
+                                   c=f"C{rep}", alpha=1.0, beta=0.0)
+                    if t.wait(timeout=120) and t.state == "done":
+                        break
+                    _time.sleep(0.02)  # shed/failed: retry
+                else:
+                    raise RuntimeError(
+                        f"request never served after retries: {t.info()}")
+        except Exception as exc:
+            with lock:
+                failures.append(exc)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_tenants)]
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+        if failures:
+            raise failures[0]
+        eng.shutdown()  # quiesce: no billing window left in flight
+        # audit the books BEFORE touching any result matrix: a
+        # checksum's D2H readback happens outside serve billing
+        # windows and is not serve cost (same reason the baseline
+        # follows the uploads)
+        cons = attribution.conservation()
+        for k, v in cons["tenant_sum"].items():
+            if v != cons["grand"][k]:
+                raise RuntimeError(
+                    f"attribution conservation broken: "
+                    f"tenant_sum[{k}]={v} != grand[{k}]="
+                    f"{cons['grand'][k]} ({cons})")
+        for k in ("flops", "bytes_moved"):
+            if cons["grand"][k] != cons["rollup"][k]:
+                raise RuntimeError(
+                    f"attribution conservation broken: grand[{k}]="
+                    f"{cons['grand'][k]} != rollup[{k}]="
+                    f"{cons['rollup'][k]} ({cons})")
+        if abs(cons["grand"]["device_ns"] / 1e9
+               - cons["rollup"]["device_seconds"]) > 1e-6:
+            raise RuntimeError(
+                f"attribution device-seconds drifted past the "
+                f"per-window quantization: {cons}")
+        for key in sorted(mats):
+            results[key] = checksum(mats[key])
+    finally:
+        eng.shutdown()
+        for s in sessions:
+            s.close()
     return float(sum(results[k] for k in sorted(results)))
 
 
@@ -710,6 +831,8 @@ def _one_product(entry: dict, seed: int):
         return _tune_storm(entry, seed)
     if entry.get("serve_tenants"):
         return _serve_storm(entry, seed)
+    if entry.get("usage_tenants"):
+        return _usage_storm(entry, seed)
     if entry.get("delta_iters"):
         return _delta_chain(entry, seed)
     if entry.get("purify_steps"):

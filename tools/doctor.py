@@ -144,6 +144,16 @@ HINTS = {
         "burning cycles — check the trial watchdog channel "
         "(tune_trial) and the last_error in the tune health component",
         "docs/autotuning.md#runbook-failing-trials"),
+    "tenant_hotspot": (
+        "one tenant dominates the attributed device time; check its "
+        "request mix and quotas (and `tools/usage_report.py` for the "
+        "capacity math) before adding capacity for everyone",
+        SERVE_RUNBOOK + "#usage-metering--capacity-planning"),
+    "incident_captured": (
+        "the process auto-captured incident bundle(s) on an "
+        "anomaly/SLO rising edge; render one offline with "
+        "`python tools/doctor.py --bundle incidents/<file>.jsonl`",
+        "docs/observability.md#incident-bundles"),
 }
 
 # the telemetry cells --trend tables by default (history worth eyes:
@@ -228,19 +238,61 @@ def fetch_live(url: str, timeout: float = 10.0) -> dict:
         except urllib.error.HTTPError as e:  # 503 CRITICAL still has a body
             return e.read().decode()
 
-    return {
+    live = {
         "health": json.loads(get("/healthz")),
         "metrics_text": get("/metrics"),
         "events": json.loads(get("/events")),
         "flight": json.loads(get("/flight")),
+        "usage": None,
     }
+    try:  # pre-v5 endpoints have no /usage route
+        usage = json.loads(get("/usage"))
+        if isinstance(usage, dict) and "tenants" in usage:
+            live["usage"] = usage
+    except ValueError:
+        pass
+    return live
+
+
+def read_bundle(path: str) -> dict:
+    """Parse an incident bundle (`dbcsr_tpu.obs.incidents`, typed JSONL
+    with a ``rec`` discriminator) back into analyze()'s inputs."""
+    out: dict = {"meta": {}, "health": None, "sample": None,
+                 "usage": None, "events": [], "flight": []}
+    for rec in _read_jsonl(path):
+        kind = rec.get("rec")
+        if kind == "meta":
+            out["meta"] = rec
+        elif kind in ("health", "sample", "usage"):
+            out[kind] = rec.get(kind)
+        elif kind == "event":
+            out["events"].append(rec)
+        elif kind == "flight":
+            out["flight"].append(rec)
+    return out
+
+
+def usage_from_rollup(path: str) -> dict | None:
+    """The committed USAGE_ROLLUP.jsonl artifact re-shaped into the
+    `/usage` endpoint's dict (delegates to `tools/usage_report.py` —
+    the ONE reader of that artifact)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import usage_report
+
+    try:
+        rollup = usage_report.read_rollup(path)
+    except OSError:
+        return None
+    if not rollup["tenants"] and not rollup["totals"]:
+        return None
+    return {"tenants": rollup["tenants"], "totals": rollup["totals"]}
 
 
 # ----------------------------------------------------------- analysis
 
 def analyze(health: dict | None, prom: dict, events: list,
             flight: list, probe: list, captures: list,
-            top: int = 5) -> dict:
+            top: int = 5, usage: dict | None = None) -> dict:
     """Fold every available signal into one report dict (the renderer
     and --json both consume this)."""
     report: dict = {"health": health, "hints": []}
@@ -537,6 +589,56 @@ def analyze(health: dict | None, prom: dict, events: list,
         report["hints"].append(_hint("slo_burn", detail=", ".join(
             f"{n} ({b}x)" for n, b in sorted(slo_burning.items()))))
 
+    # tenant cost attribution: the /usage dict (live), an incident
+    # bundle's usage section, or the committed USAGE_ROLLUP.jsonl
+    # re-shaped by usage_from_rollup — else the tenant meter counters
+    if usage is None:
+        meters: dict = {}
+        meter_keys = (("dbcsr_tpu_tenant_device_seconds_total",
+                       "device_seconds"),
+                      ("dbcsr_tpu_tenant_flops_total", "flops"),
+                      ("dbcsr_tpu_tenant_bytes_moved_total", "bytes_moved"),
+                      ("dbcsr_tpu_tenant_saved_flops_total", "saved_flops"))
+        for metric, field in meter_keys:
+            for labels, v in prom.get(metric, []):
+                meters.setdefault(labels.get("tenant", "?"), {})[field] = v
+        if meters:
+            usage = {"tenants": meters, "totals": {}}
+    if usage and usage.get("tenants"):
+        rows = {t: {
+            "device_seconds": float(r.get("device_seconds") or 0.0),
+            "flops": int(r.get("flops") or 0),
+            "bytes_moved": int(r.get("bytes_moved") or 0),
+            "saved_flops": int(r.get("saved_flops") or 0),
+            "requests": int(r.get("requests") or 0),
+        } for t, r in usage["tenants"].items()}
+        report["usage"] = {"tenants": rows,
+                           "totals": dict(usage.get("totals") or {})}
+        total_dev = sum(r["device_seconds"] for r in rows.values())
+        named = {t: r for t, r in rows.items() if t != "(evicted)"}
+        if total_dev > 0 and len(named) >= 2:
+            hot, row = max(named.items(),
+                           key=lambda kv: kv[1]["device_seconds"])
+            share = row["device_seconds"] / total_dev
+            if share >= 0.6:
+                report["hints"].append(_hint(
+                    "tenant_hotspot",
+                    detail=f"{hot} holds {share:.0%} of attributed "
+                           f"device time"))
+
+    # incident bundles: the capture counter, else the bus event
+    incidents = 0.0
+    for labels, v in prom.get("dbcsr_tpu_incident_bundles_total", []):
+        if labels.get("result") == "captured":
+            incidents += v
+    if not incidents:
+        incidents = sum(1 for e in events
+                        if e.get("event") == "incident_captured")
+    if incidents:
+        report["incidents"] = int(incidents)
+        report["hints"].append(_hint(
+            "incident_captured", detail=f"{int(incidents)} bundle(s)"))
+
     # anomalies: live health verdict first, else anomaly events
     anomalies: dict = collections.Counter()
     if health:
@@ -668,6 +770,27 @@ def render(report: dict, out=print) -> None:
         if sv.get("deadline_offenders"):
             out("   top deadline-miss offenders: " + ", ".join(
                 f"{t} ({n})" for t, n in sv["deadline_offenders"]))
+    if report.get("usage"):
+        ug = report["usage"]
+        totals = ug.get("totals") or {}
+        head = " tenant usage:"
+        if totals.get("device_seconds") is not None:
+            head += f" total_dev_s={float(totals['device_seconds']):.6f}"
+        if totals.get("requests"):
+            head += f" requests={int(totals['requests'])}"
+        out(head)
+        ranked = sorted(ug["tenants"].items(),
+                        key=lambda kv: -kv[1]["device_seconds"])
+        for t, r in ranked:
+            parts = [f"dev_s={r['device_seconds']:.6f}",
+                     f"flops={r['flops']}"]
+            if r.get("requests"):
+                parts.append(f"reqs={r['requests']}")
+            if r.get("saved_flops"):
+                parts.append(f"saved_flops={r['saved_flops']}")
+            out(f"   {t:<20} " + ", ".join(parts))
+    if report.get("incidents"):
+        out(f" incident bundles captured: {report['incidents']}")
     if report.get("integrity"):
         ig = report["integrity"]
         parts = []
@@ -932,6 +1055,49 @@ def _selftest(repo_root: str) -> int:
     report = analyze(None, {}, events, [], probe, captures)
     render(report)
 
+    # --bundle offline: a synthetic incident bundle (the JSONL shape
+    # dbcsr_tpu.obs.incidents persists) through read_bundle + analyze —
+    # the usage section, the hotspot hint and the incident marker must
+    # all materialize from the file alone
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as fh:
+        bundle_path = fh.name
+        fh.write(json.dumps({"rec": "meta", "kind": "incident",
+                             "reason": "slo_burn:serve_p95_latency",
+                             "t_unix": 1.0, "pid": 42}) + "\n")
+        fh.write(json.dumps({"rec": "health", "health": {
+            "status": "DEGRADED", "components": {}}}) + "\n")
+        fh.write(json.dumps({"rec": "usage", "usage": {"tenants": {
+            "alice": {"device_seconds": 0.9, "flops": 900,
+                      "bytes_moved": 9000, "saved_flops": 0,
+                      "requests": 9},
+            "bob": {"device_seconds": 0.1, "flops": 100,
+                    "bytes_moved": 1000, "saved_flops": 50,
+                    "requests": 1},
+        }, "totals": {"device_seconds": 1.0, "requests": 10}}}) + "\n")
+        fh.write(json.dumps({"rec": "event", "event": "incident_captured",
+                             "reason": "slo_burn:serve_p95_latency"})
+                 + "\n")
+    try:
+        bundle = read_bundle(bundle_path)
+        breport = analyze(bundle["health"], {}, bundle["events"],
+                          bundle["flight"], [], [],
+                          usage=bundle["usage"])
+        render(breport)
+    finally:
+        os.unlink(bundle_path)
+    bundle_ok = (
+        bundle["meta"].get("reason") == "slo_burn:serve_p95_latency"
+        and breport["usage"]["tenants"]["alice"]["device_seconds"] == 0.9
+        and breport["usage"]["totals"]["requests"] == 10
+        and breport["incidents"] == 1
+        and any(h["kind"] == "tenant_hotspot" for h in breport["hints"])
+        and any(h["kind"] == "incident_captured"
+                for h in breport["hints"])
+    )
+
     # --trend offline: a synthetic 2-process shard family (one rank
     # healthy, one with a burning serve-latency SLO) through the full
     # trend pipeline — per-cell sparklines + the burn summary
@@ -967,7 +1133,7 @@ def _selftest(repo_root: str) -> int:
         and any("slo burn summary" in ln for ln in trend_lines)
     )
 
-    ok = trend_ok and (
+    ok = trend_ok and bundle_ok and (
         report["health"]["status"] in ("DEGRADED", "CRITICAL")
         and report["breakers"].get("pallas|23x23x23xfloat64") == "open"
         and report["watchdog"].get("tpu_probe", {}).get("wedge_streak") == 2
@@ -1012,6 +1178,14 @@ def main(argv=None) -> int:
                     help="watchdog probe JSONL (capture loop)")
     ap.add_argument("--captures", default="BENCH_CAPTURES.jsonl",
                     help="bench capture JSONL (roofline fractions)")
+    ap.add_argument("--bundle",
+                    help="incident bundle JSONL (dbcsr_tpu.obs."
+                         "incidents, incidents/incident-*.jsonl): "
+                         "diagnose the captured moment offline")
+    ap.add_argument("--usage", default="USAGE_ROLLUP.jsonl",
+                    help="tenant usage rollup JSONL (the capture "
+                         "loop's committed USAGE_ROLLUP.jsonl) for "
+                         "the tenant-cost section in artifact mode")
     ap.add_argument("--timeseries", default="timeseries.jsonl",
                     help="telemetry time-series shard base or file "
                          "(--trend artifact mode; the committed "
@@ -1062,10 +1236,34 @@ def main(argv=None) -> int:
             render_trend(report)
         return 0
 
+    if args.bundle:
+        bundle = read_bundle(args.bundle)
+        if not bundle["meta"] and not bundle["events"] \
+                and bundle["health"] is None:
+            print(f"doctor: no bundle records in {args.bundle!r}",
+                  file=sys.stderr)
+            return 2
+        report = analyze(bundle["health"], {}, bundle["events"],
+                         bundle["flight"], [], [], top=args.top,
+                         usage=bundle["usage"])
+        report["incident"] = {k: bundle["meta"].get(k)
+                              for k in ("reason", "ts", "t_unix", "pid")
+                              if bundle["meta"].get(k) is not None}
+        if args.as_json:
+            print(json.dumps(report, default=str))
+        else:
+            meta = report["incident"]
+            print(f" incident bundle: reason={meta.get('reason', '?')}"
+                  + (f" ts={meta['ts']}" if meta.get("ts") else "")
+                  + (f" pid={meta['pid']}" if meta.get("pid") else ""))
+            render(report)
+        return 0
+
     health = None
     prom: dict = {}
     events: list = []
     flight: list = []
+    usage = None
     if args.url or args.port:
         url = args.url or f"http://127.0.0.1:{args.port}"
         try:
@@ -1078,9 +1276,12 @@ def main(argv=None) -> int:
         prom = parse_prometheus(live["metrics_text"])
         events = live["events"]
         flight = live["flight"]
+        usage = live.get("usage")
     else:
         for shard in expand_shards(args.events):
             events.extend(_read_jsonl(shard))
+        if os.path.exists(args.usage):
+            usage = usage_from_rollup(args.usage)
         if not events:
             # fall back to trace instants: same event names, no ring
             for shard in expand_shards(args.trace):
@@ -1092,7 +1293,7 @@ def main(argv=None) -> int:
     captures = _read_jsonl(args.captures)
 
     report = analyze(health, prom, events, flight, probe, captures,
-                     top=args.top)
+                     top=args.top, usage=usage)
     # tier-0 lint artifact (tools/capture_tiered.py banks LINT.json):
     # a tree that fails its own invariant analyzer taints every other
     # number this report vouches for

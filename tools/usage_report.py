@@ -1,0 +1,189 @@
+"""dbcsr_tpu usage report: tenant cost rollup -> capacity estimate.
+
+Reads the committed ``USAGE_ROLLUP.jsonl`` artifact (written by the
+capture loop's usage tier, `tools/capture_tiered.py`) or any file in
+the same shape, and turns the attributed per-request device time plus
+the serving SLO latency target into the number an on-call/capacity
+planner actually wants: **sustainable requests/s per worker**.
+
+    python tools/usage_report.py                       # ./USAGE_ROLLUP.jsonl
+    python tools/usage_report.py --rollup path.jsonl --slo-ms 250
+    python tools/usage_report.py --json
+
+Artifact shape (one JSON object per line, ``kind`` discriminator):
+
+    {"kind": "usage_meta",   "obs_schema": 5, "slo_target_ms": 500.0, ...}
+    {"kind": "tenant_usage", "tenant": "alice", "device_seconds": ...,
+     "flops": ..., "bytes_moved": ..., "saved_flops": ..., "requests": ...}
+    {"kind": "usage_totals", "device_seconds": ..., "requests": ..., ...}
+
+Capacity model (documented so the number is auditable, M/M/1 with an
+exponential sojourn tail): mean service time ``s`` is the attributed
+device-seconds per request; the p95 sojourn time of an M/M/1 queue is
+``~ 3 s / (1 - rho)`` (``ln 20 ~= 3``), so holding p95 under the SLO
+target ``T`` bounds utilization at ``rho = 1 - 3 s / T`` (clamped to
+[0, 0.95]); the sustainable arrival rate per worker is then
+``rho / s`` requests/s.  When the target cannot be met even unloaded
+(``3 s >= T``) the report says so instead of printing a zero.
+
+No dbcsr_tpu import — works on an artifact copied off another machine.
+The SLO target falls back to ``DBCSR_TPU_SLO_SERVE_P95_MS`` (the same
+knob the live SLO evaluator reads), default 500 ms.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_ROLLUP = "USAGE_ROLLUP.jsonl"
+DEFAULT_SLO_MS = 500.0
+MAX_UTILIZATION = 0.95
+P95_TAIL_FACTOR = 3.0  # ln(20): P(T > t) = exp(-t / E[T]) at p95
+
+
+def read_rollup(path: str) -> dict:
+    """{"meta": dict, "tenants": {name: row}, "totals": dict} from the
+    typed-JSONL artifact; torn/unknown lines are skipped."""
+    meta: dict = {}
+    tenants: dict = {}
+    totals: dict = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            kind = rec.get("kind")
+            if kind == "usage_meta":
+                meta = rec
+            elif kind == "tenant_usage":
+                tenants[rec.get("tenant", "?")] = rec
+            elif kind == "usage_totals":
+                totals = rec
+    return {"meta": meta, "tenants": tenants, "totals": totals}
+
+
+def capacity(totals: dict, slo_ms: float) -> dict:
+    """The capacity estimate from attributed totals + the SLO target
+    (see the module docstring for the queueing model)."""
+    requests = int(totals.get("requests") or 0)
+    dev_s = float(totals.get("device_seconds") or 0.0)
+    out: dict = {"slo_target_ms": slo_ms, "requests": requests,
+                 "device_seconds": round(dev_s, 6)}
+    if requests <= 0 or dev_s <= 0.0:
+        out["feasible"] = False
+        out["why"] = "no attributed requests in the rollup"
+        return out
+    service_s = dev_s / requests
+    slo_s = slo_ms / 1e3
+    out["mean_service_ms"] = round(service_s * 1e3, 4)
+    rho = 1.0 - P95_TAIL_FACTOR * service_s / slo_s
+    if rho <= 0.0:
+        out["feasible"] = False
+        out["why"] = (f"p95 target {slo_ms:g} ms is unreachable: even an "
+                      f"unloaded worker's tail is ~"
+                      f"{P95_TAIL_FACTOR * service_s * 1e3:.3f} ms")
+        return out
+    rho = min(rho, MAX_UTILIZATION)
+    out["feasible"] = True
+    out["utilization"] = round(rho, 4)
+    out["req_per_s_per_worker"] = round(rho / service_s, 3)
+    return out
+
+
+def report(rollup: dict, slo_ms: float) -> dict:
+    totals = rollup["totals"]
+    tenants = rollup["tenants"]
+    cap = capacity(totals, slo_ms)
+    total_dev = float(totals.get("device_seconds") or 0.0)
+    rows = []
+    for name, row in sorted(tenants.items(),
+                            key=lambda kv: -float(
+                                kv[1].get("device_seconds") or 0.0)):
+        dev = float(row.get("device_seconds") or 0.0)
+        rows.append({
+            "tenant": name,
+            "device_seconds": round(dev, 6),
+            "share": round(dev / total_dev, 4) if total_dev else 0.0,
+            "requests": int(row.get("requests") or 0),
+            "flops": int(row.get("flops") or 0),
+            "bytes_moved": int(row.get("bytes_moved") or 0),
+            "saved_flops": int(row.get("saved_flops") or 0),
+        })
+    return {"meta": rollup["meta"], "tenants": rows, "totals": totals,
+            "capacity": cap}
+
+
+def render(rep: dict, out=print) -> None:
+    meta = rep.get("meta") or {}
+    out(" dbcsr_tpu usage report"
+        + (f"  (rollup {meta['ts']})" if meta.get("ts") else ""))
+    rows = rep["tenants"]
+    if rows:
+        out(f"   {'tenant':<20} {'dev_s':>12} {'share':>7} {'reqs':>6} "
+            f"{'flops':>14} {'moved_MB':>9} {'saved_flops':>12}")
+        for r in rows:
+            out(f"   {r['tenant']:<20} {r['device_seconds']:>12.6f} "
+                f"{r['share']:>6.1%} {r['requests']:>6} "
+                f"{r['flops']:>14} {r['bytes_moved'] / 1e6:>9.2f} "
+                f"{r['saved_flops']:>12}")
+    else:
+        out("   (no tenant rows in the rollup)")
+    cap = rep["capacity"]
+    out(f" slo target: p95 <= {cap['slo_target_ms']:g} ms")
+    if cap.get("feasible"):
+        out(f" capacity: ~{cap['req_per_s_per_worker']:g} req/s per worker "
+            f"(mean attributed service {cap['mean_service_ms']:g} ms, "
+            f"utilization cap {cap['utilization']:.0%})")
+    else:
+        out(f" capacity: n/a — {cap.get('why', '?')}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--rollup", default=DEFAULT_ROLLUP,
+                    help="usage rollup JSONL (default USAGE_ROLLUP.jsonl)")
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="p95 latency target in ms (default: the "
+                         "artifact's stamp, else DBCSR_TPU_SLO_SERVE_"
+                         f"P95_MS, else {DEFAULT_SLO_MS:g})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report")
+    args = ap.parse_args(argv)
+    try:
+        rollup = read_rollup(args.rollup)
+    except OSError as exc:
+        print(f"usage_report: cannot read {args.rollup!r}: {exc}",
+              file=sys.stderr)
+        return 2
+    if not rollup["totals"] and not rollup["tenants"]:
+        print(f"usage_report: no usage records in {args.rollup!r}",
+              file=sys.stderr)
+        return 2
+    slo_ms = args.slo_ms
+    if slo_ms is None:
+        slo_ms = rollup["meta"].get("slo_target_ms")
+    if slo_ms is None:
+        try:
+            slo_ms = float(os.environ.get("DBCSR_TPU_SLO_SERVE_P95_MS",
+                                          DEFAULT_SLO_MS))
+        except ValueError:
+            slo_ms = DEFAULT_SLO_MS
+    rep = report(rollup, float(slo_ms))
+    if args.as_json:
+        print(json.dumps(rep, default=str))
+    else:
+        render(rep)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
