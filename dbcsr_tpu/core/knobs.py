@@ -190,6 +190,17 @@ KNOBS = {
         "doc": "maximum incident bundles captured per process "
                "(default 8).",
     },
+    "DBCSR_TPU_LOADTEST_SEED": {
+        "owner": "tools/loadtest.py",
+        "doc": "default replay seed for the load harness (default 0): "
+               "same trace + seed => bitwise-identical request stream "
+               "(docs/loadtest.md).",
+    },
+    "DBCSR_TPU_LOADTEST_WAIT_S": {
+        "owner": "tools/loadtest.py",
+        "doc": "per-ticket completion wait during replay legs, seconds "
+               "(default 120).",
+    },
     "DBCSR_TPU_LOCKCHECK": {
         "owner": "utils/lockcheck.py",
         "doc": "=1 enables the dynamic lock-order checker: per-thread "
@@ -411,6 +422,14 @@ KNOBS = {
         "owner": "resilience/watchdog.py",
         "doc": "path persisting watchdog wedge-streak state across "
                "processes.",
+    },
+    "DBCSR_TPU_WORKLOAD": {
+        "owner": "serve/workload.py",
+        "doc": "workload-trace recorder control: unset/'0'/'off' "
+               "disables it (the default — tracing every request is an "
+               "operator decision), a path enables the JSONL shard "
+               "sink capturing each terminal request's digest-only "
+               "schema (docs/loadtest.md).",
     },
     "DBCSR_TPU_XLA_COST": {
         "owner": "obs/costmodel.py",

@@ -63,14 +63,16 @@ from dbcsr_tpu.obs.tracer import (  # noqa: F401
 
 # version stamp for machine-readable obs artifacts (bench capture JSON,
 # trace shards, perf-gate reports): bump when the schema of any of
-# them changes incompatibly.  v5 = tenant cost attribution (tenant
+# them changes incompatibly.  v6 = workload trace capture + capacity
+# certification (workload_request shards, WORKLOAD_TRACE.jsonl,
+# CAPACITY_CERT.json — this PR); v5 = tenant cost attribution (tenant
 # usage meters, the /usage route, incident bundles, the usage rollup
-# artifact — this PR); v4 = telemetry time-series shards + SLO burn
+# artifact); v4 = telemetry time-series shards + SLO burn
 # gauges + the `slo` health component; v3 = event bus JSONL +
 # product_id correlation + health verdicts (PR 5); v2 = trace sharding
 # + roofline/costmodel fields (PR 2); v1 = the original obs subsystem
 # (PR 1).
-OBS_SCHEMA_VERSION = 5
+OBS_SCHEMA_VERSION = 6
 
 
 def enable_trace(path: str | None = None) -> "tracer.Tracer":

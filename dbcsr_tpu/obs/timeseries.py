@@ -513,10 +513,31 @@ def _collect_attribution() -> list:
     return pts
 
 
+def _collect_workload() -> list:
+    """Workload observability plane (serve.workload + tools/loadtest):
+    trace records captured by the serve recorder, replayed-request
+    meters, and whether the recorder sink is live — so a capacity
+    certification run leaves its own telemetry trail."""
+    import sys
+
+    pts: list = []
+    from dbcsr_tpu.obs import metrics
+
+    for name in ("dbcsr_tpu_workload_records_total",
+                 "dbcsr_tpu_replay_requests_total"):
+        for labels, v in metrics.counter_items(name):
+            pts.append((name, labels, v, COUNTER))
+    wl = sys.modules.get("dbcsr_tpu.serve.workload")
+    if wl is not None:  # never import the recorder just to sample it
+        pts.append(("dbcsr_tpu_workload_sink_active", {},
+                    1.0 if wl.sink_active() else 0.0, GAUGE))
+    return pts
+
+
 _COLLECTORS = (_collect_engine, _collect_serve, _collect_breakers,
                _collect_pool, _collect_integrity, _collect_precision,
                _collect_value_reuse, _collect_tune, _collect_health,
-               _collect_attribution)
+               _collect_attribution, _collect_workload)
 
 
 # ------------------------------------------------------------ sampling

@@ -107,6 +107,15 @@ SITES = {
                     "`request_id`, `n`)",
         "corruptible": True, "chaos": True, "dynamic": False,
     },
+    "replay_submit": {
+        "boundary": "the workload-replay submission choke point "
+                    "(`serve.workload.replay_submit`, the load harness "
+                    "and the chaos replay case both go through it) — a "
+                    "fault sheds the replayed submission before it "
+                    "reaches the engine (labels `tenant`, "
+                    "`request_id`; `docs/loadtest.md`)",
+        "corruptible": False, "chaos": True, "dynamic": False,
+    },
     "tune_trial": {
         "boundary": "the online autotuner's trial boundary "
                     "(`tune.trials`, one per candidate sweep; labels "

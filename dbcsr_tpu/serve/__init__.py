@@ -30,6 +30,11 @@ thin glue over the engine machinery PRs 4–7 proved out:
   invalidated through the mutation-epoch machinery, return the cached
   C with zero engine dispatches; ABFT-on hits are re-certified per
   request.  See docs/serving.md § Content-addressed product cache.
+* `workload` — the workload observability loop: terminal-request
+  trace recorder (``DBCSR_TPU_WORKLOAD=<base>``, digest-only operand
+  schema), trace model/synthesizer, and the deterministic replay
+  primitives `tools/loadtest.py` turns into the measured capacity
+  certificate (CAPACITY_CERT.json).  See docs/loadtest.md.
 
 Surface: `obs.server` gains ``/serve/submit``, ``/serve/status`` and
 ``/serve/tenants``; `tools/serve_bench.py` is the many-client
@@ -44,6 +49,10 @@ from dbcsr_tpu.serve.engine import (  # noqa: F401
 )
 from dbcsr_tpu.serve.queue import Rejected, Request  # noqa: F401
 from dbcsr_tpu.serve.session import Session, get_session  # noqa: F401
+
+# imported for its env activation (DBCSR_TPU_WORKLOAD) and so the
+# queue's guarded sys.modules hook finds the recorder
+from dbcsr_tpu.serve import workload  # noqa: F401
 
 __all__ = [
     "ServeEngine", "get_engine", "shutdown",

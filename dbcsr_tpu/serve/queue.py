@@ -147,6 +147,18 @@ class Request:
         self.error = error
         self.result = result
         self.t_done = time.time()
+        # workload-trace recorder (off unless DBCSR_TPU_WORKLOAD is
+        # set): runs AFTER the terminal fields land so the record
+        # carries the classified outcome; same guarded-module pattern
+        # as the attribution ledger above
+        try:
+            import sys
+
+            _wl = sys.modules.get("dbcsr_tpu.serve.workload")
+            if _wl is not None:
+                _wl.on_terminal(self, state)
+        except Exception:
+            pass  # recording must never mask the outcome
         self._event.set()
 
     def info(self) -> dict:

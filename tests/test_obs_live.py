@@ -372,7 +372,8 @@ def test_doctor_runbook_anchors_exist():
             "serving.md": anchors_of("serving.md"),
             "observability.md": anchors_of("observability.md"),
             "static_analysis.md": anchors_of("static_analysis.md"),
-            "autotuning.md": anchors_of("autotuning.md")}
+            "autotuning.md": anchors_of("autotuning.md"),
+            "loadtest.md": anchors_of("loadtest.md")}
     for kind, (_, anchor) in doctor.HINTS.items():
         if anchor.startswith("docs/"):
             doc, frag = anchor[len("docs/"):].split("#", 1)

@@ -1,0 +1,388 @@
+"""Workload trace capture, deterministic replay, capacity certification.
+
+The load-bearing pins: the recorder's shard round-trip (digest-only
+schema — a trace never carries matrix values), `request_stream` as a
+PURE function of (trace, seed) — same inputs, bitwise-identical stream
+— the recorded product-cache repeat structure reproducing under a
+serialized replay, certify's SLO-burn stop condition and knee
+selection, the certificate schema with `tools/perf_gate.py` refusing
+cross-device-kind comparisons, publish refusing degraded certificates,
+and the doctor capacity row/runbook wiring (docs/loadtest.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import loadtest  # noqa: E402
+from dbcsr_tpu import serve  # noqa: E402
+from dbcsr_tpu.core.config import get_config, set_config  # noqa: E402
+from dbcsr_tpu.obs import metrics  # noqa: E402
+from dbcsr_tpu.serve import workload  # noqa: E402
+
+BS = [4] * 5
+
+_CFG_KEYS = ("serve_queue_max", "serve_window_ms", "serve_coalesce",
+             "serve_coalesce_max", "serve_tenant_inflight")
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    prev = {k: getattr(get_config(), k) for k in _CFG_KEYS}
+    metrics.reset()
+    yield
+    workload.disable_sink()
+    serve.shutdown()
+    set_config(**prev)
+    metrics.reset()
+
+
+@pytest.fixture(scope="module")
+def recorded(tmp_path_factory):
+    """One small recorded trace for the whole module: 2 tenants x 4
+    requests over 2 distinct operand pairs — a deliberate digest
+    repeat structure (recorded hit rate 0.5)."""
+    prev = {k: getattr(get_config(), k) for k in _CFG_KEYS}
+    out = str(tmp_path_factory.mktemp("wl") / "trace.jsonl")
+    try:
+        meta = loadtest.record_trace(out, tenants=2, requests=4,
+                                     nblk=len(BS), bsize=BS[0],
+                                     seed=11, distinct=2)
+    finally:
+        set_config(**prev)
+    return out, meta
+
+
+# ------------------------------------------------------------- recorder
+
+def test_shard_roundtrip_digest_only(recorded):
+    trace, meta = recorded
+    records = workload.read_trace(trace)
+    assert meta["requests"] == len(records) == 8
+    assert meta["tenants"] == ["wl-tenant0", "wl-tenant1"]
+    for rec in records:
+        assert rec["kind"] == "workload_request"
+        assert rec["schema"] == workload.WORKLOAD_SCHEMA
+        assert rec["state"] == "done" and rec["outcome"] == "OK"
+        assert rec["latency_ms"] >= 0.0
+        assert rec["params"] == {"alpha": 1.0, "beta": 0.0}
+        for key in ("a", "b", "c"):
+            spec = rec["operands"][key]
+            # digest-only privacy posture: sha1 hex + shape schema,
+            # never values (docs/loadtest.md)
+            assert re.fullmatch(r"[0-9a-f]{40}", spec["digest"])
+            assert spec["row_blk"] == BS and spec["col_blk"] == BS
+            assert set(spec) == {"digest", "fingerprint", "row_blk",
+                                 "col_blk", "dtype", "occupation"}
+    # deterministic read order: arrival time, then request id
+    ts = [(r["t"], r["request_id"]) for r in records]
+    assert ts == sorted(ts)
+
+
+def test_recorder_off_is_inert(recorded):
+    """With no sink the hook is an early return: a terminal request
+    must record nothing and increment nothing."""
+    assert not workload.sink_active()
+    before = list(metrics.counter_items("dbcsr_tpu_workload_records_total"))
+    from dbcsr_tpu.serve import engine as eng_mod
+
+    eng = eng_mod.get_engine(start=True)
+    sess = eng.open_session("inert")
+    try:
+        sess.random("A", BS, BS, dtype=np.float64, occupation=0.5, seed=3)
+        sess.random("B", BS, BS, dtype=np.float64, occupation=0.5, seed=4)
+        sess.create("C", BS, BS, dtype=np.float64)
+        t = eng.submit(sess, a="A", b="B", c="C", alpha=1.0, beta=0.0)
+        assert t.wait(60) and t.state == "done"
+    finally:
+        eng_mod.shutdown()
+        sess.close()
+    after = list(metrics.counter_items("dbcsr_tpu_workload_records_total"))
+    assert after == before
+
+
+# ------------------------------------------------- deterministic replay
+
+def test_request_stream_bitwise_deterministic(recorded):
+    trace, _meta = recorded
+    records = workload.read_trace(trace)
+    s1 = workload.request_stream(records, seed=5)
+    s2 = workload.request_stream(records, seed=5)
+    assert (json.dumps(s1, sort_keys=True)
+            == json.dumps(s2, sort_keys=True))
+    # a different seed reseeds every operand but keeps the structure
+    s3 = workload.request_stream(records, seed=6)
+    assert [e["offset_s"] for e in s3] == [e["offset_s"] for e in s1]
+    assert all(a["operands"]["a"]["seed"] != b["operands"]["a"]["seed"]
+               for a, b in zip(s1, s3))
+    # equal recorded digests -> equal derived seeds (repeat structure)
+    by_digest = {}
+    for e in s1:
+        for spec in e["operands"].values():
+            by_digest.setdefault(spec["digest"], set()).add(spec["seed"])
+    assert all(len(seeds) == 1 for seeds in by_digest.values())
+
+
+def test_derive_seed_pinned():
+    """The digest->seed map is part of the replay contract: a change
+    silently invalidates every shared trace, so the constant is
+    pinned (sha1("<digest>:<seed>") first 4 bytes, big endian)."""
+    assert workload.derive_seed("ab", 0) == 0xB278C76B
+    assert workload.derive_seed("ab", 1) != workload.derive_seed("ab", 0)
+
+
+def test_repeat_rate_fidelity(recorded):
+    """A serialized x1 replay must reproduce the RECORDED product-cache
+    hit rate: digests map to derived seeds, equal digests materialize
+    equal values, the cache keys on value digests."""
+    trace, meta = recorded
+    records = workload.read_trace(trace)
+    model = workload.fit(records)
+    for row in model["tenants"].values():
+        assert row["repeat_rate"] == 0.5
+    assert meta["cache_hit_rate"] == 0.5
+    stream = workload.request_stream(records, seed=0)
+    leg = loadtest.replay_leg(stream, rate_x=4.0, repeats=1,
+                              coalesce=False)
+    assert leg["completed"] == len(stream)
+    assert leg["clean"], leg
+    assert leg["cache_hit_rate"] == meta["cache_hit_rate"]
+
+
+def test_synthesize_scales_model(recorded):
+    trace, _meta = recorded
+    model = workload.fit(workload.read_trace(trace))
+    base = workload.synthesize(model, duration_s=2.0, seed=3)
+    doubled = workload.synthesize(model, rate_x=2.0, tenants_x=2.0,
+                                  duration_s=2.0, seed=3)
+    assert {r["kind"] for r in base} == {"workload_request"}
+    # 2x rate and 2x tenants: ~4x the requests (randomized arrivals;
+    # the bound is loose on purpose)
+    assert len(doubled) > 2 * len(base)
+    assert any("~1" in r["tenant"] for r in doubled)
+    # synthetic traces replay through the same pure stream path
+    s1 = workload.request_stream(base, seed=9)
+    s2 = workload.request_stream(base, seed=9)
+    assert (json.dumps(s1, sort_keys=True)
+            == json.dumps(s2, sort_keys=True))
+
+
+# ---------------------------------------------------------- certify
+
+def _fake_leg(rate_x, rps, clean, burning=()):
+    return {
+        "rate_x": rate_x, "offered": 8, "offered_rps": rps + 1.0,
+        "completed": 8 if clean else 5, "completed_rps": rps,
+        "shed": 0 if clean else 3, "deadline_missed": 0, "failed": 0,
+        "wall_s": 1.0, "p50_ms": 10.0, "p95_ms": 40.0,
+        "requests_per_dispatch": 1.0, "cache_hit_rate": None,
+        "device_seconds": 0.25, "burning": list(burning),
+        "serve_burn": {}, "clean": clean,
+    }
+
+
+def test_certify_slo_burn_stop_and_bisect(recorded, monkeypatch):
+    """The ramp must STOP at the first non-clean leg (the SLO-burn
+    boundary), bisect it, and certify the best clean leg."""
+    trace, _meta = recorded
+    legs = {1.0: _fake_leg(1.0, 10.0, True),
+            2.0: _fake_leg(2.0, 19.0, True),
+            4.0: _fake_leg(4.0, 21.0, False,
+                           burning=["serve_p95_latency"]),
+            3.0: _fake_leg(3.0, 20.0, True),
+            3.5: _fake_leg(3.5, 20.5, False,
+                           burning=["serve_p95_latency"])}
+    probed = []
+
+    def fake_replay(stream, rate_x=1.0, **kw):
+        probed.append(rate_x)
+        return dict(legs[rate_x])
+
+    monkeypatch.setattr(loadtest, "replay_leg", fake_replay)
+    cert = loadtest.certify(trace, seed=0, max_doublings=5,
+                            bisect_iters=2)
+    assert probed == [1.0, 2.0, 4.0, 3.0, 3.5]  # stop at 4, bisect
+    assert cert["kind"] == "capacity_cert"
+    assert cert["value"] == 20.0 and cert["certified_rate_x"] == 3.0
+    assert cert["slo_burn_boundary"]["first_bad_rate_x"] == 3.5
+    assert cert["slo_burn_boundary"]["burning"] == ["serve_p95_latency"]
+    assert not cert["degraded"]
+    assert [leg["rate_x"] for leg in cert["shed_curve"]] == sorted(legs)
+
+
+def test_certify_saturation_rollover(recorded, monkeypatch):
+    """When no leg ever burns (deep CPU run), the ramp stops at the
+    throughput rollover and certifies the best clean leg."""
+    trace, _meta = recorded
+    legs = {1.0: _fake_leg(1.0, 10.0, True),
+            2.0: _fake_leg(2.0, 18.0, True),
+            4.0: _fake_leg(4.0, 12.0, True)}  # past the knee
+
+    monkeypatch.setattr(loadtest, "replay_leg",
+                        lambda stream, rate_x=1.0, **kw:
+                        dict(legs[rate_x]))
+    cert = loadtest.certify(trace, seed=0, max_doublings=5)
+    assert cert["value"] == 18.0 and cert["certified_rate_x"] == 2.0
+    assert cert["slo_burn_boundary"]["first_bad_rate_x"] is None
+
+
+def test_cert_schema_and_stamps(recorded, monkeypatch):
+    trace, _meta = recorded
+    monkeypatch.setattr(loadtest, "replay_leg",
+                        lambda stream, rate_x=1.0, **kw:
+                        _fake_leg(rate_x, 10.0, rate_x < 2.0))
+    cert = loadtest.certify(trace, seed=7, max_doublings=2,
+                            bisect_iters=0)
+    for key in ("kind", "metric", "value", "unit", "device_kind",
+                "device_fallback", "obs_schema", "workload_schema",
+                "trace", "trace_requests", "trace_tenants", "seed",
+                "certified_rate_x", "p50_ms_at_knee", "p95_ms_at_knee",
+                "requests_per_dispatch", "cache_hit_rate",
+                "slo_burn_boundary", "shed_curve", "degraded"):
+        assert key in cert, key
+    assert cert["metric"] == loadtest.CERT_METRIC
+    assert cert["unit"] == "req/s/worker"
+    assert cert["workload_schema"] == workload.WORKLOAD_SCHEMA
+    assert cert["seed"] == 7
+
+
+def test_perf_gate_refuses_device_kind_mismatch(tmp_path):
+    """A CPU-measured certificate must never gate a TPU run: the gate
+    reports the pair incomparable (exit 2), not regressed."""
+    import perf_gate
+
+    base = {"kind": "capacity_cert", "metric": loadtest.CERT_METRIC,
+            "value": 100.0, "unit": "req/s/worker",
+            "device_kind": "cpu", "device_fallback": True}
+    cand = dict(base, device_kind="tpu-v4", device_fallback=False,
+                value=20.0)
+    report = perf_gate.gate([base], [cand])
+    assert report["exit_code"] == 2
+    assert all(row["verdict"] == "incomparable"
+               for row in report["cases"])
+    # same device kind, worse value: a real regression (exit 1)
+    report = perf_gate.gate([base], [dict(base, value=50.0)])
+    assert report["exit_code"] == 1
+
+
+def test_publish_refuses_degraded_and_regressed(tmp_path):
+    cert = {"kind": "capacity_cert", "metric": loadtest.CERT_METRIC,
+            "value": 100.0, "unit": "req/s/worker",
+            "device_kind": "cpu", "device_fallback": True,
+            "certified_rate_x": 4.0, "p95_ms_at_knee": 20.0,
+            "degraded": False}
+    path = str(tmp_path / "CAPACITY_CERT.json")
+    assert loadtest.publish(dict(cert, degraded=True), path) == 3
+    assert not os.path.exists(path)  # refusal leaves no artifact
+    assert loadtest.publish(cert, path) == 0
+    assert json.load(open(path))["value"] == 100.0
+    # a big drop against the committed baseline is refused
+    assert loadtest.publish(dict(cert, value=10.0), path) == 1
+    assert json.load(open(path))["value"] == 100.0  # untouched
+    # --force overrides deliberately
+    assert loadtest.publish(dict(cert, value=10.0), path,
+                            force=True) == 0
+
+
+# ------------------------------------------------- doctor + usage_report
+
+def test_doctor_capacity_row_and_degraded_hint():
+    import doctor
+
+    cert = {"kind": "capacity_cert", "value": 120.0,
+            "unit": "req/s/worker", "certified_rate_x": 8.0,
+            "p50_ms_at_knee": 12.0, "p95_ms_at_knee": 80.0,
+            "cache_hit_rate": 0.5, "requests_per_dispatch": 2.0,
+            "device_kind": "cpu", "degraded": True,
+            "trace": "WORKLOAD_TRACE.jsonl", "seed": 0}
+    report = doctor.analyze(None, {}, [], [], [], [], capacity=cert)
+    assert report["capacity"]["value"] == 120.0
+    kinds = [h["kind"] for h in report["hints"]]
+    assert "capacity_regression" in kinds
+    lines = []
+    doctor.render(report, out=lines.append)
+    assert any(line.startswith(" capacity:") for line in lines)
+
+
+def test_doctor_capacity_anchor_resolves():
+    """The capacity_regression runbook anchor must point at a real
+    heading in docs/loadtest.md (the GitHub anchor convention)."""
+    import doctor
+
+    action, anchor = doctor.HINTS["capacity_regression"]
+    assert anchor.startswith("docs/loadtest.md#")
+    frag = anchor.split("#", 1)[1]
+    md = open(os.path.join(_REPO, "docs", "loadtest.md")).read()
+    anchors = set()
+    for line in md.splitlines():
+        m = re.match(r"^(#+)\s+(.*)$", line)
+        if m:
+            a = re.sub(r"[^\w\s-]", "", m.group(2).lower().strip())
+            anchors.add(a.replace(" ", "-"))
+    assert frag in anchors, (frag, sorted(anchors))
+
+
+def test_usage_report_cross_check_divergence(tmp_path):
+    import usage_report
+
+    rollup = tmp_path / "rollup.jsonl"
+    rollup.write_text(
+        json.dumps({"kind": "usage_meta", "slo_target_ms": 500.0}) + "\n"
+        + json.dumps({"kind": "tenant_usage", "tenant": "a",
+                      "device_seconds": 1.0, "requests": 10}) + "\n"
+        + json.dumps({"kind": "usage_totals", "device_seconds": 1.0,
+                      "requests": 10}) + "\n")
+    # analytic: service 100ms -> rho 0.4 -> 4 req/s; measured 6 req/s
+    # agrees (<2x), 100 req/s diverges (>2x, exit 3)
+    good = tmp_path / "cert_ok.json"
+    good.write_text(json.dumps({"kind": "capacity_cert", "value": 6.0,
+                                "degraded": False}))
+    bad = tmp_path / "cert_bad.json"
+    bad.write_text(json.dumps({"kind": "capacity_cert", "value": 100.0,
+                               "degraded": False}))
+    degraded = tmp_path / "cert_deg.json"
+    degraded.write_text(json.dumps({"kind": "capacity_cert",
+                                    "value": 100.0, "degraded": True}))
+    assert usage_report.main(["--rollup", str(rollup),
+                              "--cert", str(good)]) == 0
+    assert usage_report.main(["--rollup", str(rollup),
+                              "--cert", str(bad)]) == 3
+    # degraded certificates are reported, never cross-checked
+    assert usage_report.main(["--rollup", str(rollup),
+                              "--cert", str(degraded)]) == 0
+    # no certificate: the analytic report stands alone
+    assert usage_report.main(["--rollup", str(rollup),
+                              "--cert", str(tmp_path / "none")]) == 0
+
+
+# --------------------------------------------------- committed artifacts
+
+def test_committed_trace_and_cert_consistent():
+    """The committed fixture pair must parse, agree with each other,
+    and carry the schema stamps replay needs."""
+    trace = os.path.join(_REPO, "WORKLOAD_TRACE.jsonl")
+    cert_path = os.path.join(_REPO, "CAPACITY_CERT.json")
+    if not (os.path.exists(trace) and os.path.exists(cert_path)):
+        pytest.skip("committed workload artifacts not present")
+    records = workload.read_trace(trace)
+    assert records, "committed trace has no workload_request records"
+    assert all(r["schema"] == workload.WORKLOAD_SCHEMA for r in records)
+    cert = json.load(open(cert_path))
+    assert cert["kind"] == "capacity_cert"
+    assert cert["metric"] == loadtest.CERT_METRIC
+    assert cert["workload_schema"] == workload.WORKLOAD_SCHEMA
+    assert cert["trace"] == "WORKLOAD_TRACE.jsonl"
+    assert cert["trace_requests"] == len(records)
+    assert cert["value"] > 0 and not cert["degraded"]
+    # the stream the committed pair certifies is reproducible today
+    stream = workload.request_stream(records, seed=cert["seed"])
+    assert len(stream) == len(records)

@@ -165,6 +165,18 @@ def corpus():
         # bitwise-stable whatever row dispatch picks up
         ("tune_storm", dict(bs=[4] * 6, dtype=np.float64, occ=0.5,
                             tune_requests=2)),
+        # workload-replay case: a trace recorded in-process through the
+        # serve recorder, then replayed via the deterministic replay
+        # path (`serve.workload`) under injected serve_admit/
+        # serve_execute/replay_submit faults — every stream entry must
+        # land EXACTLY once (bounded retries, no request lost or
+        # duplicated, audited against the replay ledger), the faulted
+        # leg's per-request checksums must equal the clean replay
+        # BITWISE (integer-valued operands), and a capacity
+        # certificate built while faults are active must come out
+        # degraded and be REFUSED by `tools.loadtest.publish`
+        ("replay_storm", dict(bs=[4] * 6, dtype=np.float64, occ=0.5,
+                              replay_tenants=2, replay_requests=3)),
     ]
 
 
@@ -193,9 +205,10 @@ def random_schedule(rng: random.Random) -> str:
         opts = [f"seed={rng.randint(0, 2**16)}"]
         if site == "execute_stack":
             opts.append(f"times={rng.randint(1, 2)}")
-        elif site.startswith("serve_"):
+        elif site.startswith("serve_") or site == "replay_submit":
             # bounded like execute_stack: an every-call admission/
-            # execution fault starves the storm case's retry loop
+            # execution/replay-submission fault starves the storm and
+            # replay cases' retry loops
             opts.append(f"times={rng.randint(1, 3)}")
         elif rng.random() < 0.5:
             opts.append(f"times={rng.randint(1, 3)}")
@@ -821,12 +834,223 @@ def _tune_storm(entry: dict, seed: int) -> float:
         return _serve_run("outer", with_cycle=True)
 
 
+def _replay_storm(entry: dict, seed: int) -> float:
+    """Record a small workload trace in-process, then replay it
+    through the deterministic replay path (`serve.workload`) under the
+    OUTER fault schedule.  Contract pinned here:
+
+    * no request lost or duplicated — every stream entry lands exactly
+      ONCE through bounded retries at `workload.replay_submit` (the
+      ``replay_submit`` site fires there), cross-checked against the
+      ``dbcsr_tpu_replay_requests_total`` ledger;
+    * the faulted leg's per-request checksums equal the clean replay
+      BITWISE (integer-valued operands: exact accumulation whatever
+      driver or degraded path a fault forces);
+    * a capacity certificate built while faults are active must carry
+      ``degraded`` and `tools.loadtest.publish` must REFUSE it — the
+      clean run publishes the same shape to prove the refusal is the
+      degraded bit, not an accident."""
+    import tempfile
+
+    import numpy as np
+
+    from dbcsr_tpu import serve
+    from dbcsr_tpu.core.config import set_config
+    from dbcsr_tpu.obs import events as obs_events
+    from dbcsr_tpu.obs import metrics
+    from dbcsr_tpu.ops.test_methods import checksum
+    from dbcsr_tpu.resilience import faults
+    from dbcsr_tpu.serve import workload
+
+    # tools/ is on sys.path (the _hostdev insert); loadtest's import-
+    # time TS-interval default must not leak into the rest of the suite
+    _prev_ts = os.environ.get("DBCSR_TPU_TS_INTERVAL_S")
+    import loadtest
+    if _prev_ts is None:
+        os.environ.pop("DBCSR_TPU_TS_INTERVAL_S", None)
+
+    bs = entry["bs"]
+    n_tenants = int(entry["replay_tenants"])
+    n_req = int(entry["replay_requests"])
+    set_config(serve_coalesce=True, serve_window_ms=5.0,
+               serve_tenant_inflight=64)
+
+    def _record() -> list:
+        """A small live trace: each tenant submits ``n_req``
+        multiplies drawn from 2 operand pairs (digest repeats worth
+        replaying), recorded to a temp shard family."""
+        base = os.path.join(tempfile.mkdtemp(prefix="chaos-replay-"),
+                            "workload.jsonl")
+        workload.enable_sink(base)
+        eng = serve.ServeEngine(start=True)
+        sessions, tickets = [], []
+        try:
+            for ti in range(n_tenants):
+                sess = eng.open_session(f"replay-tenant{ti}")
+                sessions.append(sess)
+                for d in range(2):
+                    s0 = seed + 97 * ti + 11 * d
+                    sess.random(f"A{d}", bs, bs, dtype=entry["dtype"],
+                                occupation=entry["occ"], seed=s0)
+                    sess.random(f"B{d}", bs, bs, dtype=entry["dtype"],
+                                occupation=entry["occ"], seed=s0 + 1)
+                for i in range(n_req):
+                    sess.create(f"C{i}", bs, bs, dtype=entry["dtype"])
+                    tickets.append(eng.submit(
+                        sess, a=f"A{i % 2}", b=f"B{i % 2}", c=f"C{i}",
+                        alpha=1.0, beta=0.0))
+            for t in tickets:
+                if not (t.wait(timeout=120) and t.state == "done"):
+                    raise RuntimeError(
+                        f"replay_storm recording stalled: {t.info()}")
+        finally:
+            eng.shutdown()
+            for s in sessions:
+                s.close()
+            workload.disable_sink()
+        records = workload.read_trace(base)
+        if len(records) != n_tenants * n_req:
+            raise RuntimeError(
+                f"replay_storm: recorded {len(records)} records, "
+                f"expected {n_tenants * n_req}")
+        return records
+
+    def _done_total() -> float:
+        return sum(v for labels, v in metrics.counter_items(
+            "dbcsr_tpu_replay_requests_total")
+            if labels.get("outcome") == "done")
+
+    def _replay(tag: str, stream: list):
+        """One serialized replay leg; returns ({entry_i: checksum},
+        wall seconds).  Faulted submissions/executions are retried
+        (bounded) — the contract is loud rejection and recovery, never
+        silent loss."""
+        eng = serve.ServeEngine(start=True)
+        sessions: dict = {}
+        cache: dict = {}
+        checks: dict = {}
+        d0 = _done_total()
+        t0 = time.perf_counter()
+        try:
+            for ent in stream:
+                sess = sessions.get(ent["tenant"])
+                if sess is None:
+                    sess = eng.open_session(ent["tenant"])
+                    sessions[ent["tenant"]] = sess
+                kwargs = dict(ent.get("params") or {})
+                out_mat = None
+                for k, spec in sorted((ent.get("operands") or {}).items()):
+                    name = (f"{k}-{spec['digest'][:12]}"
+                            if spec.get("role") != "out"
+                            else f"{k}-{tag}-{ent['request_id']}")
+                    fresh = (spec.get("role") == "out"
+                             or (sess.tenant, spec["digest"]) not in cache)
+                    m = workload.materialize(sess, name, spec, cache)
+                    if fresh:
+                        # integer-valued operands: every driver's
+                        # accumulation is exact, so the checksum is
+                        # bitwise whatever path a fault degrades onto
+                        m.map_bin_data(lambda d: np.trunc(d * 4.0))
+                    kwargs[k] = name
+                    if spec.get("role") == "out":
+                        out_mat = m
+                for _attempt in range(60):
+                    try:
+                        t = workload.replay_submit(
+                            eng, sess, ent, kwargs,
+                            request_id=f"{tag}-{ent['request_id']}"
+                                       f"a{_attempt}")
+                    except Exception:
+                        time.sleep(0.02)  # shed at submission: retry
+                        continue
+                    if t.wait(timeout=120) and t.state == "done":
+                        break
+                    time.sleep(0.02)  # shed/failed in-engine: retry
+                else:
+                    raise RuntimeError(
+                        f"replay_storm {tag}: entry {ent['i']} never "
+                        f"served after retries")
+                checks[ent["i"]] = checksum(out_mat)
+                workload.note_replay(ent["tenant"], "done")
+        finally:
+            eng.shutdown()
+            for s in sessions.values():
+                s.close()
+        wall = time.perf_counter() - t0
+        # loss/duplication audit: exactly one completion per stream
+        # entry, and the replay ledger counter agrees
+        if sorted(checks) != list(range(len(stream))):
+            raise RuntimeError(
+                f"replay_storm {tag}: {len(checks)}/{len(stream)} "
+                f"entries landed exactly once")
+        landed = _done_total() - d0
+        if landed != len(stream):
+            raise RuntimeError(
+                f"replay_storm {tag}: replay ledger disagrees with "
+                f"the stream ({landed} != {len(stream)})")
+        return checks, wall
+
+    # record + clean reference in a pristine inner fault context: the
+    # outer schedule applies to the replayed leg, not the fixture
+    with faults.inject_faults(""):
+        records = _record()
+        stream = workload.request_stream(records, seed=seed)
+        ref, ref_wall = _replay("clean", stream)
+
+    # certificate contract: under the outer schedule faults are active
+    # -> degraded -> publish refuses; on the clean run it publishes
+    cert = dict(
+        loadtest._stamps(),
+        kind="capacity_cert",
+        workload_schema=workload.WORKLOAD_SCHEMA,
+        metric=loadtest.CERT_METRIC,
+        value=round(len(stream) / max(ref_wall, 1e-6), 3),
+        unit="req/s/worker",
+        certified_rate_x=1.0,
+        p95_ms_at_knee=0.0,
+        degraded=bool(faults.active()),
+    )
+    cpath = os.path.join(tempfile.mkdtemp(prefix="chaos-cert-"),
+                         "CAPACITY_CERT.json")
+    rc = loadtest.publish(cert, cpath)
+    if cert["degraded"]:
+        if rc != 3 or os.path.exists(cpath):
+            raise RuntimeError(
+                "replay_storm: a degraded certificate was published")
+    elif rc != 0 or not os.path.exists(cpath):
+        raise RuntimeError(
+            f"replay_storm: clean certificate publish failed (rc={rc})")
+
+    if obs_events.enabled():
+        obs_events.clear()  # inner pristine legs are not the outer
+        #                     schedule's correlation count
+    # faulted leg under the OUTER schedule: the ordinary chaos
+    # contract, pinned bitwise against the clean replay
+    out, _wall = _replay("outer", stream)
+    for i in sorted(ref):
+        if out[i] != ref[i]:
+            raise RuntimeError(
+                f"replay_storm: entry {i} checksum {out[i]} != clean "
+                f"{ref[i]} (must be bitwise)")
+    # correlation: no replay-plane rejection may be anonymous
+    if obs_events.enabled():
+        for kind in ("serve_shed", "serve_degrade", "serve_failed",
+                     "serve_deadline_missed"):
+            for e in obs_events.records(kind=kind):
+                if not e.get("request_id") and not e.get("request_ids"):
+                    raise RuntimeError(
+                        f"uncorrelated {kind} event on the bus: {e}")
+    return float(sum(ref[k] for k in sorted(ref)))
+
+
 def _one_product(entry: dict, seed: int):
     import numpy as np
 
     from dbcsr_tpu.mm.multiply import multiply
     from dbcsr_tpu.ops.test_methods import checksum, make_random_matrix
 
+    if entry.get("replay_tenants"):
+        return _replay_storm(entry, seed)
     if entry.get("tune_requests"):
         return _tune_storm(entry, seed)
     if entry.get("serve_tenants"):

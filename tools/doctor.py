@@ -154,6 +154,12 @@ HINTS = {
         "anomaly/SLO rising edge; render one offline with "
         "`python tools/doctor.py --bundle incidents/<file>.jsonl`",
         "docs/observability.md#incident-bundles"),
+    "capacity_regression": (
+        "the committed capacity certificate is degraded or disagrees "
+        "with the live usage meter by >2x; re-run `python tools/"
+        "loadtest.py certify` against the committed trace and "
+        "re-commit CAPACITY_CERT.json only if the change is real",
+        "docs/loadtest.md#capacity-certification"),
 }
 
 # the telemetry cells --trend tables by default (history worth eyes:
@@ -292,7 +298,8 @@ def usage_from_rollup(path: str) -> dict | None:
 
 def analyze(health: dict | None, prom: dict, events: list,
             flight: list, probe: list, captures: list,
-            top: int = 5, usage: dict | None = None) -> dict:
+            top: int = 5, usage: dict | None = None,
+            capacity: dict | None = None) -> dict:
     """Fold every available signal into one report dict (the renderer
     and --json both consume this)."""
     report: dict = {"health": health, "hints": []}
@@ -626,6 +633,42 @@ def analyze(health: dict | None, prom: dict, events: list,
                     detail=f"{hot} holds {share:.0%} of attributed "
                            f"device time"))
 
+    # measured serve capacity: the committed CAPACITY_CERT.json
+    # (tools/loadtest.py).  A degraded certificate, or one that
+    # disagrees with the analytic M/M/1 number derived from the usage
+    # totals by >2x, earns the capacity_regression hint — same
+    # divergence bar as `tools/usage_report.py --cert`.
+    if capacity and capacity.get("kind") == "capacity_cert":
+        report["capacity"] = {k: capacity.get(k) for k in (
+            "value", "unit", "certified_rate_x", "p50_ms_at_knee",
+            "p95_ms_at_knee", "cache_hit_rate", "requests_per_dispatch",
+            "device_kind", "degraded", "trace", "seed")}
+        if capacity.get("degraded"):
+            report["hints"].append(_hint(
+                "capacity_regression",
+                detail="certificate is marked degraded (built under "
+                       "fault injection) — not publishable evidence"))
+        else:
+            totals = ((usage or {}).get("totals") or {})
+            try:
+                import usage_report as _ur
+
+                cap = _ur.capacity(totals, slo_ms=500.0)
+            except Exception:
+                cap = None
+            analytic = (cap or {}).get("req_per_s_per_worker")
+            measured = capacity.get("value")
+            if analytic and measured:
+                ratio = max(measured / analytic, analytic / measured)
+                report["capacity"]["analytic_req_per_s"] = round(
+                    analytic, 4)
+                if ratio > 2.0:
+                    report["hints"].append(_hint(
+                        "capacity_regression",
+                        detail=f"measured {measured:g} vs analytic "
+                               f"{analytic:g} req/s/worker "
+                               f"({ratio:.1f}x apart)"))
+
     # incident bundles: the capture counter, else the bus event
     incidents = 0.0
     for labels, v in prom.get("dbcsr_tpu_incident_bundles_total", []):
@@ -789,6 +832,21 @@ def render(report: dict, out=print) -> None:
             if r.get("saved_flops"):
                 parts.append(f"saved_flops={r['saved_flops']}")
             out(f"   {t:<20} " + ", ".join(parts))
+    if report.get("capacity"):
+        cp = report["capacity"]
+        head = (f" capacity: certified {cp.get('value')} "
+                f"{cp.get('unit', 'req/s/worker')}")
+        if cp.get("certified_rate_x") is not None:
+            head += f" at x{cp['certified_rate_x']:g}"
+        if cp.get("p95_ms_at_knee") is not None:
+            head += f", p95={cp['p95_ms_at_knee']}ms"
+        if cp.get("analytic_req_per_s") is not None:
+            head += f" (analytic {cp['analytic_req_per_s']:g})"
+        if cp.get("device_kind"):
+            head += f" [{cp['device_kind']}]"
+        if cp.get("degraded"):
+            head += " DEGRADED"
+        out(head)
     if report.get("incidents"):
         out(f" incident bundles captured: {report['incidents']}")
     if report.get("integrity"):
@@ -1098,6 +1156,36 @@ def _selftest(repo_root: str) -> int:
                 for h in breport["hints"])
     )
 
+    # --capacity offline: a synthetic certificate through analyze —
+    # the capacity row must render, a degraded cert must hint, and a
+    # clean cert that disagrees with the usage-derived analytic
+    # number by >2x must hint with the divergence
+    cert = {"kind": "capacity_cert", "value": 120.0,
+            "unit": "req/s/worker", "certified_rate_x": 8.0,
+            "p50_ms_at_knee": 12.0, "p95_ms_at_knee": 45.0,
+            "cache_hit_rate": 0.5, "requests_per_dispatch": 3.4,
+            "device_kind": "cpu", "degraded": True,
+            "trace": "WORKLOAD_TRACE.jsonl", "seed": 0}
+    creport = analyze(None, {}, [], [], [], [], capacity=cert)
+    cap_lines: list = []
+    render(creport, out=cap_lines.append)
+    creport2 = analyze(
+        None, {}, [], [], [], [],
+        usage={"tenants": {}, "totals": {"device_seconds": 1.0,
+                                         "requests": 10}},
+        capacity=dict(cert, degraded=False))
+    capacity_ok = (
+        creport["capacity"]["value"] == 120.0
+        and any(h["kind"] == "capacity_regression"
+                and "degraded" in h["detail"] for h in creport["hints"])
+        and any(ln.startswith(" capacity:") for ln in cap_lines)
+        and any(h["kind"] == "capacity_regression"
+                and "apart" in h["detail"] for h in creport2["hints"])
+        and all(h["runbook"].startswith("docs/loadtest.md")
+                for h in creport["hints"] + creport2["hints"]
+                if h["kind"] == "capacity_regression")
+    )
+
     # --trend offline: a synthetic 2-process shard family (one rank
     # healthy, one with a burning serve-latency SLO) through the full
     # trend pipeline — per-cell sparklines + the burn summary
@@ -1133,7 +1221,7 @@ def _selftest(repo_root: str) -> int:
         and any("slo burn summary" in ln for ln in trend_lines)
     )
 
-    ok = trend_ok and bundle_ok and (
+    ok = trend_ok and bundle_ok and capacity_ok and (
         report["health"]["status"] in ("DEGRADED", "CRITICAL")
         and report["breakers"].get("pallas|23x23x23xfloat64") == "open"
         and report["watchdog"].get("tpu_probe", {}).get("wedge_streak") == 2
@@ -1186,6 +1274,10 @@ def main(argv=None) -> int:
                     help="tenant usage rollup JSONL (the capture "
                          "loop's committed USAGE_ROLLUP.jsonl) for "
                          "the tenant-cost section in artifact mode")
+    ap.add_argument("--capacity", default="CAPACITY_CERT.json",
+                    help="measured capacity certificate JSON "
+                         "(tools/loadtest.py certify) for the "
+                         "capacity row + regression hint")
     ap.add_argument("--timeseries", default="timeseries.jsonl",
                     help="telemetry time-series shard base or file "
                          "(--trend artifact mode; the committed "
@@ -1291,9 +1383,16 @@ def main(argv=None) -> int:
                                            event=rec.get("name")))
     probe = _read_jsonl(args.probe)
     captures = _read_jsonl(args.captures)
+    capacity = None
+    if os.path.exists(args.capacity):
+        try:
+            with open(args.capacity) as fh:
+                capacity = json.load(fh)
+        except (ValueError, OSError):
+            capacity = None
 
     report = analyze(health, prom, events, flight, probe, captures,
-                     top=args.top, usage=usage)
+                     top=args.top, usage=usage, capacity=capacity)
     # tier-0 lint artifact (tools/capture_tiered.py banks LINT.json):
     # a tree that fails its own invariant analyzer taints every other
     # number this report vouches for

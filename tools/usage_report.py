@@ -10,6 +10,15 @@ planner actually wants: **sustainable requests/s per worker**.
     python tools/usage_report.py --rollup path.jsonl --slo-ms 250
     python tools/usage_report.py --json
 
+When a measured capacity certificate exists (``CAPACITY_CERT.json``,
+written by ``tools/loadtest.py certify`` — override with ``--cert``),
+the report cross-checks the analytic number against the measured one
+side by side and **exits 3 when they diverge by more than 2×**: that
+catches a stale analytic model (the workload changed under it) or a
+broken replay (the measured number is nonsense) — either way a human
+must look before trusting a capacity plan.  A degraded certificate is
+reported but never cross-checked.
+
 Artifact shape (one JSON object per line, ``kind`` discriminator):
 
     {"kind": "usage_meta",   "obs_schema": 5, "slo_target_ms": 500.0, ...}
@@ -39,9 +48,13 @@ import os
 import sys
 
 DEFAULT_ROLLUP = "USAGE_ROLLUP.jsonl"
+DEFAULT_CERT = "CAPACITY_CERT.json"
 DEFAULT_SLO_MS = 500.0
 MAX_UTILIZATION = 0.95
 P95_TAIL_FACTOR = 3.0  # ln(20): P(T > t) = exp(-t / E[T]) at p95
+# analytic-vs-measured divergence past this factor exits non-zero:
+# >2x apart means the model or the measurement is wrong, not noise
+MAX_DIVERGENCE = 2.0
 
 
 def read_rollup(path: str) -> dict:
@@ -97,7 +110,41 @@ def capacity(totals: dict, slo_ms: float) -> dict:
     return out
 
 
-def report(rollup: dict, slo_ms: float) -> dict:
+def read_cert(path: str) -> dict | None:
+    """The measured capacity certificate, or None when absent or not
+    a certificate (the cross-check is strictly opt-in evidence)."""
+    try:
+        with open(path) as fh:
+            cert = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(cert, dict) or cert.get("kind") != "capacity_cert":
+        return None
+    return cert
+
+
+def cross_check(cap: dict, cert: dict) -> dict:
+    """Analytic vs measured, side by side.  ``diverged`` is True when
+    both numbers exist and sit more than MAX_DIVERGENCE apart."""
+    measured = cert.get("value")
+    analytic = cap.get("req_per_s_per_worker")
+    out = {
+        "measured_req_per_s": measured,
+        "analytic_req_per_s": analytic,
+        "certificate_degraded": bool(cert.get("degraded")),
+        "device_kind": cert.get("device_kind"),
+        "certified_rate_x": cert.get("certified_rate_x"),
+        "diverged": False,
+    }
+    if cert.get("degraded") or not measured or not analytic:
+        return out
+    ratio = max(measured / analytic, analytic / measured)
+    out["ratio"] = round(ratio, 3)
+    out["diverged"] = ratio > MAX_DIVERGENCE
+    return out
+
+
+def report(rollup: dict, slo_ms: float, cert: dict | None = None) -> dict:
     totals = rollup["totals"]
     tenants = rollup["tenants"]
     cap = capacity(totals, slo_ms)
@@ -116,8 +163,11 @@ def report(rollup: dict, slo_ms: float) -> dict:
             "bytes_moved": int(row.get("bytes_moved") or 0),
             "saved_flops": int(row.get("saved_flops") or 0),
         })
-    return {"meta": rollup["meta"], "tenants": rows, "totals": totals,
-            "capacity": cap}
+    rep = {"meta": rollup["meta"], "tenants": rows, "totals": totals,
+           "capacity": cap}
+    if cert is not None:
+        rep["cross_check"] = cross_check(cap, cert)
+    return rep
 
 
 def render(rep: dict, out=print) -> None:
@@ -143,6 +193,20 @@ def render(rep: dict, out=print) -> None:
             f"utilization cap {cap['utilization']:.0%})")
     else:
         out(f" capacity: n/a — {cap.get('why', '?')}")
+    xc = rep.get("cross_check")
+    if xc:
+        line = (f" measured:  {xc['measured_req_per_s']:g} req/s per "
+                f"worker (certificate"
+                + (f", {xc['device_kind']}" if xc.get("device_kind")
+                   else "") + ")")
+        if xc["certificate_degraded"]:
+            line += " DEGRADED — not cross-checked"
+        elif xc.get("ratio") is not None:
+            line += (f" — {xc['ratio']:g}x "
+                     + ("apart: DIVERGED (model stale or replay "
+                        "broken)" if xc["diverged"] else
+                        "apart: consistent"))
+        out(line)
 
 
 def main(argv=None) -> int:
@@ -155,6 +219,10 @@ def main(argv=None) -> int:
                     help="p95 latency target in ms (default: the "
                          "artifact's stamp, else DBCSR_TPU_SLO_SERVE_"
                          f"P95_MS, else {DEFAULT_SLO_MS:g})")
+    ap.add_argument("--cert", default=DEFAULT_CERT,
+                    help="measured capacity certificate "
+                         "(tools/loadtest.py certify; skipped silently "
+                         "when absent)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable report")
     args = ap.parse_args(argv)
@@ -177,11 +245,17 @@ def main(argv=None) -> int:
                                           DEFAULT_SLO_MS))
         except ValueError:
             slo_ms = DEFAULT_SLO_MS
-    rep = report(rollup, float(slo_ms))
+    cert = read_cert(args.cert)
+    rep = report(rollup, float(slo_ms), cert=cert)
     if args.as_json:
         print(json.dumps(rep, default=str))
     else:
         render(rep)
+    if (rep.get("cross_check") or {}).get("diverged"):
+        print(f"usage_report: analytic and measured capacity diverge "
+              f"by >{MAX_DIVERGENCE:g}x — capacity plan untrustworthy "
+              f"until a human reconciles them", file=sys.stderr)
+        return 3
     return 0
 
 
