@@ -1073,9 +1073,13 @@ def _run_candidate(base, a_data, b_data, fb_plan, alpha, c_zero,
     candidate (falsely tripping their breakers).  We are already on
     the failure path — one C copy per attempt is cheap insurance.
 
-    Under ``DBCSR_TPU_ABFT=recover`` the candidate's output is itself
-    probe-verified against ``base`` before being accepted — a recovery
-    must never replace one silently-corrupted result with another."""
+    Whenever the ABFT plane is armed (``verify`` or ``recover``) the
+    candidate's output is itself probe-verified against ``base`` before
+    being accepted — a recovery must never replace one
+    silently-corrupted result with another.  Gating this on ``recover``
+    alone left a gap: under ``verify`` a flip corrupting the pristine
+    same-driver retry was accepted unprobed (and even counted as a
+    recovery) — pinned by tests/test_integrity.py."""
     trial = jnp.array(base, copy=True)
     if _faults.active():
         _faults.maybe_inject("execute_stack", driver=fb_plan.driver)
@@ -1085,7 +1089,7 @@ def _run_candidate(base, a_data, b_data, fb_plan, alpha, c_zero,
     if checks_on and _output_corrupted(out):
         raise CorruptedOutputError(
             f"driver {fb_plan.driver!r} produced non-finite output blocks")
-    if _abft.recover_enabled():
+    if _abft.enabled():
         _abft.check_stack(base, out, a_data, b_data, fb_plan, alpha)
     return out
 

@@ -119,6 +119,49 @@ KNOBS = {
                "[,seed=][,times=][,sleep=]', ';'-separated "
                "(docs/resilience.md).",
     },
+    "DBCSR_TPU_FLEET_BACKOFF_S": {
+        "owner": "serve/router.py",
+        "doc": "fleet router base retry backoff seconds (doubles per "
+               "attempt; default 0.05).",
+    },
+    "DBCSR_TPU_FLEET_CACHE_TIMEOUT_S": {
+        "owner": "serve/product_cache.py",
+        "doc": "fleet-shared product-cache tier: per-peer lookup "
+               "timeout seconds (default 0.3); a slow/down peer costs "
+               "one timeout, then the cool-off degrades lookups to "
+               "local-only.",
+    },
+    "DBCSR_TPU_FLEET_HEARTBEAT_TIMEOUT_S": {
+        "owner": "serve/router.py",
+        "doc": "fleet router heartbeat probe timeout seconds "
+               "(default 2).",
+    },
+    "DBCSR_TPU_FLEET_PEER_COOLOFF_S": {
+        "owner": "serve/product_cache.py",
+        "doc": "seconds a failed fleet cache peer is skipped before "
+               "being probed again (default 30).",
+    },
+    "DBCSR_TPU_FLEET_PEERS": {
+        "owner": "serve/product_cache.py",
+        "doc": "comma-separated sibling-worker obs URLs for the "
+               "fleet-shared product-cache tier (set per worker by "
+               "serve.fleet; empty = local-only).",
+    },
+    "DBCSR_TPU_FLEET_RETRIES": {
+        "owner": "serve/router.py",
+        "doc": "routed submit attempts per request before the router "
+               "marks the worker suspect and raises (default 3).",
+    },
+    "DBCSR_TPU_FLEET_SUBMIT_TIMEOUT_S": {
+        "owner": "serve/router.py",
+        "doc": "per-attempt HTTP timeout of a routed submit, seconds "
+               "(default 10).",
+    },
+    "DBCSR_TPU_FLEET_SUSPECT_AFTER": {
+        "owner": "serve/router.py",
+        "doc": "consecutive missed heartbeats before a SUSPECT worker "
+               "is declared DOWN (default 3).",
+    },
     "DBCSR_TPU_FLIGHT_DUMP": {
         "owner": "obs/flight.py",
         "doc": "path the flight recorder dumps to at process exit.",
@@ -291,6 +334,14 @@ KNOBS = {
         "doc": "idle seconds before a tenant's engine accounting rows "
                "(rolling latency window, outcome tallies) expire "
                "(default 3600).",
+    },
+    "DBCSR_TPU_SERVE_WAL": {
+        "owner": "serve/engine.py",
+        "doc": "=1 journals every admitted by-name request to "
+               "DBCSR_TPU_SERVE_JOURNAL at SUBMIT time (write-ahead) "
+               "instead of only at drain, tombstoned at its terminal "
+               "state — what makes a SIGKILLed fleet worker's queue "
+               "replayable on a peer (docs/serving.md § fleet).",
     },
     "DBCSR_TPU_SLO_CRITICAL_BURN": {
         "owner": "obs/slo.py",

@@ -140,6 +140,11 @@ from dbcsr_tpu.obs.windows import Window as _Window  # noqa: E402
 
 _serve_window = _Window(_window_n())
 
+# fleet worker liveness, fed by the serve router's heartbeat loop
+# (`serve.router.FleetRouter` via observe_fleet): worker name -> up.
+# Empty = this process routes no fleet (the component reads OK).
+_fleet_state: dict = {}
+
 
 def _threshold(name: str, default: float) -> float:
     v = _th_cache.get(name)
@@ -181,6 +186,7 @@ def reset() -> None:
         _peak_cache.clear()
         _th_cache.clear()
         _serve_window.clear()
+        _fleet_state.clear()
 
 
 def _counter_total(name: str) -> float:
@@ -676,6 +682,43 @@ def _params_generation() -> int:
         return 0
 
 
+def observe_fleet(workers: dict) -> None:
+    """Router feed: the live worker-liveness map ``{name: up}`` (the
+    whole table each heartbeat round — workers that left the fleet
+    leave the map, so a drained-and-removed worker stops paging)."""
+    with _lock:
+        _fleet_state.clear()
+        _fleet_state.update({str(k): bool(v) for k, v in workers.items()})
+
+
+def _eval_fleet() -> dict:
+    """The serve fleet's component (fed by `serve.router` heartbeats):
+    OK when every known worker is up (or this process routes no
+    fleet), DEGRADED when some workers are down (capacity lost, the
+    router re-places around them), CRITICAL when ALL are down (no
+    routable worker — the fleet serves nothing).  Advisory like
+    ``slo``/``tune``: a dead PEER must never close THIS process's own
+    admission (docs/serving.md § fleet)."""
+    with _lock:
+        snap = dict(_fleet_state)
+    if not snap:
+        return {"status": OK, "reasons": [], "workers": {}}
+    down = sorted(w for w, up in snap.items() if not up)
+    status, reasons = OK, []
+    if down and len(down) == len(snap):
+        status = CRITICAL
+        reasons.append(
+            f"all {len(snap)} fleet workers down ({', '.join(down)}) "
+            "— docs/serving.md#runbook-worker-down")
+    elif down:
+        status = DEGRADED
+        reasons.append(
+            f"{len(down)}/{len(snap)} fleet workers down "
+            f"({', '.join(down)}) — the router routes around them; "
+            "docs/serving.md#runbook-worker-down")
+    return {"status": status, "reasons": reasons, "workers": snap}
+
+
 def _eval_slo() -> dict:
     """The SLO plane's component (`obs.slo.component`): error-budget
     burn over the telemetry history store — OK with a reason when the
@@ -703,9 +746,11 @@ def _components(include_slo: bool = True) -> dict:
         # the ADVISORY components: they page operators via the full
         # verdict but must never close serve admission — an SLO burn
         # feeding back into sheds (or a sick background tuner shedding
-        # live traffic) would be a positive feedback loop
+        # live traffic) would be a positive feedback loop; likewise a
+        # dead fleet PEER must not shed this worker's own traffic
         components["slo"] = _eval_slo()
         components["tune"] = _eval_tune()
+        components["fleet"] = _eval_fleet()
     return components
 
 

@@ -65,6 +65,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -72,6 +73,10 @@ from dbcsr_tpu.obs import tracer as _trace
 
 _lock = threading.Lock()
 _server: "ObsServer | None" = None
+# /serve/stage's per-process materialization memo: (tenant, digest) ->
+# matrix (the loadtest mat_cache contract — repeated digests reuse ONE
+# object so the value-digest memo and product cache behave as live)
+_stage_cache: dict = {}
 # remembered when an early start() could not bind (index unknown and
 # the base port was taken by another rank): rebind() retries with the
 # resolved offset
@@ -139,6 +144,35 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/serve/status":
                 q = parse_qs(url.query)
                 self._serve_status(q.get("request_id", [None])[0])
+            elif route == "/serve/heartbeat":
+                # fleet liveness probe: answers whether THIS process is
+                # alive and routable — never 503s on a missing engine
+                # (the router reads `engine`/`draining`, it does not
+                # infer them from the status code)
+                from dbcsr_tpu.serve import engine as _serve
+
+                eng = _serve.current_engine()
+                self._send_json({
+                    "pid": os.getpid(),
+                    "t_unix": time.time(),
+                    "engine": eng is not None and eng.running(),
+                    "draining": bool(eng.draining) if eng else False,
+                    "queue_depth": eng.queue.depth() if eng else 0,
+                })
+            elif route == "/serve/checksum":
+                self._serve_checksum(parse_qs(url.query))
+            elif route == "/serve/cache":
+                # fleet-shared product-cache tier: one entry by digest
+                # handle (serve.product_cache.peer_lookup's wire call)
+                from dbcsr_tpu.serve import product_cache as _pcache
+
+                q = parse_qs(url.query)
+                dig = q.get("digest", [None])[0]
+                payload = _pcache.export_entry(dig) if dig else None
+                if payload is None:
+                    self._send_json({"found": False}, code=404)
+                else:
+                    self._send_json(dict(payload, found=True))
             elif route == "/serve/tenants":
                 eng = self._serve_engine()
                 if eng is None:
@@ -163,6 +197,14 @@ class _Handler(BaseHTTPRequestHandler):
                                "/serve/submit (POST)",
                                "/serve/status?request_id=",
                                "/serve/tenants",
+                               "/serve/heartbeat",
+                               "/serve/checksum?session=&name=",
+                               "/serve/cache?digest=",
+                               "/serve/session/open (POST)",
+                               "/serve/matrix (POST)",
+                               "/serve/stage (POST)",
+                               "/serve/drain (POST)",
+                               "/serve/replay (POST)",
                                "/usage?top="],
                     "process_index": _server.process_index
                     if _server else None,
@@ -262,7 +304,16 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             url = urlparse(self.path)
             route = url.path.rstrip("/")
-            if route != "/serve/submit":
+            handlers = {
+                "/serve/submit": self._serve_submit,
+                "/serve/session/open": self._serve_session_open,
+                "/serve/matrix": self._serve_matrix,
+                "/serve/stage": self._serve_stage,
+                "/serve/drain": self._serve_drain,
+                "/serve/replay": self._serve_replay,
+            }
+            handler = handlers.get(route)
+            if handler is None:
                 self._send_json({"error": f"no POST route {route}"},
                                 code=404)
                 return
@@ -272,43 +323,176 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError:
                 self._send_json({"error": "bad JSON body"}, code=400)
                 return
-            eng = self._serve_engine()
-            if eng is None:
-                return
-            from dbcsr_tpu.serve import session as _session
-
-            sess = _session.get_session(str(body.get("session", "")))
-            if sess is None:
-                self._send_json(
-                    {"error": f"unknown session {body.get('session')!r}"},
-                    code=404)
-                return
-            params = {k: body[k] for k in
-                      ("a", "b", "c", "p", "alpha", "beta", "transa",
-                       "transb", "filter_eps", "retain_sparsity", "steps",
-                       "out")
-                      if k in body}
-            try:
-                req = eng.submit(
-                    sess, op=str(body.get("op", "multiply")),
-                    priority=int(body.get("priority", 10)),
-                    deadline_s=body.get("deadline_s"), **params)
-            except KeyError as exc:  # unregistered matrix name
-                self._send_json({"error": str(exc.args[0])}, code=404)
-                return
-            except ValueError as exc:  # unknown op
-                self._send_json({"error": str(exc)}, code=400)
-                return
-            if body.get("wait"):
-                req.wait(timeout=float(body.get("timeout_s", 30.0)))
-            info = req.info()
-            self._send_json(info, code=429 if req.state == "shed" else 200)
-        except Exception as exc:  # the submit path must never kill the job
+            handler(body)
+        except Exception as exc:  # the serve paths must never kill the job
             try:
                 self._send_json(
                     {"error": f"{type(exc).__name__}: {exc}"}, code=500)
             except Exception:
                 pass
+
+    def _resolve_session(self, body: dict):
+        """The session named by ``body`` or None (a 404 was sent)."""
+        from dbcsr_tpu.serve import session as _session
+
+        sess = _session.get_session(str(body.get("session", "")))
+        if sess is None:
+            self._send_json(
+                {"error": f"unknown session {body.get('session')!r}"},
+                code=404)
+        return sess
+
+    def _serve_submit(self, body: dict) -> None:
+        eng = self._serve_engine()
+        if eng is None:
+            return
+        sess = self._resolve_session(body)
+        if sess is None:
+            return
+        params = {k: body[k] for k in
+                  ("a", "b", "c", "p", "alpha", "beta", "transa",
+                   "transb", "filter_eps", "retain_sparsity", "steps",
+                   "out")
+                  if k in body}
+        try:
+            req = eng.submit(
+                sess, op=str(body.get("op", "multiply")),
+                priority=int(body.get("priority", 10)),
+                deadline_s=body.get("deadline_s"),
+                request_id=body.get("request_id"), **params)
+        except KeyError as exc:  # unregistered matrix name
+            self._send_json({"error": str(exc.args[0])}, code=404)
+            return
+        except ValueError as exc:  # unknown op
+            self._send_json({"error": str(exc)}, code=400)
+            return
+        if body.get("wait"):
+            req.wait(timeout=float(body.get("timeout_s", 30.0)))
+        info = req.info()
+        self._send_json(info, code=429 if req.state == "shed" else 200)
+
+    def _serve_session_open(self, body: dict) -> None:
+        """Open (or idempotently re-open) a session.  An explicit
+        ``session_id`` is what lets the fleet router re-pin a dead
+        worker's tenant sessions on a surviving peer under the SAME
+        id, so journaled requests resolve; re-opening an id the same
+        tenant already holds returns it (idempotent), another tenant's
+        id is refused 409 — the session-name-collision guard."""
+        eng = self._serve_engine()
+        if eng is None:
+            return
+        tenant = str(body.get("tenant") or "")
+        if not tenant:
+            self._send_json({"error": "no tenant"}, code=400)
+            return
+        sid = body.get("session_id")
+        if sid is not None:
+            from dbcsr_tpu.serve import session as _session
+
+            existing = _session.get_session(str(sid))
+            if existing is not None:
+                if existing.tenant != tenant:
+                    self._send_json(
+                        {"error": f"session id {sid!r} is held by "
+                                  f"tenant {existing.tenant!r}"},
+                        code=409)
+                    return
+                self._send_json({"session_id": existing.session_id,
+                                 "tenant": existing.tenant,
+                                 "existing": True})
+                return
+        sess = eng.open_session(tenant, name=sid)
+        self._send_json({"session_id": sess.session_id,
+                         "tenant": sess.tenant, "existing": False})
+
+    def _serve_matrix(self, body: dict) -> None:
+        """Create a matrix in a session by spec — ``random`` (the
+        deterministic per-(session, name, seed) generator: two workers
+        given the same spec materialize bitwise-equal values, the
+        cross-worker failover re-pinning primitive) or ``create``
+        (an empty result target)."""
+        import numpy as np
+
+        sess = self._resolve_session(body)
+        if sess is None:
+            return
+        name = str(body.get("name") or "")
+        row_blk = body.get("row_blk") or []
+        col_blk = body.get("col_blk") or row_blk
+        if not name or not row_blk:
+            self._send_json({"error": "need name and row_blk"}, code=400)
+            return
+        dtype = np.dtype(str(body.get("dtype", "float64")))
+        if str(body.get("kind", "random")) == "create":
+            sess.create(name, row_blk, col_blk, dtype=dtype)
+        else:
+            sess.random(name, row_blk, col_blk, dtype=dtype,
+                        occupation=float(body.get("occupation", 0.5)),
+                        seed=int(body.get("seed", 0)))
+        self._send_json({"ok": True, "session": sess.session_id,
+                         "name": name})
+
+    def _serve_stage(self, body: dict) -> None:
+        """Stage one workload stream entry: materialize its operands
+        into the session (digest-derived seeds — deterministic across
+        workers) and return the submit kwargs.  The stage cache is
+        per-process and memoizes per (tenant, digest) exactly like the
+        loadtest harness's."""
+        from dbcsr_tpu.serve import workload as _workload
+
+        sess = self._resolve_session(body)
+        if sess is None:
+            return
+        entry = body.get("entry")
+        if not isinstance(entry, dict):
+            self._send_json({"error": "no entry"}, code=400)
+            return
+        kwargs = _workload.stage_entry(sess, entry, _stage_cache)
+        self._send_json({"ok": True, "session": sess.session_id,
+                         "kwargs": kwargs})
+
+    def _serve_drain(self, body: dict) -> None:
+        eng = self._serve_engine()
+        if eng is None:
+            return
+        self._send_json(eng.drain(
+            timeout=float(body.get("timeout_s", 30.0)),
+            journal_path=body.get("journal")))
+
+    def _serve_replay(self, body: dict) -> None:
+        """Replay a journal on THIS worker (the fleet failover target's
+        side of the handoff): ``skip_ids`` are request ids the router's
+        ledger knows completed elsewhere — tombstoned, never re-run."""
+        eng = self._serve_engine()
+        if eng is None:
+            return
+        tickets = eng.replay_journal(
+            path=body.get("journal"),
+            skip_ids=body.get("skip_ids") or ())
+        self._send_json({"replayed": [t.request_id for t in tickets],
+                         "count": len(tickets)})
+
+    def _serve_checksum(self, q: dict) -> None:
+        """``/serve/checksum?session=&name=``: the scalar checksum of
+        one registered matrix (`ops.test_methods.checksum`) — what the
+        fleet chaos case compares bitwise across workers."""
+        from dbcsr_tpu.ops.test_methods import checksum
+        from dbcsr_tpu.serve import session as _session
+
+        sid = q.get("session", [None])[0]
+        name = q.get("name", [None])[0]
+        sess = _session.get_session(str(sid or ""))
+        if sess is None:
+            self._send_json({"error": f"unknown session {sid!r}"},
+                            code=404)
+            return
+        try:
+            m = sess.get(str(name or ""))
+        except KeyError as exc:
+            self._send_json({"error": str(exc.args[0])}, code=404)
+            return
+        self._send_json({"session": sess.session_id, "name": name,
+                         "checksum": float(checksum(m))})
 
 
 class ObsServer:

@@ -116,6 +116,32 @@ SITES = {
                     "`request_id`; `docs/loadtest.md`)",
         "corruptible": False, "chaos": True, "dynamic": False,
     },
+    "fleet_route": {
+        "boundary": "the fleet router's placement/submit boundary "
+                    "(`serve.router.FleetRouter` — a fault fails the "
+                    "routed attempt, exercising the retry/backoff and "
+                    "re-placement paths; labels `tenant`, `worker`, "
+                    "`request_id`; `docs/serving.md` § fleet)",
+        # multi-process serving topology: driven deterministically by
+        # the fleet_storm corpus case and the fleet tests, never by the
+        # single-process randomized draw (the multihost_init precedent)
+        "corruptible": False, "chaos": False, "dynamic": False,
+    },
+    "worker_heartbeat": {
+        "boundary": "the fleet router's per-worker heartbeat probe "
+                    "(`serve.router.FleetRouter.check` — a fault counts "
+                    "as a missed beat, driving the UP -> SUSPECT -> "
+                    "DOWN suspicion ladder; labels `worker`)",
+        "corruptible": False, "chaos": False, "dynamic": False,
+    },
+    "fleet_handoff": {
+        "boundary": "the exactly-once failover boundary "
+                    "(`serve.router.FleetRouter.failover` — a fault "
+                    "aborts the handoff attempt before any replay "
+                    "lands; the journal survives for the retry; labels "
+                    "`worker`, `target`)",
+        "corruptible": False, "chaos": False, "dynamic": False,
+    },
     "tune_trial": {
         "boundary": "the online autotuner's trial boundary "
                     "(`tune.trials`, one per candidate sweep; labels "

@@ -49,6 +49,42 @@ def default_journal_path() -> str:
     return os.environ.get("DBCSR_TPU_SERVE_JOURNAL",
                           f"serve_journal-{os.getpid()}.jsonl")
 
+
+def wal_enabled() -> bool:
+    """Write-ahead journaling (``DBCSR_TPU_SERVE_WAL=1``): every
+    admitted by-name request is journaled at SUBMIT time and
+    tombstoned at its terminal state, so a SIGKILLed process leaves
+    exactly its unfinished requests behind for a peer to replay — the
+    fleet's exactly-once failover substrate (docs/serving.md § fleet).
+    Off by default: single-worker drains journal at drain time only."""
+    return os.environ.get("DBCSR_TPU_SERVE_WAL", "") in ("1", "on")
+
+
+def journal_ids(path: str) -> tuple:
+    """``(submitted, tombstoned)`` request-id sets of a journal file —
+    the fleet router's failover audit primitive (pending = submitted -
+    tombstoned).  Torn tail lines are skipped like `replay_journal`."""
+    sub: set = set()
+    done: set = set()
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                rid = rec.get("request_id")
+                if not rid:
+                    continue
+                (done if rec.get("replay_done") else sub).add(rid)
+    except OSError:
+        pass
+    return sub, done
+
+
 _lock = _lockcheck.wrap("serve.engine", threading.Lock())
 _engine: "ServeEngine | None" = None
 
@@ -190,7 +226,8 @@ class ServeEngine:
                 "completed_inflight": drained_clean}
 
     def replay_journal(self, path: Optional[str] = None,
-                       remove: bool = True) -> List[Request]:
+                       remove: bool = True,
+                       skip_ids=()) -> List[Request]:
         """Resubmit every journaled request EXACTLY ONCE per process
         (idempotent on request id: duplicate lines, ids already
         replayed in this process, and ids whose completion tombstone is
@@ -204,7 +241,19 @@ class ServeEngine:
         docs/serving.md § Drain & restart).  Entries whose session id
         is not registered in this process, that admission sheds, or
         that fail to resubmit keep their lines for a later replay.
-        Returns the replayed tickets."""
+
+        ``skip_ids``: request ids the CALLER knows reached a terminal
+        state elsewhere (the fleet router's ledger — e.g. a request
+        the router re-routed after a timeout, now journaled in TWO
+        workers' files).  They are tombstoned, not replayed: the fleet
+        decision lands in the journal itself, so the file retires and
+        a later replay of the same journal cannot double-execute.
+
+        A journal line whose session id resolves to a session of a
+        DIFFERENT tenant is skipped (line kept): on a surviving peer a
+        session NAME may collide with live state, and replaying across
+        that collision would hand one tenant's work — and its results
+        — to another.  Returns the replayed tickets."""
         from dbcsr_tpu.obs import events as _events
         from dbcsr_tpu.obs import metrics as _metrics
         from dbcsr_tpu.serve import session as _session
@@ -229,6 +278,29 @@ class ServeEngine:
                 done_ids.add(rec.get("request_id"))
             else:
                 recs.append(rec)
+        skip = {rid for rid in skip_ids if rid} - done_ids
+        skip &= {r.get("request_id") for r in recs}
+        if skip:
+            try:
+                try:
+                    with open(path, "rb") as fh:
+                        fh.seek(-1, os.SEEK_END)
+                        torn_tail = fh.read(1) != b"\n"
+                except (OSError, ValueError):
+                    torn_tail = False
+                with open(path, "a") as fh:
+                    if torn_tail:
+                        fh.write("\n")
+                    for rid in sorted(skip):
+                        fh.write(json.dumps(
+                            {"request_id": rid, "replay_done": True,
+                             "skipped": True}) + "\n")
+            except OSError:
+                pass  # tombstones not durable — but the caller KNOWS
+                #       these ids completed elsewhere, so they must
+                #       still be skipped this call (a re-execution is
+                #       worse than a non-retired journal line)
+            done_ids |= skip
         tickets: List[Request] = []
         for rec in recs:
             rid = rec.get("request_id")
@@ -237,6 +309,13 @@ class ServeEngine:
             sess = _session.get_session(str(rec.get("session", "")))
             if sess is None:
                 continue  # unresolved session: line stays journaled
+            want = rec.get("tenant")
+            if want is not None and sess.tenant != want:
+                # session-name collision on this (surviving) process:
+                # the registered session belongs to another tenant —
+                # never replay across the boundary; the line stays
+                # for a replay target holding the right session
+                continue
             self._replay_pending[rid] = path
             try:
                 req = self.submit(
@@ -284,9 +363,15 @@ class ServeEngine:
         and tombstone re-replays the request on the next start
         (at-least-once) — accepted work is never lost.  ``shed`` and
         ``journaled`` states do NOT tombstone: the request is going
-        back to (or staying in) the journal, not completing."""
+        back to (or staying in) the journal, not completing.
+        EXCEPTION: a write-ahead-journaled request (`wal_enabled`)
+        tombstones on shed too — its submitter (the fleet router)
+        observed the structured rejection synchronously and owns the
+        retry, so the line completing would otherwise replay a request
+        the router already resubmitted elsewhere."""
         path = req.replay_journal_path
-        if not path or state in ("shed", "journaled"):
+        if not path or state == "journaled" \
+                or (state == "shed" and not req.journal_wal):
             return
         req.replay_journal_path = None
         try:
@@ -417,6 +502,21 @@ class ServeEngine:
                 "deadline_s": deadline_s,
                 "params": journal_params,
             }
+            if req.on_terminal is None and wal_enabled():
+                # write-ahead journal (fleet workers): the line lands
+                # BEFORE admission and the tombstone hook attaches with
+                # it, so a SIGKILL at ANY later point leaves exactly
+                # the unfinished requests pending in the journal
+                wal_path = default_journal_path()
+                try:
+                    with open(wal_path, "a") as fh:
+                        fh.write(json.dumps(req.journal) + "\n")
+                except OSError:
+                    pass  # an unwritable WAL must not refuse traffic
+                else:
+                    req.journal_wal = True
+                    req.replay_journal_path = wal_path
+                    req.on_terminal = self._journal_mark_done
         req.nbytes = self._operand_bytes(params)
         req.ckey = _coalesce.coalesce_key(op, params)
         _attr.on_submit(req)
@@ -682,6 +782,11 @@ class ServeEngine:
         pckey = _pcache.key_of(p) if _pcache.enabled() else None
         if pckey is not None:
             ent = _pcache.lookup(pckey, tenant=req.tenant)
+            if ent is None:
+                # fleet tier: a digest hit on ANY sibling worker
+                # serves this request (DBCSR_TPU_FLEET_PEERS; bounded
+                # degradation to local-only on slow/down peers)
+                ent = _pcache.peer_lookup(pckey, tenant=req.tenant)
             if ent is not None:
                 _pcache.install(ent, p["c"])
                 self._maybe_corrupt_result(p["c"], req.request_id)

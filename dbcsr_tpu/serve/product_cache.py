@@ -28,7 +28,11 @@ and the request dispatches for real.
 
 from __future__ import annotations
 
+import base64
+import hashlib
+import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Optional
 
@@ -50,12 +54,20 @@ class _Entry:
     __slots__ = ("keys", "bins", "nbytes", "tenant", "flops", "seconds",
                  "hits")
 
-    def __init__(self, c: BlockSparseMatrix, tenant: str, flops: int,
-                 seconds: float = 0.0):
+    def __init__(self, c: Optional[BlockSparseMatrix], tenant: str,
+                 flops: int, seconds: float = 0.0, *,
+                 keys=None, bins=None):
         from dbcsr_tpu.core import mempool
 
-        self.keys = c.keys
-        self.bins, self.nbytes = mempool.alias_bins(c)
+        if c is not None:
+            self.keys = c.keys
+            self.bins, self.nbytes = mempool.alias_bins(c)
+        else:
+            # wire path (`entry_from_wire`): pre-built device bins
+            # already owned by THIS process — nothing is aliased
+            self.keys = keys
+            self.bins = list(bins or ())
+            self.nbytes = sum(int(b[1].nbytes) for b in self.bins)
         self.tenant = tenant
         self.flops = int(flops)
         self.seconds = float(seconds)
@@ -65,6 +77,13 @@ class _Entry:
 _entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
 _bytes_total = 0
 _bytes_by_tenant: dict = {}
+# hex digest -> key, for the fleet-shared tier's HTTP handle (a tuple
+# key cannot travel in a URL; its digest can)
+_by_digest: dict = {}
+# peer url -> monotonic deadline until which it is skipped (cool-off
+# after a timeout/error: a down peer costs ONE timeout, then lookups
+# degrade to local-only until the cool-off expires)
+_peer_down: dict = {}
 
 
 def _counter(result: str, **labels):
@@ -192,6 +211,7 @@ def store(key: tuple, c: BlockSparseMatrix, tenant: str,
         if old is not None:
             _drop_locked(old)
         _entries[key] = ent
+        _by_digest[digest_of_key(key)] = key
         _bytes_total += ent.nbytes
         _bytes_by_tenant[tenant] = \
             _bytes_by_tenant.get(tenant, 0) + ent.nbytes
@@ -201,7 +221,8 @@ def store(key: tuple, c: BlockSparseMatrix, tenant: str,
             if len(_entries) == 1 and \
                     _bytes_total <= cfg.serve_product_cache_bytes:
                 break
-            _, evicted = _entries.popitem(last=False)
+            ekey, evicted = _entries.popitem(last=False)
+            _by_digest.pop(digest_of_key(ekey), None)
             _drop_locked(evicted)
             _counter("evict", tenant=evicted.tenant)
     _counter("store", tenant=tenant)
@@ -222,6 +243,7 @@ def invalidate(key: tuple, tenant: str = "?") -> None:
     with _lock:
         ent = _entries.pop(key, None)
         if ent is not None:
+            _by_digest.pop(digest_of_key(key), None)
             _drop_locked(ent)
     if ent is not None:
         _counter("invalidated", tenant=tenant)
@@ -233,6 +255,8 @@ def clear() -> None:
     global _bytes_total
     with _lock:
         _entries.clear()
+        _by_digest.clear()
+        _peer_down.clear()
         _bytes_total = 0
         _bytes_by_tenant.clear()
     _bytes_gauges()
@@ -247,3 +271,145 @@ def snapshot() -> dict:
             "bytes_by_tenant": dict(_bytes_by_tenant),
             "hits": sum(e.hits for e in _entries.values()),
         }
+
+
+# ------------------------------------------------- fleet-shared tier
+#
+# N fleet workers each run this cache locally; a digest hit on ANY of
+# them should serve the product fleet-wide.  Each worker exposes its
+# entries over ``GET /serve/cache?digest=…`` (obs/server.py), and a
+# local miss consults the sibling workers named by
+# ``DBCSR_TPU_FLEET_PEERS`` before dispatching.  Degradation is
+# graceful and bounded: one lookup pays at most one
+# ``DBCSR_TPU_FLEET_CACHE_TIMEOUT_S`` timeout per peer, and a peer
+# that timed out (or errored) is cooled off for
+# ``DBCSR_TPU_FLEET_PEER_COOLOFF_S`` — a dead peer costs ONE timeout,
+# then lookups are local-only until the cool-off expires.
+
+def digest_of_key(key: tuple) -> str:
+    """Stable hex handle of a cache key (tuples of scalar keys, value
+    digests and fingerprints cannot travel in a URL; their repr is
+    deterministic across processes, so its sha1 can)."""
+    return hashlib.sha1(repr(key).encode()).hexdigest()
+
+
+def export_entry(digest_hex: str) -> Optional[dict]:
+    """The wire form of one cached entry by digest handle (the
+    ``/serve/cache`` route's payload), or None when absent.  Bins
+    travel as base64 host bytes — the peer re-uploads them to its own
+    device; aliasing never crosses a process boundary."""
+    with _lock:
+        key = _by_digest.get(digest_hex)
+        ent = _entries.get(key) if key is not None else None
+        if ent is None:
+            return None
+        _entries.move_to_end(key)
+        bins = list(ent.bins)
+        keys = ent.keys
+        meta = {"tenant": ent.tenant, "flops": ent.flops,
+                "seconds": ent.seconds}
+    wire_bins = []
+    for shape, data, count in bins:
+        arr = np.asarray(data)
+        wire_bins.append({
+            "shape": [int(s) for s in arr.shape],
+            "dtype": str(arr.dtype),
+            "count": int(count),
+            "data": base64.b64encode(arr.tobytes()).decode("ascii"),
+        })
+    return dict(meta, digest=digest_hex,
+                keys=np.asarray(keys).tolist(), bins=wire_bins)
+
+
+def entry_from_wire(payload: dict) -> _Entry:
+    """Rebuild a peer-exported entry locally: host bytes -> fresh
+    device buffers (owned by THIS process's runtime from here on)."""
+    import jax.numpy as jnp
+
+    bins = []
+    for b in payload["bins"]:
+        arr = np.frombuffer(
+            base64.b64decode(b["data"]),
+            dtype=np.dtype(b["dtype"])).reshape(b["shape"])
+        bins.append((tuple(int(s) for s in b["shape"]),
+                     jnp.asarray(arr), int(b["count"])))
+    return _Entry(None, str(payload.get("tenant", "?")),
+                  int(payload.get("flops", 0)),
+                  float(payload.get("seconds", 0.0)),
+                  keys=np.ascontiguousarray(payload["keys"], np.int64),
+                  bins=bins)
+
+
+def _peers() -> list:
+    raw = os.environ.get("DBCSR_TPU_FLEET_PEERS", "")
+    return [p.strip().rstrip("/") for p in raw.split(",") if p.strip()]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def peer_lookup(key: tuple, tenant: str = "?") -> Optional[_Entry]:
+    """The fleet tier: after a local miss, ask each sibling worker for
+    the digest before dispatching.  A hit is banked locally under the
+    same key (the next identical submission is a LOCAL hit) and
+    returned; outcomes land on the shared cache counter as
+    ``peer_hit``/``peer_miss``/``peer_error``."""
+    import json as _json
+    import urllib.error as _uerr
+    import urllib.request as _rq
+
+    peers = _peers()
+    if not peers:
+        return None
+    dig = digest_of_key(key)
+    timeout = _env_float("DBCSR_TPU_FLEET_CACHE_TIMEOUT_S", 0.3)
+    cooloff = _env_float("DBCSR_TPU_FLEET_PEER_COOLOFF_S", 30.0)
+    now = time.monotonic()
+    for peer in peers:
+        with _lock:
+            if _peer_down.get(peer, 0.0) > now:
+                continue
+        try:
+            with _rq.urlopen(f"{peer}/serve/cache?digest={dig}",
+                             timeout=timeout) as resp:
+                payload = _json.loads(resp.read().decode())
+        except _uerr.HTTPError as exc:
+            # a structured miss (404 {"found": false}) is a healthy
+            # peer answering — never cool it off for not having the
+            # digest, or the first miss disables the tier for 30s
+            if exc.code == 404:
+                _counter("peer_miss", tenant=tenant)
+                continue
+            with _lock:
+                _peer_down[peer] = time.monotonic() + cooloff
+            _counter("peer_error", tenant=tenant)
+            continue
+        except Exception:
+            with _lock:
+                _peer_down[peer] = time.monotonic() + cooloff
+            _counter("peer_error", tenant=tenant)
+            continue
+        if not payload or not payload.get("found"):
+            _counter("peer_miss", tenant=tenant)
+            continue
+        try:
+            ent = entry_from_wire(payload)
+        except Exception:
+            _counter("peer_error", tenant=tenant)
+            continue
+        global _bytes_total
+        with _lock:
+            if key not in _entries:
+                _entries[key] = ent
+                _by_digest[dig] = key
+                _bytes_total += ent.nbytes
+                _bytes_by_tenant[ent.tenant] = \
+                    _bytes_by_tenant.get(ent.tenant, 0) + ent.nbytes
+        _counter("peer_hit", tenant=tenant)
+        _bytes_gauges()
+        return ent
+    return None

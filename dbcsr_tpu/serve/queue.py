@@ -68,7 +68,7 @@ class Request:
         "request_id", "session", "op", "params", "priority", "t_submit",
         "t_deadline", "t_done", "t_running", "state", "outcome", "error",
         "result", "ckey", "nbytes", "journal", "replay_journal_path",
-        "on_terminal", "_event",
+        "journal_wal", "on_terminal", "_event",
     )
 
     def __init__(self, session, op: str, params: dict,
@@ -101,6 +101,11 @@ class Request:
         #                       request was resubmitted from a drain
         #                       journal: its terminal state appends a
         #                       completion tombstone there
+        self.journal_wal = False  # write-ahead journaled at SUBMIT
+        #                       (DBCSR_TPU_SERVE_WAL): unlike a drain
+        #                       replay, a shed IS terminal for the line
+        #                       — the routed submitter observed it and
+        #                       owns the retry
         self.on_terminal = None  # engine hook invoked by _finish with
         #                       (request, state) BEFORE the terminal
         #                       state becomes visible — the one

@@ -1,0 +1,690 @@
+"""Fault-tolerant serve fleet: health-aware routing, worker failure
+detection, and exactly-once cross-worker failover.
+
+The router tests run against stub HTTP workers (stdlib http.server —
+no engine, no jax boot) so the placement / suspicion-ladder / retry /
+failover semantics are pinned fast and deterministically; the journal
+edge cases (torn tail, duplicate request id in two journals, session
+collision) drive the same failover code path over real journal files.
+The WAL and peer-cache tests use the real engine / cache on CPU.  The
+full multi-process SIGKILL e2e is the slow leg (the chaos suite's
+``fleet_storm`` corpus case exercises it under injected faults too).
+"""
+
+import base64
+import json
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+import pytest
+
+from dbcsr_tpu.obs import events, health, metrics
+from dbcsr_tpu.resilience import faults
+from dbcsr_tpu.serve.router import (DOWN, SETTLED_STATES, SUSPECT, UP,
+                                    FleetRouter, RouteError)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    faults.clear()
+    metrics.reset()
+    health.reset()
+    events.clear()
+    # keep every router timeout/backoff snappy under stubs
+    monkeypatch.setenv("DBCSR_TPU_FLEET_SUBMIT_TIMEOUT_S", "2.0")
+    monkeypatch.setenv("DBCSR_TPU_FLEET_HEARTBEAT_TIMEOUT_S", "2.0")
+    monkeypatch.setenv("DBCSR_TPU_FLEET_BACKOFF_S", "0.01")
+    yield
+    faults.clear()
+    metrics.reset()
+    health.reset()
+    events.clear()
+
+
+# ------------------------------------------------------------- stub worker
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, obj, code=200):
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        st = self.server.stub
+        url = urlparse(self.path)
+        st.calls.append(url.path)
+        if url.path == "/serve/heartbeat":
+            if not st.heartbeat_ok:
+                self._json({"error": "wedged"}, code=500)
+                return
+            self._json({"pid": 1, "t_unix": time.time(),
+                        "engine": True, "draining": st.draining,
+                        "queue_depth": 0})
+        elif url.path == "/healthz":
+            self._json({"status": st.healthz_status},
+                       code=st.healthz_code)
+        elif url.path == "/serve/status":
+            rid = parse_qs(url.query).get("request_id", [""])[0]
+            info = st.known.get(rid)
+            if info is None:
+                self._json({"error": f"unknown request {rid}"},
+                           code=404)
+            else:
+                self._json(info)
+        elif url.path == "/serve/cache":
+            dig = parse_qs(url.query).get("digest", [""])[0]
+            payload = st.cache.get(dig)
+            if payload is None:
+                self._json({"found": False}, code=404)
+            else:
+                self._json(dict(payload, found=True))
+        else:
+            self._json({"error": "no route"}, code=404)
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        st = self.server.stub
+        url = urlparse(self.path)
+        st.calls.append(url.path)
+        length = int(self.headers.get("Content-Length") or 0)
+        body = json.loads(self.rfile.read(length) or b"{}")
+        if url.path == "/serve/submit":
+            rid = body.get("request_id")
+            st.submits.append(rid)
+            if st.submit_mode == "shed":
+                self._json({"request_id": rid, "state": "shed",
+                            "outcome": "shed"}, code=429)
+                return
+            info = {"request_id": rid, "state": "done",
+                    "outcome": "ok", "latency_ms": 1.0}
+            st.known[rid] = info
+            if st.submit_sleep:
+                time.sleep(st.submit_sleep)  # past the router timeout
+            self._json(info)
+        elif url.path == "/serve/session/open":
+            st.opens.append(body)
+            if st.open_code != 200:
+                self._json({"error": "session collision"},
+                           code=st.open_code)
+                return
+            self._json({"session_id": body.get("session_id")
+                        or f"{body['tenant']}-auto"})
+        elif url.path == "/serve/matrix":
+            st.matrices.append(body)
+            self._json({"ok": True, "session": body.get("session"),
+                        "name": body.get("name")})
+        elif url.path == "/serve/stage":
+            st.stages.append(body)
+            self._json({"ok": True, "kwargs": {}})
+        elif url.path == "/serve/replay":
+            st.replays.append(body)
+            self._json({"replayed": st.replay_result})
+        elif url.path == "/serve/drain":
+            self._json({"journal": body.get("journal"),
+                        "journaled": 0, "completed_inflight": True})
+        else:
+            self._json({"error": "no route"}, code=404)
+
+
+class StubWorker:
+    """One configurable fake worker endpoint."""
+
+    def __init__(self):
+        self.calls = []
+        self.heartbeat_ok = True
+        self.draining = False
+        self.healthz_code = 200
+        self.healthz_status = "OK"
+        self.submit_mode = "done"
+        self.submit_sleep = 0.0
+        self.known = {}
+        self.cache = {}
+        self.submits = []
+        self.opens = []
+        self.open_code = 200
+        self.matrices = []
+        self.stages = []
+        self.replays = []
+        self.replay_result = []
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._srv.stub = self
+        self.url = f"http://127.0.0.1:{self._srv.server_port}"
+        t = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        t.start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+@pytest.fixture
+def stubs():
+    made = []
+
+    def make(n=2):
+        made.extend(StubWorker() for _ in range(n))
+        return made
+
+    yield make
+    for s in made:
+        s.stop()
+
+
+def _router(workers, journals=None):
+    journals = journals or {}
+    return FleetRouter([(f"w{i}", s.url, journals.get(f"w{i}"))
+                        for i, s in enumerate(workers)])
+
+
+def _open(r, tenant="t", sid=None):
+    return r.open_session(tenant, session_id=sid)
+
+
+# ------------------------------------------------------------- placement
+
+def test_placement_skips_unroutable_healthz(stubs):
+    w0, w1 = stubs(2)
+    w0.healthz_code = 503
+    w0.healthz_status = "CRITICAL"
+    r = _router([w0, w1])
+    sid = _open(r, "alice")
+    assert r.sessions[sid]["worker"] == "w1"
+    assert r.affinity["alice"] == "w1"
+    # sticky: the second session reuses the binding without re-probing
+    probes = w1.calls.count("/healthz")
+    _open(r, "alice", sid="alice-2")
+    assert w1.calls.count("/healthz") == probes
+
+
+def test_placement_balances_by_tenant_count(stubs):
+    w0, w1 = stubs(2)
+    r = _router([w0, w1])
+    _open(r, "alice")
+    _open(r, "bob")
+    assert {r.affinity["alice"], r.affinity["bob"]} == {"w0", "w1"}
+
+
+def test_no_routable_worker_raises_route_error(stubs):
+    (w0,) = stubs(1)
+    r = _router([w0])
+    r.mark_down("w0")
+    with pytest.raises(RouteError):
+        r.place("alice")
+
+
+# ------------------------------------------------------ failure detection
+
+def test_suspicion_ladder_down_then_rejoin(stubs):
+    events.set_enabled(True)
+    w0, w1 = stubs(2)
+    r = _router([w0, w1])
+    w0.heartbeat_ok = False
+    r.check()
+    assert r.workers["w0"].state == SUSPECT
+    r.check()
+    r.check()  # DBCSR_TPU_FLEET_SUSPECT_AFTER default 3
+    assert r.workers["w0"].state == DOWN
+    assert metrics.gauge("dbcsr_tpu_fleet_worker_up").value(
+        worker="w0") == 0.0
+    assert metrics.gauge("dbcsr_tpu_fleet_worker_up").value(
+        worker="w1") == 1.0
+    fleet = health.verdict()["components"]["fleet"]
+    assert fleet["status"] == "DEGRADED"
+    assert any(e.get("worker") == "w0" and "runbook-worker-down"
+               in e.get("hint", "")
+               for e in events.records(kind="worker_down"))
+    # an answering beat rejoins the worker UP (rising edge on the bus)
+    w0.heartbeat_ok = True
+    r.check()
+    assert r.workers["w0"].state == UP
+    assert any(e.get("worker") == "w0"
+               for e in events.records(kind="worker_up"))
+    assert health.verdict()["components"]["fleet"]["status"] == "OK"
+
+
+def test_all_down_is_critical(stubs):
+    (w0,) = stubs(1)
+    r = _router([w0])
+    r.mark_down("w0")
+    assert health.verdict()["components"]["fleet"]["status"] == "CRITICAL"
+
+
+def test_down_worker_costs_nothing_per_request(stubs):
+    w0, w1 = stubs(2)
+    r = _router([w0, w1])
+    r.mark_down("w0")
+    n0 = len(w0.calls)
+    for t in ("a", "b", "c"):
+        _open(r, t)
+    assert len(w0.calls) == n0  # never probed at placement
+    assert all(v == "w1" for v in r.affinity.values())
+
+
+def test_heartbeat_fault_site_fires(stubs):
+    (w0,) = stubs(1)
+    r = _router([w0])
+    with faults.inject_faults(
+            "worker_heartbeat:raise,prob=1.0,times=1") as sp:
+        r.check()
+    assert sp[0].fired == 1
+    assert r.workers["w0"].state == SUSPECT  # the miss was counted
+    r.check()  # pristine round heals it
+    assert r.workers["w0"].state == UP
+
+
+# -------------------------------------------------------- routed submit
+
+def test_submit_lands_and_ledgers(stubs):
+    w0, w1 = stubs(2)
+    r = _router([w0, w1])
+    sid = _open(r)
+    info = r.submit(sid, request_id="r1", op="multiply")
+    assert info["state"] == "done"
+    landings = r.ledger["r1"]["landings"]
+    assert list(landings.values()) == ["done"]
+    assert r.audit()["unresolved"] == []
+
+
+def test_submit_shed_is_structured_not_a_failure(stubs):
+    w0, w1 = stubs(2)
+    w0.submit_mode = w1.submit_mode = "shed"
+    r = _router([w0, w1])
+    sid = _open(r)
+    info = r.submit(sid, request_id="r1", op="multiply")
+    assert info["state"] == "shed"  # caller owns the retry
+    # shed is a settled admission decision, not an unresolved request
+    assert r.audit()["unresolved"] == []
+
+
+def test_ambiguous_timeout_probes_and_never_resubmits(stubs, monkeypatch):
+    monkeypatch.setenv("DBCSR_TPU_FLEET_SUBMIT_TIMEOUT_S", "0.3")
+    w0, w1 = stubs(2)
+    r = _router([w0, w1])
+    sid = _open(r)
+    owner = r.sessions[sid]["worker"]
+    stub = {"w0": w0, "w1": w1}[owner]
+    stub.submit_sleep = 1.0  # admit, then stall past the timeout
+    info = r.submit(sid, request_id="r-ambig", op="multiply")
+    # the status probe resolved the ambiguity: polled, not re-sent
+    assert info["state"] == "done"
+    assert stub.submits == ["r-ambig"]
+    assert r.audit()["duplicated"] == []
+
+
+def test_fleet_route_fault_retries_then_lands(stubs):
+    w0, w1 = stubs(2)
+    r = _router([w0, w1])
+    sid = _open(r)
+    with faults.inject_faults(
+            "fleet_route:raise,prob=1.0,times=1") as sp:
+        info = r.submit(sid, request_id="r1", op="multiply")
+    assert sp[0].fired == 1
+    assert info["state"] == "done"
+    routed = {(dict(k)["worker"], dict(k)["outcome"]): v
+              for k, v in metrics._counters[
+                  "dbcsr_tpu_fleet_requests_total"].values.items()}
+    assert any(o == "retried" for _, o in routed)
+    assert any(o == "routed" for _, o in routed)
+
+
+def test_submit_exhausted_raises_route_error(stubs, monkeypatch):
+    monkeypatch.setenv("DBCSR_TPU_FLEET_RETRIES", "2")
+    w0, w1 = stubs(2)
+    r = _router([w0, w1])
+    sid = _open(r)
+    with faults.inject_faults("fleet_route:raise,prob=1.0"):
+        with pytest.raises(RouteError):
+            r.submit(sid, request_id="r1", op="multiply")
+    # the exhaustion counted a miss toward the suspicion ladder
+    assert r.workers[r.sessions[sid]["worker"]].misses >= 1
+
+
+# ------------------------------------------------------------- failover
+
+def _journal(path, submitted, tombstoned=(), torn_tail=False):
+    with open(path, "w") as fh:
+        for rid in submitted:
+            fh.write(json.dumps({
+                "request_id": rid, "tenant": "t", "session": "t-s",
+                "op": "multiply", "params": {}}) + "\n")
+        for rid in tombstoned:
+            fh.write(json.dumps({"request_id": rid,
+                                 "replay_done": True}) + "\n")
+        if torn_tail:
+            fh.write('{"request_id": "r-torn", "op": "mul')  # no EOL
+
+
+def test_failover_replays_pending_and_repins(stubs, tmp_path):
+    events.set_enabled(True)
+    w0, w1 = stubs(2)
+    jpath = str(tmp_path / "j-w0.jsonl")
+    _journal(jpath, ["r1", "r2"])
+    r = _router([w0, w1], journals={"w0": jpath})
+    r.affinity["t"] = "w0"  # pin the session to the doomed worker
+    sid = _open(r, "t", sid="t-s")
+    assert r.sessions[sid]["worker"] == "w0"
+    r.matrix(sid, name="a", row_blk=[4], seed=1)
+    w1.replay_result = ["r1", "r2"]
+    w1.known["r1"] = {"request_id": "r1", "state": "done"}
+    w1.known["r2"] = {"request_id": "r2", "state": "done"}
+    w0.stop()
+    r.mark_down("w0")
+    moved = r.failover("w0")
+    assert moved["target"] == "w1"
+    assert moved["pending"] == ["r1", "r2"]
+    assert moved["replayed"] == ["r1", "r2"]
+    assert moved["repinned"] == [sid]
+    # the session re-pinned under the SAME id with its recorded state
+    assert w1.opens[-1]["session_id"] == sid
+    assert w1.matrices and w1.matrices[-1]["name"] == "a"
+    assert r.sessions[sid]["worker"] == "w1"
+    r.settle_replayed(moved["replayed"], "w1")
+    audit = r.audit()
+    assert audit["duplicated"] == [] and audit["unresolved"] == []
+    assert any("exactly-once-failover" in e.get("hint", "")
+               for e in events.records(kind="fleet_failover"))
+    assert metrics.counter_items("dbcsr_tpu_fleet_failovers_total")
+
+
+def test_duplicate_rid_in_two_journals_lands_exactly_once(
+        stubs, tmp_path):
+    """A request routed to w0, timed out, and re-routed to w1 sits in
+    BOTH write-ahead journals.  Once the ledger holds its ``done``
+    from w1, failing w0 over must tombstone it via ``skip_ids`` — one
+    landing fleet-wide."""
+    w0, w1 = stubs(2)
+    jpath = str(tmp_path / "j-w0.jsonl")
+    _journal(jpath, ["r-dup", "r-solo"])
+    r = _router([w0, w1], journals={"w0": jpath})
+    r._land("r-dup", "t", "w1", "done")  # completed on the peer
+    w1.replay_result = ["r-solo"]
+    w1.known["r-solo"] = {"request_id": "r-solo", "state": "done"}
+    w0.stop()
+    r.mark_down("w0")
+    moved = r.failover("w0")
+    assert moved["skipped"] == ["r-dup"]
+    assert moved["replayed"] == ["r-solo"]
+    assert w1.replays[-1]["skip_ids"] == ["r-dup"]
+    r.settle_replayed(moved["replayed"], "w1")
+    audit = r.audit()
+    assert audit["duplicated"] == [] and audit["unresolved"] == []
+    landings = audit["requests"]["r-dup"]["landings"]
+    assert sum(1 for st in landings.values() if st == "done") == 1
+
+
+def test_failover_backfills_tombstoned_ids_from_journal(
+        stubs, tmp_path):
+    """Work that COMPLETED on the dead worker before the crash has a
+    tombstone in its journal but no pollable process: the failover
+    must backfill the ledger from the tombstones or the audit calls
+    finished work unresolved."""
+    w0, w1 = stubs(2)
+    jpath = str(tmp_path / "j-w0.jsonl")
+    _journal(jpath, ["r-done"], tombstoned=["r-done"])
+    r = _router([w0, w1], journals={"w0": jpath})
+    r._land("r-done", "t", "w0", "queued")  # submit-time landing only
+    w0.stop()
+    r.mark_down("w0")
+    moved = r.failover("w0")
+    assert moved["pending"] == [] and moved["replayed"] == []
+    assert r.audit()["unresolved"] == []
+    # wait() short-circuits on the settled landing — no dead-worker poll
+    info = r.wait("r-done", timeout=1.0)
+    assert info["state"] == "done" and info["settled_by"] == "w0"
+
+
+def test_torn_journal_tail_is_skipped(stubs, tmp_path):
+    from dbcsr_tpu.serve import engine as eng_mod
+
+    w0, w1 = stubs(2)
+    jpath = str(tmp_path / "j-w0.jsonl")
+    _journal(jpath, ["r-ok"], torn_tail=True)  # SIGKILL mid-append
+    sub, done = eng_mod.journal_ids(jpath)
+    assert sub == {"r-ok"} and done == set()
+    r = _router([w0, w1], journals={"w0": jpath})
+    w1.replay_result = ["r-ok"]
+    w1.known["r-ok"] = {"request_id": "r-ok", "state": "done"}
+    w0.stop()
+    r.mark_down("w0")
+    moved = r.failover("w0")
+    assert moved["pending"] == ["r-ok"]  # the torn line never replays
+    r.settle_replayed(moved["replayed"], "w1")
+    assert r.audit()["unresolved"] == []
+
+
+def test_session_collision_never_repins_across_tenants(
+        stubs, tmp_path):
+    w0, w1 = stubs(2)
+    r = _router([w0, w1])
+    r.affinity["alice"] = "w0"
+    sid = _open(r, "alice", sid="shared-name")
+    w1.open_code = 409  # the peer already holds this id for bob
+    w0.stop()
+    r.mark_down("w0")
+    moved = r.failover("w0")
+    assert moved["collided"] == [sid]
+    assert moved["repinned"] == []
+    assert r.sessions[sid]["worker"] == "w0"  # binding NOT moved
+    assert w1.matrices == []  # no state re-created under bob's session
+
+
+def test_fleet_handoff_fault_aborts_before_replay(stubs, tmp_path):
+    w0, w1 = stubs(2)
+    jpath = str(tmp_path / "j-w0.jsonl")
+    _journal(jpath, ["r1"])
+    r = _router([w0, w1], journals={"w0": jpath})
+    w0.stop()
+    r.mark_down("w0")
+    with faults.inject_faults(
+            "fleet_handoff:raise,prob=1.0,times=1") as sp:
+        with pytest.raises(Exception):
+            r.failover("w0")
+        assert sp[0].fired == 1
+        assert w1.replays == []  # aborted BEFORE any replay landed
+        assert os.path.exists(jpath)  # the journal survives
+        w1.replay_result = ["r1"]
+        w1.known["r1"] = {"request_id": "r1", "state": "done"}
+        moved = r.failover("w0")  # the retry succeeds
+    assert moved["replayed"] == ["r1"]
+
+
+def test_drain_reconciles_ledger_before_restart(stubs):
+    """A request that completed on a worker BEFORE its drain must get
+    its terminal state into the ledger while the process still
+    remembers it — the rolling restart wipes that memory."""
+    w0, w1 = stubs(2)
+    r = _router([w0, w1])
+    sid = _open(r)
+    owner = r.sessions[sid]["worker"]
+    stub = {"w0": w0, "w1": w1}[owner]
+    r.submit(sid, request_id="r-pre", op="multiply")
+    # regress the landing to a non-terminal submit-time state
+    r.ledger["r-pre"]["landings"][owner] = "queued"
+    r.drain(owner)
+    assert r.ledger["r-pre"]["landings"][owner] == "done"
+    assert not r.workers[owner].routable()  # drained ⇒ unroutable
+    assert stub.calls.count("/serve/drain") == 1
+
+
+# ------------------------------------------------------------ engine WAL
+
+def test_wal_journals_at_submit_and_tombstones_at_done(
+        tmp_path, monkeypatch):
+    from dbcsr_tpu.core.config import set_config
+    from dbcsr_tpu.serve import engine as eng_mod
+
+    jpath = str(tmp_path / "wal.jsonl")
+    monkeypatch.setenv("DBCSR_TPU_SERVE_WAL", "1")
+    monkeypatch.setenv("DBCSR_TPU_SERVE_JOURNAL", jpath)
+    set_config(serve_coalesce=False)
+    eng = eng_mod.get_engine(start=True)
+    try:
+        sess = eng.open_session("wal-t")
+        sess.random("a", [4, 4], [4, 4], dtype=np.float64,
+                    occupation=0.9, seed=1)
+        sess.random("b", [4, 4], [4, 4], dtype=np.float64,
+                    occupation=0.9, seed=2)
+        sess.create("c", [4, 4], [4, 4], dtype=np.float64)
+        t = eng.submit(sess, op="multiply", request_id="wal-r1",
+                       a="a", b="b", c="c", alpha=1.0, beta=0.0)
+        # on disk at SUBMIT time: a SIGKILL from here loses nothing
+        sub, done = eng_mod.journal_ids(jpath)
+        assert "wal-r1" in sub
+        assert t.wait(60.0) and t.state == "done"
+        # tombstoned at the terminal state; a fully-tombstoned journal
+        # retires (the file is removed once nothing is pending)
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if not os.path.exists(jpath):
+                break
+            sub, done = eng_mod.journal_ids(jpath)
+            if "wal-r1" in done:
+                break
+            time.sleep(0.02)
+        assert (not os.path.exists(jpath)
+                or "wal-r1" in eng_mod.journal_ids(jpath)[1])
+    finally:
+        eng_mod.shutdown()
+        sess.close()
+        set_config(serve_coalesce=True)
+
+
+# -------------------------------------------------------- peer cache tier
+
+def _wire_payload(dig, arr):
+    return {"digest": dig, "tenant": "t", "flops": 10, "seconds": 0.01,
+            "keys": [[0, 0, 0]],
+            "bins": [{"shape": list(arr.shape), "dtype": str(arr.dtype),
+                      "count": 1,
+                      "data": base64.b64encode(arr.tobytes()).decode()}]}
+
+
+def test_peer_cache_hit_banks_locally(stubs, monkeypatch):
+    from dbcsr_tpu.serve import product_cache as pc
+
+    (peer,) = stubs(1)
+    key = ("multiply", "testkey", 1.0)
+    dig = pc.digest_of_key(key)
+    arr = np.arange(16, dtype=np.float64).reshape(1, 4, 4)
+    peer.cache[dig] = _wire_payload(dig, arr)
+    monkeypatch.setenv("DBCSR_TPU_FLEET_PEERS", peer.url)
+    pc.clear()
+    ent = pc.peer_lookup(key, tenant="t")
+    assert ent is not None and ent.flops == 10
+    # banked under the same key: the next lookup is LOCAL
+    ncalls = peer.calls.count("/serve/cache")
+    assert pc.lookup(key, tenant="t") is not None
+    assert peer.calls.count("/serve/cache") == ncalls
+    outcomes = {dict(k)["result"]: v for k, v in
+                metrics.counter_items("dbcsr_tpu_product_cache_total")}
+    assert outcomes.get("peer_hit") == 1
+
+
+def test_peer_miss_never_cools_off_the_peer(stubs, monkeypatch):
+    from dbcsr_tpu.serve import product_cache as pc
+
+    (peer,) = stubs(1)
+    monkeypatch.setenv("DBCSR_TPU_FLEET_PEERS", peer.url)
+    pc.clear()
+    assert pc.peer_lookup(("k", 1), tenant="t") is None
+    assert pc.peer_lookup(("k", 2), tenant="t") is None
+    # a healthy peer answering 404 keeps being asked — only timeouts
+    # and errors cool it off
+    assert peer.calls.count("/serve/cache") == 2
+    outcomes = {dict(k)["result"]: v for k, v in
+                metrics.counter_items("dbcsr_tpu_product_cache_total")}
+    assert outcomes.get("peer_miss") == 2
+    assert "peer_error" not in outcomes
+
+
+def test_dead_peer_costs_one_timeout_then_cools_off(monkeypatch):
+    from dbcsr_tpu.serve import product_cache as pc
+
+    with socket.socket() as s:  # a port with NO listener
+        s.bind(("127.0.0.1", 0))
+        dead = f"http://127.0.0.1:{s.getsockname()[1]}"
+    monkeypatch.setenv("DBCSR_TPU_FLEET_PEERS", dead)
+    monkeypatch.setenv("DBCSR_TPU_FLEET_CACHE_TIMEOUT_S", "0.2")
+    pc.clear()
+    t0 = time.perf_counter()
+    assert pc.peer_lookup(("k", 1), tenant="t") is None
+    assert pc.peer_lookup(("k", 2), tenant="t") is None
+    assert pc.peer_lookup(("k", 3), tenant="t") is None
+    # one connection failure, then the cool-off short-circuits
+    assert time.perf_counter() - t0 < 2.0
+    outcomes = {dict(k)["result"]: v for k, v in
+                metrics.counter_items("dbcsr_tpu_product_cache_total")}
+    assert outcomes.get("peer_error") == 1
+
+
+# ------------------------------------------------------------ slow e2e
+
+@pytest.mark.slow
+def test_sigkill_failover_is_exactly_once_e2e(tmp_path):
+    """Real 2-worker fleet: SIGKILL the session owner mid-queue, fail
+    over, and prove every request lands exactly once with checksums
+    bitwise-equal a clean single-worker run (the chaos ``fleet_storm``
+    case drives the same path under injected faults)."""
+    import urllib.request
+
+    from dbcsr_tpu.serve.fleet import Fleet
+
+    def _checksum(url, name):
+        with urllib.request.urlopen(
+                f"{url}/serve/checksum?session=t-s&name={name}",
+                timeout=10) as resp:
+            return json.loads(resp.read())["checksum"]
+
+    def run(n, kill):
+        wd = tmp_path / f"fleet{n}{kill}"
+        wd.mkdir(exist_ok=True)
+        with Fleet(n=n, workdir=str(wd)) as fl:
+            r = fl.router()
+            r.check()
+            sid = r.open_session("t", session_id="t-s")
+            r.matrix(sid, name="a", row_blk=[4, 4, 4], seed=1)
+            r.matrix(sid, name="b", row_blk=[4, 4, 4], seed=2)
+            for i in range(4):
+                r.matrix(sid, name=f"c{i}", row_blk=[4, 4, 4],
+                         kind="create")
+            rids = [r.submit(sid, request_id=f"req-{i}", op="multiply",
+                             a="a", b="b", c=f"c{i}")["request_id"]
+                    for i in range(4)]
+            if kill:
+                owner = r.sessions[sid]["worker"]
+                fl.kill(owner)
+                r.mark_down(owner)
+                moved = r.failover(owner)
+                r.settle_replayed(moved["replayed"], moved["target"],
+                                  timeout=120.0)
+                sums = {f"c{i}": _checksum(
+                    fl.specs[moved["target"]]["url"], f"c{i}")
+                    for i in range(4)
+                    if f"req-{i}" in moved["replayed"]}
+            else:
+                for rid in rids:
+                    assert r.wait(rid, timeout=120.0)[
+                        "state"] == "done"
+                sums = {f"c{i}": _checksum(fl.specs["w0"]["url"],
+                                           f"c{i}")
+                        for i in range(4)}
+            audit = r.audit()
+            assert audit["duplicated"] == []
+            assert audit["unresolved"] == []
+            return sums
+
+    clean = run(1, kill=False)
+    stormed = run(2, kill=True)
+    assert stormed  # the kill left at least one pending request
+    for name, cs in stormed.items():
+        assert cs == clean[name]  # bitwise: replay == clean run

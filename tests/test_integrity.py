@@ -149,6 +149,37 @@ def test_deferred_multi_mismatch_recovery_counters_balance():
     assert mm >= 1 and rc == mm
 
 
+def test_host_flip_corrupted_retry_is_reprobed_and_recovered():
+    """A flip corrupting the pristine SAME-DRIVER retry must be caught
+    by the candidate probe under ``abft=verify`` too: `_run_candidate`
+    used to gate that probe on ``recover`` alone, so a second flip
+    landing on the host driver's retry was accepted unprobed — and
+    even counted as a recovery.  Pinned: both flips detected, the
+    mismatch/recovery counters stay balanced, the chain walks off
+    host, and the final result is correct."""
+    a, b, c = _mats(seed=9)
+    ref_a, ref_b, ref_c = _mats(seed=9)
+    set_config(mm_driver="host")
+    multiply("N", "N", 1.5, ref_a, ref_b, 0.5, ref_c)
+    ref = np.asarray(to_dense(ref_c))
+
+    set_config(abft="verify")
+    with faults.inject_faults("host:flip,seed=5,times=2,prob=1.0") as sp:
+        multiply("N", "N", 1.5, a, b, 0.5, c)
+    assert sp[0].fired == 2  # primary AND its same-driver retry
+    mm = _ctr("dbcsr_tpu_abft_mismatches_total")
+    rc = _ctr("dbcsr_tpu_abft_recoveries_total")
+    assert mm >= 2 and rc == mm
+    # the corrupted retry was rejected and the chain walked off host
+    fb = metrics._counters.get("dbcsr_tpu_driver_fallback_total")
+    pairs = {(dict(k).get("from"), dict(k).get("to"))
+             for k in (fb.values if fb is not None else {})}
+    assert any(f == "host" and t != "host" for f, t in pairs), pairs
+    # healed on a different driver: allclose, not bitwise (the chain
+    # candidate's accumulation order is its own)
+    assert np.allclose(np.asarray(to_dense(c)), ref, rtol=1e-9, atol=0)
+
+
 def test_abft_off_is_zero_overhead_and_blind():
     """With the knob off nothing probes: a flip sails through (the
     pre-ABFT world this PR exists to close) — pinned so the knob's

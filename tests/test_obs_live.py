@@ -156,7 +156,8 @@ def test_endpoint_serves_metrics_and_healthz(endpoint):
     v = json.loads(body)
     assert v["status"] in ("OK", "DEGRADED")
     assert set(v["components"]) == {"drivers", "watchdog", "engine",
-                                    "perf", "integrity", "slo", "tune"}
+                                    "perf", "integrity", "slo", "tune",
+                                    "fleet"}
 
 
 def test_endpoint_serves_flight_and_filtered_events(endpoint):

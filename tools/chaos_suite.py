@@ -177,6 +177,20 @@ def corpus():
         # degraded and be REFUSED by `tools.loadtest.publish`
         ("replay_storm", dict(bs=[4] * 6, dtype=np.float64, occ=0.5,
                               replay_tenants=2, replay_requests=3)),
+        # fleet case: a REAL multi-process serve fleet (serve.fleet
+        # spawns the workers, serve.router routes) — SIGKILL one
+        # worker mid-queue under deterministically injected
+        # fleet_route/fleet_handoff faults, fail its write-ahead
+        # journal over onto the surviving peer, and pin the
+        # exactly-once contract fleet-wide: every admitted request
+        # reaches exactly one terminal state (replay-ledger audit),
+        # result checksums are BITWISE equal to a clean single-worker
+        # run, and a rolling restart of every worker loses zero
+        # requests.  Paired legs in pristine/deterministic inner fault
+        # contexts (the fleet sites are chaos: False — multi-process
+        # topology, the multihost_init precedent)
+        ("fleet_storm", dict(bs=[4] * 6, dtype=np.float64, occ=0.5,
+                             fleet_workers=2, fleet_requests=3)),
     ]
 
 
@@ -1043,12 +1057,209 @@ def _replay_storm(entry: dict, seed: int) -> float:
     return float(sum(ref[k] for k in sorted(ref)))
 
 
+def _fleet_storm(entry: dict, seed: int) -> float:
+    """The multi-process fleet under fire (see the corpus comment).
+    Three legs, all in inner fault contexts so the case is
+    deterministic whatever the outer schedule drew:
+
+    1. clean — ONE worker, no faults: the reference checksums;
+    2. storm — N workers with ``fleet_route``/``fleet_handoff`` raise
+       faults injected in the router process, the session's owning
+       worker SIGKILLed mid-queue, its write-ahead journal failed over
+       onto the surviving peer: every admitted request must reach
+       exactly one terminal state fleet-wide (ledger audit), the
+       liveness gauge and the advisory ``fleet`` health component
+       must name the dead worker, and the failed-over results must be
+       BITWISE equal to leg 1;
+    3. rolling restart — more requests in flight, then every worker
+       drained/replayed/restarted in turn: zero requests lost, audit
+       still clean, results still bitwise."""
+    import urllib.request
+
+    import numpy as np
+
+    from dbcsr_tpu.obs import events as obs_events
+    from dbcsr_tpu.obs import health as obs_health
+    from dbcsr_tpu.obs import metrics
+    from dbcsr_tpu.resilience import faults
+    from dbcsr_tpu.serve.fleet import Fleet
+    from dbcsr_tpu.serve.router import SETTLED_STATES
+
+    bs = entry["bs"]
+    n_workers = int(entry["fleet_workers"])
+    n_req = int(entry["fleet_requests"])
+    dtype_name = np.dtype(entry["dtype"]).name
+    cnames = [f"C{i}" for i in range(n_req)]
+    rnames = [f"R{i}" for i in range(n_req)]
+
+    def _checksums(url: str, sid: str, names) -> dict:
+        out = {}
+        for n in names:
+            with urllib.request.urlopen(
+                    f"{url}/serve/checksum?session={sid}&name={n}",
+                    timeout=10) as resp:
+                out[n] = json.loads(resp.read())["checksum"]
+        return out
+
+    def _stage(router, sid, outs):
+        router.matrix(sid, name="A", row_blk=bs, dtype=dtype_name,
+                      occupation=entry["occ"], seed=seed)
+        router.matrix(sid, name="B", row_blk=bs, dtype=dtype_name,
+                      occupation=entry["occ"], seed=seed + 1)
+        for cn in outs:
+            router.matrix(sid, name=cn, row_blk=bs, dtype=dtype_name,
+                          kind="create")
+
+    def _assert_exactly_once(router, rids):
+        for rid in rids:
+            row = router.ledger.get(rid)
+            landings = row["landings"] if row else {}
+            settled = [w for w, st in landings.items()
+                       if st in SETTLED_STATES]
+            if len(settled) != 1:
+                raise RuntimeError(
+                    f"fleet_storm: request {rid} settled on "
+                    f"{settled or 'no worker'} (landings {landings}) "
+                    f"— not exactly once")
+        audit = router.audit()
+        if audit["duplicated"] or audit["unresolved"]:
+            raise RuntimeError(
+                f"fleet_storm: ledger audit failed — duplicated="
+                f"{audit['duplicated']} unresolved={audit['unresolved']}")
+
+    # leg 1: clean single-worker reference (pristine fault context)
+    with faults.inject_faults(""):
+        with Fleet(n=1) as fl:
+            router = fl.router()
+            router.check()
+            sid = router.open_session("fleet-t", session_id="fleet-s")
+            _stage(router, sid, cnames + rnames)
+            for i, cn in enumerate(cnames + rnames):
+                info = router.submit(
+                    sid, request_id=f"fs-{i}", op="multiply",
+                    a="A", b="B", c=cn, wait=True, timeout_s=120.0)
+                if info["state"] != "done":
+                    raise RuntimeError(
+                        f"fleet_storm clean leg stalled: {info}")
+            ref = _checksums(fl.specs["w0"]["url"], sid,
+                             cnames + rnames)
+
+    # legs 2+3 under the deterministic fleet schedule: the first two
+    # routed attempts and the first failover attempt fail loudly
+    with faults.inject_faults(
+            "fleet_route:raise,prob=1.0,times=2;"
+            "fleet_handoff:raise,prob=1.0,times=1"):
+        with Fleet(n=n_workers) as fl:
+            router = fl.router()
+            router.check()
+            sid = router.open_session("fleet-t", session_id="fleet-s")
+            _stage(router, sid, cnames + rnames)
+            rids = []
+            for i, cn in enumerate(cnames):
+                info = router.submit(sid, request_id=f"fs-{i}",
+                                     op="multiply", a="A", b="B", c=cn)
+                rids.append(info["request_id"])
+            # SIGKILL the owning worker mid-queue: the write-ahead
+            # journal is now the only record of unfinished requests
+            owner = router.sessions[sid]["worker"]
+            fl.kill(owner)
+            router.mark_down(owner)
+            # degradation must be OBSERVABLE before it is repaired
+            up = metrics.gauge("dbcsr_tpu_fleet_worker_up").value(
+                worker=owner)
+            if up != 0.0:
+                raise RuntimeError(
+                    f"fleet_storm: liveness gauge for dead {owner} "
+                    f"reads {up}, want 0")
+            fcomp = (obs_health.verdict().get("components") or {}).get(
+                "fleet") or {}
+            if fcomp.get("status") != "DEGRADED":
+                raise RuntimeError(
+                    f"fleet_storm: fleet health component is "
+                    f"{fcomp.get('status')!r} with {owner} down, "
+                    f"want DEGRADED")
+            # failover: the injected fleet_handoff fault fails the
+            # first attempt BEFORE any replay lands; bounded retry
+            for _attempt in range(10):
+                try:
+                    moved = router.failover(owner)
+                    break
+                except Exception:
+                    time.sleep(0.05)
+            else:
+                raise RuntimeError(
+                    "fleet_storm: failover never succeeded")
+            router.settle_replayed(moved["replayed"], moved["target"],
+                                   timeout=120.0)
+            _assert_exactly_once(router, rids)
+            # bitwise results for every request the peer REPLAYED (a
+            # request that finished on w0 in the instants before the
+            # SIGKILL is settled by its journal tombstone instead —
+            # its output died with the process, never silently wrong)
+            replayed_c = [f"C{rid.split('-')[1]}"
+                          for rid in moved["replayed"]]
+            target_url = fl.specs[moved["target"]]["url"]
+            out = _checksums(target_url, sid, replayed_c)
+            for cn in replayed_c:
+                if out[cn] != ref[cn]:
+                    raise RuntimeError(
+                        f"fleet_storm: {cn} checksum {out[cn]} != "
+                        f"clean {ref[cn]} (must be bitwise)")
+
+            # leg 3: rolling restart with work in flight — the dead
+            # worker rejoins first so every drain has a surviving peer
+            fl.respawn(owner)
+            router.rejoin(owner)
+            rrids = []
+            for i, rn in enumerate(rnames):
+                info = router.submit(
+                    sid, request_id=f"fr-{i}", op="multiply",
+                    a="A", b="B", c=rn)
+                rrids.append(info["request_id"])
+            fl.rolling_restart(router, timeout=120.0)
+            # zero loss: every in-flight request settled exactly once
+            # somewhere (done before its worker drained — reconciled
+            # into the ledger at drain time — or replayed on the peer)
+            _assert_exactly_once(router, rids + rrids)
+            # the upgraded fleet still computes bitwise-identical
+            # results: fresh requests through the restarted workers
+            for i in range(n_req):
+                router.matrix(sid, name=f"P{i}", row_blk=bs,
+                              dtype=dtype_name, kind="create")
+                info = router.submit(
+                    sid, request_id=f"fp-{i}", op="multiply",
+                    a="A", b="B", c=f"P{i}", wait=True,
+                    timeout_s=120.0)
+                if info["state"] != "done":
+                    raise RuntimeError(
+                        f"fleet_storm: post-restart submit stalled: "
+                        f"{info}")
+            sworker = router.sessions[sid]["worker"]
+            out2 = _checksums(fl.specs[sworker]["url"], sid,
+                              [f"P{i}" for i in range(n_req)])
+            for i, rn in enumerate(rnames):
+                if out2[f"P{i}"] != ref[rn]:
+                    raise RuntimeError(
+                        f"fleet_storm: post-restart P{i} checksum "
+                        f"{out2[f'P{i}']} != clean {ref[rn]}")
+    # the router-side story must be on the event bus, correlated
+    if obs_events.enabled():
+        kinds = {e.get("event") for e in obs_events.records()}
+        for want in ("worker_down", "fleet_failover"):
+            if want not in kinds:
+                raise RuntimeError(
+                    f"fleet_storm: no {want} event on the bus")
+    return float(sum(ref[k] for k in sorted(ref)))
+
+
 def _one_product(entry: dict, seed: int):
     import numpy as np
 
     from dbcsr_tpu.mm.multiply import multiply
     from dbcsr_tpu.ops.test_methods import checksum, make_random_matrix
 
+    if entry.get("fleet_workers"):
+        return _fleet_storm(entry, seed)
     if entry.get("replay_tenants"):
         return _replay_storm(entry, seed)
     if entry.get("tune_requests"):

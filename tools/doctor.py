@@ -154,6 +154,17 @@ HINTS = {
         "anomaly/SLO rising edge; render one offline with "
         "`python tools/doctor.py --bundle incidents/<file>.jsonl`",
         "docs/observability.md#incident-bundles"),
+    "worker_down": (
+        "the fleet router declared a worker DOWN (missed heartbeats "
+        "past the suspicion threshold or its process exited); its "
+        "sessions fail over to a surviving peer — check the worker's "
+        "own endpoint/journal before respawning",
+        SERVE_RUNBOOK + "#runbook-worker-down"),
+    "failover_replay": (
+        "a dead/drained worker's journal was replayed on a peer; "
+        "every request id lands exactly once fleet-wide (ledger-"
+        "deduplicated) — audit the router's ledger if counts look off",
+        SERVE_RUNBOOK + "#exactly-once-failover"),
     "capacity_regression": (
         "the committed capacity certificate is degraded or disagrees "
         "with the live usage meter by >2x; re-run `python tools/"
@@ -669,6 +680,49 @@ def analyze(health: dict | None, prom: dict, events: list,
                                f"{analytic:g} req/s/worker "
                                f"({ratio:.1f}x apart)"))
 
+    # fleet: the router's per-worker liveness gauge first
+    # (prometheus), else the worker_down / fleet_failover bus events
+    fleet_row: dict = {"workers": {}}
+    for labels, v in prom.get("dbcsr_tpu_fleet_worker_up", []):
+        fleet_row["workers"][labels.get("worker", "?")] = \
+            "up" if v >= 1.0 else "down"
+    routed = collections.Counter()
+    for labels, v in prom.get("dbcsr_tpu_fleet_requests_total", []):
+        routed[labels.get("outcome", "?")] += int(v)
+    if routed:
+        fleet_row["routed"] = dict(routed)
+    fo = prom.get("dbcsr_tpu_fleet_failovers_total")
+    if fo:
+        fleet_row["failovers"] = int(sum(v for _, v in fo))
+    rp2 = prom.get("dbcsr_tpu_fleet_replayed_total")
+    if rp2:
+        fleet_row["replayed"] = int(sum(v for _, v in rp2))
+    if not fleet_row["workers"]:
+        for e in events:
+            if e.get("event") == "worker_down":
+                fleet_row["workers"][e.get("worker", "?")] = "down"
+            elif e.get("event") == "worker_up":
+                fleet_row["workers"][e.get("worker", "?")] = "up"
+            elif e.get("event") == "fleet_failover":
+                fleet_row["failovers"] = \
+                    fleet_row.get("failovers", 0) + 1
+                fleet_row["replayed"] = \
+                    fleet_row.get("replayed", 0) + int(
+                        e.get("replayed") or 0)
+    if fleet_row["workers"] or fleet_row.get("failovers"):
+        report["fleet"] = fleet_row
+        dead = sorted(w for w, st in fleet_row["workers"].items()
+                      if st == "down")
+        if dead:
+            report["hints"].append(_hint(
+                "worker_down", detail=", ".join(dead)))
+        if fleet_row.get("failovers"):
+            report["hints"].append(_hint(
+                "failover_replay",
+                detail=f"{fleet_row['failovers']} failover(s), "
+                       f"{fleet_row.get('replayed', 0)} request(s) "
+                       f"replayed"))
+
     # incident bundles: the capture counter, else the bus event
     incidents = 0.0
     for labels, v in prom.get("dbcsr_tpu_incident_bundles_total", []):
@@ -847,6 +901,18 @@ def render(report: dict, out=print) -> None:
         if cp.get("degraded"):
             head += " DEGRADED"
         out(head)
+    if report.get("fleet"):
+        fl = report["fleet"]
+        parts = [f"{w}={st}" for w, st in sorted(fl["workers"].items())]
+        if fl.get("routed"):
+            parts.append("routed[" + ", ".join(
+                f"{k}={v}" for k, v in sorted(fl["routed"].items()))
+                + "]")
+        if fl.get("failovers"):
+            parts.append(f"failovers={fl['failovers']}")
+        if fl.get("replayed"):
+            parts.append(f"replayed={fl['replayed']}")
+        out(" fleet: " + ", ".join(parts))
     if report.get("incidents"):
         out(f" incident bundles captured: {report['incidents']}")
     if report.get("integrity"):
@@ -1186,6 +1252,48 @@ def _selftest(repo_root: str) -> int:
                 if h["kind"] == "capacity_regression")
     )
 
+    # fleet offline: the router's liveness gauge + failover counters
+    # through analyze — the fleet row must render, a down worker must
+    # earn the worker_down hint (naming the worker) and a failover
+    # must earn the failover_replay hint, both anchored in the
+    # serving runbook
+    fleet_prom = {
+        "dbcsr_tpu_fleet_worker_up": [({"worker": "w0"}, 0.0),
+                                      ({"worker": "w1"}, 1.0)],
+        "dbcsr_tpu_fleet_requests_total": [
+            ({"worker": "w0", "outcome": "routed"}, 5.0),
+            ({"worker": "w0", "outcome": "retried"}, 2.0)],
+        "dbcsr_tpu_fleet_failovers_total": [
+            ({"worker": "w0", "target": "w1"}, 1.0)],
+        "dbcsr_tpu_fleet_replayed_total": [({"worker": "w1"}, 4.0)],
+    }
+    freport = analyze(None, fleet_prom, [], [], [], [])
+    fleet_lines: list = []
+    render(freport, out=fleet_lines.append)
+    # events-only fallback (a dead process's artifacts)
+    freport2 = analyze(None, {}, [
+        {"event": "worker_down", "worker": "w2", "misses": 3},
+        {"event": "fleet_failover", "worker": "w2", "target": "w3",
+         "replayed": 2},
+    ], [], [], [])
+    fleet_ok = (
+        freport["fleet"]["workers"] == {"w0": "down", "w1": "up"}
+        and freport["fleet"]["failovers"] == 1
+        and freport["fleet"]["replayed"] == 4
+        and any(h["kind"] == "worker_down" and "w0" in h["detail"]
+                for h in freport["hints"])
+        and any(h["kind"] == "failover_replay"
+                for h in freport["hints"])
+        and any(ln.startswith(" fleet:") for ln in fleet_lines)
+        and all(h["runbook"].startswith("docs/serving.md")
+                for h in freport["hints"]
+                if h["kind"] in ("worker_down", "failover_replay"))
+        and freport2["fleet"]["workers"] == {"w2": "down"}
+        and freport2["fleet"]["replayed"] == 2
+        and any(h["kind"] == "worker_down" and "w2" in h["detail"]
+                for h in freport2["hints"])
+    )
+
     # --trend offline: a synthetic 2-process shard family (one rank
     # healthy, one with a burning serve-latency SLO) through the full
     # trend pipeline — per-cell sparklines + the burn summary
@@ -1221,7 +1329,7 @@ def _selftest(repo_root: str) -> int:
         and any("slo burn summary" in ln for ln in trend_lines)
     )
 
-    ok = trend_ok and bundle_ok and capacity_ok and (
+    ok = trend_ok and bundle_ok and capacity_ok and fleet_ok and (
         report["health"]["status"] in ("DEGRADED", "CRITICAL")
         and report["breakers"].get("pallas|23x23x23xfloat64") == "open"
         and report["watchdog"].get("tpu_probe", {}).get("wedge_streak") == 2

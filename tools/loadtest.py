@@ -25,6 +25,13 @@ Subcommands:
   bisect to the SLO-burn boundary, build the certificate, gate it
   against the committed baseline via `tools/perf_gate.py`, and
   publish only if it is clean (never degraded, never a regression).
+* ``fleet-certify --workers N [--out FLEET_CERT.json]`` — replay the
+  trace through a routed N-worker fleet (``dbcsr_tpu.serve.fleet``):
+  a 1-worker routed leg, the full fleet leg (the certificate value +
+  scaling efficiency), and a mid-leg SIGKILL failover leg that must
+  come back exactly-once clean — the capacity claim and the zero-loss
+  claim are certified under the SAME load.  perf_gate-gated like
+  ``certify``.
 
 Determinism contract: the request stream is a pure function of
 (trace, seed) — same trace + seed ⇒ bitwise-identical stream (pinned
@@ -58,8 +65,10 @@ os.environ.setdefault("DBCSR_TPU_TS_INTERVAL_S", "0")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_TRACE = os.path.join(REPO, "WORKLOAD_TRACE.jsonl")
 DEFAULT_CERT = os.path.join(REPO, "CAPACITY_CERT.json")
+DEFAULT_FLEET_CERT = os.path.join(REPO, "FLEET_CERT.json")
 
 CERT_METRIC = "serve_certified_capacity (replayed trace, 1 worker)"
+FLEET_CERT_METRIC = "serve_certified_capacity (routed fleet)"
 
 
 def _seed_default() -> int:
@@ -370,6 +379,207 @@ def _rep_entry(entry: dict, rep: int) -> dict:
     return ent
 
 
+# --------------------------------------------------------- fleet legs
+
+def fleet_leg(stream: list, workers: int = 2, rate_x: float = 1.0,
+              wait_s: float | None = None,
+              kill_mid: bool = False) -> dict:
+    """One open-loop replay leg through a routed ``workers``-process
+    fleet (`dbcsr_tpu.serve.fleet.Fleet` + `serve.router.FleetRouter`):
+    sessions open per tenant through the router, every stream entry
+    stages on its placed worker over HTTP, arrivals fire at recorded
+    offsets compressed by ``rate_x``.
+
+    ``kill_mid=True`` is the failover leg: halfway through the
+    arrival schedule one session-owning worker is SIGKILLed and its
+    journal failed over onto a peer — the leg's p95 then INCLUDES the
+    detection + replay disruption, and the leg is only ``clean`` when
+    the router's exactly-once audit comes back empty (zero loss, zero
+    duplicates).  Requires ``workers >= 2``."""
+    from dbcsr_tpu.serve.fleet import Fleet
+    from dbcsr_tpu.serve.router import SETTLED_STATES
+
+    wait_s = _wait_s_default() if wait_s is None else wait_s
+    if kill_mid and workers < 2:
+        raise ValueError("the failover leg needs a surviving peer")
+    with Fleet(n=workers) as fl:
+        router = fl.router()
+        router.check()
+        sessions: dict = {}
+        staged = []  # (entry, session_id, kwargs)
+        for entry in stream:
+            sid = sessions.get(entry["tenant"])
+            if sid is None:
+                sid = router.open_session(entry["tenant"])
+                sessions[entry["tenant"]] = sid
+            staged.append((entry, sid, router.stage(sid, entry)))
+        kill_at = len(staged) // 2 if kill_mid else None
+        failover = None
+        rids = []
+        shed = 0
+        t0 = time.perf_counter()
+        for i, (entry, sid, kwargs) in enumerate(staged):
+            if kill_at is not None and i == kill_at:
+                victim_sid = next(iter(sessions.values()))
+                owner = router.sessions[victim_sid]["worker"]
+                t_kill = time.perf_counter()
+                fl.kill(owner)
+                router.mark_down(owner)
+                moved = router.failover(owner)
+                router.settle_replayed(moved["replayed"],
+                                       moved["target"], timeout=wait_s)
+                failover = {
+                    "worker": owner, "target": moved["target"],
+                    "pending": len(moved["pending"]),
+                    "replayed": len(moved["replayed"]),
+                    "repinned": len(moved["repinned"]),
+                    "disruption_s": round(
+                        time.perf_counter() - t_kill, 3),
+                }
+            target_t = entry["offset_s"] / max(rate_x, 1e-6)
+            delay = t0 + target_t - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            info = router.submit(
+                sid, request_id=entry["request_id"],
+                op=entry.get("op", "multiply"),
+                priority=entry.get("priority", 10),
+                deadline_s=entry.get("deadline_s"), **kwargs)
+            if info.get("state") == "shed":
+                shed += 1
+            else:
+                rids.append(entry["request_id"])
+        outcomes: dict = {}
+        lat_ms = []
+        for rid in rids:
+            info = router.wait(rid, timeout=wait_s)
+            st = info.get("state", "?")
+            outcomes[st] = outcomes.get(st, 0) + 1
+            if st == "done" and info.get("latency_ms") is not None:
+                lat_ms.append(info["latency_ms"])
+        wall = time.perf_counter() - t0
+        audit = router.audit()
+
+    offered = len(staged)
+    settled = sum(n for st, n in outcomes.items()
+                  if st in SETTLED_STATES)
+    done = outcomes.get("done", 0)
+    missed = outcomes.get("deadline_missed", 0)
+    failed = outcomes.get("failed", 0) + outcomes.get("?", 0)
+    clean = (not audit["duplicated"] and not audit["unresolved"]
+             and settled + shed == offered
+             and missed == 0 and failed == 0)
+    return {
+        "workers": workers,
+        "rate_x": rate_x,
+        "offered": offered,
+        "completed": done,
+        "completed_rps": round(done / wall, 4) if wall else 0.0,
+        "shed": shed,
+        "deadline_missed": missed,
+        "failed": failed,
+        "wall_s": round(wall, 6),
+        "p50_ms": round(_latency_quantile(lat_ms, 0.50), 3),
+        "p95_ms": round(_latency_quantile(lat_ms, 0.95), 3),
+        "audit": {"duplicated": audit["duplicated"],
+                  "unresolved": audit["unresolved"]},
+        "failover": failover,
+        "clean": clean,
+    }
+
+
+def _fleet_knee(stream: list, workers: int, base_rate_x: float,
+                max_doublings: int, label: str) -> dict:
+    """Ramp ``rate_x`` ×2 until a leg sheds, goes unclean, or stops
+    improving; returns the best clean zero-shed leg.  At the recorded
+    rate the replay is arrival-limited (completed req/s == offered
+    req/s no matter how many workers), so only the ramped knee is a
+    capacity number that can be compared across fleet sizes."""
+    best = None
+    rate = float(base_rate_x)
+    for _ in range(max(1, int(max_doublings))):
+        leg = fleet_leg(stream, workers=workers, rate_x=rate)
+        print(f"  {label} x{rate:g}: {leg['completed_rps']} req/s "
+              f"shed={leg['shed']} p95={leg['p95_ms']}ms "
+              f"clean={leg['clean']}", file=sys.stderr)
+        if not leg["clean"] or leg["shed"]:
+            break
+        if best is None or leg["completed_rps"] > best["completed_rps"]:
+            best = leg
+        elif leg["completed_rps"] < 0.9 * best["completed_rps"]:
+            break  # past saturation
+        rate *= 2.0
+    if best is None:
+        best = leg
+    return best
+
+
+def fleet_certify(trace_path: str, workers: int = 2,
+                  seed: int | None = None, base_rate_x: float = 1.0,
+                  max_doublings: int = 4) -> dict:
+    """The fleet scaling certificate: the committed trace replayed
+    through (a) one routed worker, (b) the full ``workers``-process
+    fleet — each ramped to its saturation knee so both numbers are
+    capacity, not arrival rate — and (c) the fleet at its knee rate
+    with a mid-leg SIGKILL + failover.  The certificate's ``value``
+    is the fleet knee's completed req/s; ``scaling_efficiency`` pins
+    how much of ``workers ×`` the single-worker routed knee the fleet
+    actually delivers, and the failover leg proves the zero-loss
+    contract under the same load the capacity claim is made at
+    (`docs/serving.md` § fleet)."""
+    from dbcsr_tpu.resilience import faults
+    from dbcsr_tpu.serve import workload
+
+    records = workload.read_trace(trace_path)
+    if not records:
+        raise SystemExit(f"no workload records in {trace_path}")
+    seed = _seed_default() if seed is None else seed
+    stream = workload.request_stream(records, seed=seed)
+
+    single = _fleet_knee(stream, 1, base_rate_x, max_doublings,
+                         "1-worker")
+    fleet = _fleet_knee(stream, workers, base_rate_x, max_doublings,
+                        f"{workers}-worker")
+    storm = fleet_leg(stream, workers=workers,
+                      rate_x=fleet["rate_x"], kill_mid=True)
+    print(f"  failover leg x{fleet['rate_x']:g}: "
+          f"{storm['completed_rps']} req/s p95={storm['p95_ms']}ms "
+          f"clean={storm['clean']} failover={storm['failover']}",
+          file=sys.stderr)
+
+    ideal = single["completed_rps"] * workers
+    return dict(
+        _stamps(),
+        kind="capacity_cert",
+        workload_schema=workload.WORKLOAD_SCHEMA,
+        metric=FLEET_CERT_METRIC,
+        value=fleet["completed_rps"],
+        unit="req/s/fleet",
+        workers=workers,
+        trace=os.path.basename(trace_path),
+        trace_requests=len(records),
+        seed=seed,
+        rate_x=fleet["rate_x"],
+        single_worker_rps=single["completed_rps"],
+        single_worker_rate_x=single["rate_x"],
+        scaling_efficiency=(round(fleet["completed_rps"] / ideal, 4)
+                            if ideal else None),
+        p50_ms=fleet["p50_ms"],
+        p95_ms=fleet["p95_ms"],
+        failover_leg={
+            "clean": storm["clean"],
+            "completed_rps": storm["completed_rps"],
+            "p95_ms": storm["p95_ms"],
+            "failover": storm["failover"],
+            "audit": storm["audit"],
+        },
+        legs_clean=bool(single["clean"] and fleet["clean"]
+                        and storm["clean"]),
+        degraded=bool(faults.active())
+        or not (single["clean"] and fleet["clean"] and storm["clean"]),
+    )
+
+
 # -------------------------------------------------------- certification
 
 def _stamps() -> dict:
@@ -520,9 +730,10 @@ def publish(cert: dict, path: str, force: bool = False) -> int:
     with open(path, "w") as fh:
         json.dump(cert, fh, indent=1, sort_keys=True)
         fh.write("\n")
+    p95 = cert.get("p95_ms_at_knee", cert.get("p95_ms"))
     print(f"published {path}: {cert['value']} {cert['unit']} "
-          f"(rate_x={cert['certified_rate_x']}, "
-          f"p95={cert['p95_ms_at_knee']}ms)", file=sys.stderr)
+          f"(rate_x={cert.get('certified_rate_x', cert.get('rate_x'))}, "
+          f"p95={p95}ms)", file=sys.stderr)
     return 0
 
 
@@ -570,6 +781,22 @@ def main(argv=None) -> int:
     cer.add_argument("--no-publish", action="store_true",
                      help="print the certificate, do not write it")
 
+    flc = sub.add_parser("fleet-certify",
+                         help="replay the trace through a routed "
+                              "N-worker fleet (plus a SIGKILL "
+                              "failover leg) and publish "
+                              "FLEET_CERT.json")
+    flc.add_argument("--trace", default=DEFAULT_TRACE)
+    flc.add_argument("--out", default=DEFAULT_FLEET_CERT)
+    flc.add_argument("--workers", type=int, default=2)
+    flc.add_argument("--seed", type=int, default=None)
+    flc.add_argument("--base-rate-x", type=float, default=1.0)
+    flc.add_argument("--max-doublings", type=int, default=4)
+    flc.add_argument("--force", action="store_true",
+                     help="publish even if degraded/incomparable")
+    flc.add_argument("--no-publish", action="store_true",
+                     help="print the certificate, do not write it")
+
     args = ap.parse_args(argv)
 
     import jax
@@ -598,6 +825,18 @@ def main(argv=None) -> int:
                          repeats=args.repeats, coalesce=args.coalesce)
         print(json.dumps(leg))
         return 0 if leg["clean"] else 1
+
+    if args.cmd == "fleet-certify":
+        cert = fleet_certify(args.trace, workers=args.workers,
+                             seed=args.seed,
+                             base_rate_x=args.base_rate_x,
+                             max_doublings=args.max_doublings)
+        if args.no_publish:
+            print(json.dumps(cert))
+            return 0
+        rc = publish(cert, args.out, force=args.force)
+        print(json.dumps(cert))
+        return rc
 
     cert = certify(args.trace, seed=args.seed,
                    max_doublings=args.max_doublings,

@@ -19,6 +19,14 @@ broken replay (the measured number is nonsense) — either way a human
 must look before trusting a capacity plan.  A degraded certificate is
 reported but never cross-checked.
 
+When a routed fleet certificate also exists (``FLEET_CERT.json``,
+written by ``tools/loadtest.py fleet-certify``), the report adds the
+fleet row: N-worker capacity vs the routed single-worker knee, the
+scaling efficiency, and the failover leg's exactly-once verdict — and
+**exits 3 when the fleet delivers under 1/MAX_DIVERGENCE of one routed
+worker** (the router lost capacity outright) or claims more than
+MAX_DIVERGENCE × N× it (the measurement is nonsense).
+
 Artifact shape (one JSON object per line, ``kind`` discriminator):
 
     {"kind": "usage_meta",   "obs_schema": 5, "slo_target_ms": 500.0, ...}
@@ -49,6 +57,7 @@ import sys
 
 DEFAULT_ROLLUP = "USAGE_ROLLUP.jsonl"
 DEFAULT_CERT = "CAPACITY_CERT.json"
+DEFAULT_FLEET_CERT = "FLEET_CERT.json"
 DEFAULT_SLO_MS = 500.0
 MAX_UTILIZATION = 0.95
 P95_TAIL_FACTOR = 3.0  # ln(20): P(T > t) = exp(-t / E[T]) at p95
@@ -144,7 +153,49 @@ def cross_check(cap: dict, cert: dict) -> dict:
     return out
 
 
-def report(rollup: dict, slo_ms: float, cert: dict | None = None) -> dict:
+def fleet_check(cert: dict, fleet_cert: dict) -> dict:
+    """Per-worker measured capacity vs the routed-fleet measurement
+    (``tools/loadtest.py fleet-certify``).  The hard check uses the
+    fleet certificate's OWN routed single-worker knee (same harness,
+    same operating point): the fleet must deliver at least ``single /
+    MAX_DIVERGENCE`` (co-located workers legitimately contend for the
+    same cores, so N workers may not beat one — but losing more than
+    half of one worker's capacity means the router itself is the
+    bottleneck) and at most MAX_DIVERGENCE × workers × it (more means
+    the measurement is nonsense).  The in-process per-worker certificate
+    (``CAPACITY_CERT.json``) is reported alongside as the routing
+    overhead — informational, the harnesses are not comparable
+    enough to gate on.  A degraded fleet certificate (any leg
+    unclean, including the failover leg's exactly-once audit) is
+    reported, never cross-checked."""
+    workers = int(fleet_cert.get("workers") or 0)
+    single_routed = fleet_cert.get("single_worker_rps")
+    fleet = fleet_cert.get("value")
+    inproc = cert.get("value")
+    out = {
+        "workers": workers,
+        "fleet_req_per_s": fleet,
+        "single_routed_req_per_s": single_routed,
+        "inproc_per_worker_req_per_s": inproc,
+        "routing_overhead": (round(inproc / single_routed, 3)
+                            if inproc and single_routed else None),
+        "scaling_efficiency": fleet_cert.get("scaling_efficiency"),
+        "failover_clean": (fleet_cert.get("failover_leg") or {}).get(
+            "clean"),
+        "certificate_degraded": bool(fleet_cert.get("degraded")),
+        "diverged": False,
+    }
+    if (fleet_cert.get("degraded") or not fleet or not single_routed
+            or not workers):
+        return out
+    out["diverged"] = (fleet < single_routed / MAX_DIVERGENCE
+                       or fleet > MAX_DIVERGENCE * workers
+                       * single_routed)
+    return out
+
+
+def report(rollup: dict, slo_ms: float, cert: dict | None = None,
+           fleet_cert: dict | None = None) -> dict:
     totals = rollup["totals"]
     tenants = rollup["tenants"]
     cap = capacity(totals, slo_ms)
@@ -167,6 +218,8 @@ def report(rollup: dict, slo_ms: float, cert: dict | None = None) -> dict:
            "capacity": cap}
     if cert is not None:
         rep["cross_check"] = cross_check(cap, cert)
+    if fleet_cert is not None:
+        rep["fleet_check"] = fleet_check(cert or {}, fleet_cert)
     return rep
 
 
@@ -207,6 +260,20 @@ def render(rep: dict, out=print) -> None:
                         "broken)" if xc["diverged"] else
                         "apart: consistent"))
         out(line)
+    fc = rep.get("fleet_check")
+    if fc:
+        line = (f" fleet:     {fc['fleet_req_per_s']:g} req/s across "
+                f"{fc['workers']} workers")
+        if fc.get("scaling_efficiency") is not None:
+            line += f" ({fc['scaling_efficiency']:.0%} of {fc['workers']}x)"
+        if fc["certificate_degraded"]:
+            line += " DEGRADED — not cross-checked"
+        elif fc.get("single_routed_req_per_s"):
+            line += (", DIVERGED (router bottleneck or stale cert)"
+                     if fc["diverged"] else ", consistent")
+        if fc.get("failover_clean") is False:
+            line += "; failover leg UNCLEAN"
+        out(line)
 
 
 def main(argv=None) -> int:
@@ -223,6 +290,9 @@ def main(argv=None) -> int:
                     help="measured capacity certificate "
                          "(tools/loadtest.py certify; skipped silently "
                          "when absent)")
+    ap.add_argument("--fleet-cert", default=DEFAULT_FLEET_CERT,
+                    help="routed fleet certificate (tools/loadtest.py "
+                         "fleet-certify; skipped silently when absent)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable report")
     args = ap.parse_args(argv)
@@ -246,7 +316,9 @@ def main(argv=None) -> int:
         except ValueError:
             slo_ms = DEFAULT_SLO_MS
     cert = read_cert(args.cert)
-    rep = report(rollup, float(slo_ms), cert=cert)
+    fleet_cert = read_cert(args.fleet_cert)
+    rep = report(rollup, float(slo_ms), cert=cert,
+                 fleet_cert=fleet_cert)
     if args.as_json:
         print(json.dumps(rep, default=str))
     else:
@@ -255,6 +327,12 @@ def main(argv=None) -> int:
         print(f"usage_report: analytic and measured capacity diverge "
               f"by >{MAX_DIVERGENCE:g}x — capacity plan untrustworthy "
               f"until a human reconciles them", file=sys.stderr)
+        return 3
+    if (rep.get("fleet_check") or {}).get("diverged"):
+        print("usage_report: routed fleet capacity is inconsistent "
+              "with its per-worker measurement — router bottleneck "
+              "or stale certificate; re-run tools/loadtest.py "
+              "fleet-certify", file=sys.stderr)
         return 3
     return 0
 
