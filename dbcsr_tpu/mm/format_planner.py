@@ -1,0 +1,348 @@
+"""Adaptive storage-format planner: per-product dense/stack/composite.
+
+The engine historically executed every product as BCSR stacks, with one
+hardcoded escape hatch (`mm.multiply._dense_mode_wanted`) that converts
+near-full matrices to a single dense GEMM.  This module makes the
+format a PLANNED, per-(product, occupancy, device) decision between
+three executions of the identical product:
+
+* ``stack``     — the shape-bucketed BCSR stack engine (the default);
+* ``dense``     — whole-panel padded dense GEMM (`_dense_multiply`,
+  n/m/k-chunked beyond the canvas cap);
+* ``composite`` — the block-diagonal composite panel: C's block-rows
+  are greedily grouped into row-panels with narrow k-support, packed
+  into ONE batched padded GEMM (`_composite_multiply`) — the serve
+  coalescer's batching trick applied inside one matrix.
+
+Decision funnel (first hit wins), resolved once per product and cached
+by pattern fingerprints + config + params generation (a tuner
+promotion/demotion bumps the generation, so learned crossovers retire
+cached plans immediately):
+
+1. ``DBCSR_TPU_MM_FORMAT`` forced format (``reason="forced"``; a
+   structurally infeasible force falls back to stack,
+   ``reason="ineligible"``);
+2. the ``format_plan`` fault site (an injected fault degrades the plan
+   to stack, ``reason="fault"`` — never cached);
+3. a learned params-table row carrying ``format``/``format_occ``
+   columns for this block cell: above the learned occupancy crossover
+   the row's format wins (``reason="tuned"``) — this is where the
+   autotuner (`dbcsr_tpu.tune`) overrides the model per device;
+4. the legacy dense heuristic (`_dense_mode_wanted`: config forcing,
+   the occupancy threshold, the emulated-dtype flop-ratio model) —
+   preserved bit-for-bit so default behavior never changes
+   (``reason="heuristic"``);
+5. on an MXU (`effective_platform() == "tpu"`), the
+   `obs.costmodel.format_costs` occupancy-parameterized curves: the
+   cheapest modeled format among the structurally feasible ones
+   (``reason="model"``); guarded by the >= 0.5 candidate-fill rule so
+   a structurally sparse C is never silently densified;
+6. stack (``reason="default"``; products that cannot take a non-stack
+   format at all report ``reason="structural"``).
+
+Every decision lands on ``dbcsr_tpu_format_decision_total{format,
+reason}`` and in the product's trace span/flight record; every
+EXECUTED product reports back through `note_outcome`, which keeps a
+bounded regret ring (model-predicted vs measured GFLOP/s) that the
+timeseries collector samples and `tune.miner.mine_format` mines for
+re-trial when the planner's choice underperforms its own model.
+
+Import-light: numpy only at import; jax, config, params, costmodel and
+`mm.multiply` are reached lazily (multiply imports THIS module lazily
+too, so there is no cycle).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+FORMATS = ("stack", "dense", "composite")
+
+_lock = threading.Lock()
+_plan_cache: "collections.OrderedDict" = collections.OrderedDict()
+_PLAN_CACHE_MAX = 256
+_regret: "collections.deque" = collections.deque(maxlen=256)
+# measured/predicted below this ratio marks the decision a regret the
+# format miner re-trials (mirrors the tuner's roofline floor idea)
+_REGRET_FLOOR = 0.5
+
+
+class Plan:
+    """One product's format decision plus the evidence it rode on."""
+
+    __slots__ = ("fmt", "reason", "panels", "predicted", "cell", "occ",
+                 "grid")
+
+    def __init__(self, fmt: str, reason: str, panels=None,
+                 predicted: Optional[dict] = None,
+                 cell: Optional[tuple] = None, occ: Optional[float] = None,
+                 grid: Optional[tuple] = None):
+        self.fmt = fmt
+        self.reason = reason
+        self.panels = panels
+        self.predicted = predicted
+        self.cell = cell          # (bm, bn, bk, dtype) — uniform products
+        self.occ = occ            # pair occupancy: entries/(nbr*nbc*nbk)
+        self.grid = grid          # (nbr, nbc, nbk)
+
+    def __repr__(self):
+        return f"Plan({self.fmt}, reason={self.reason}, occ={self.occ})"
+
+
+def _uniform(m) -> bool:
+    return (len(np.unique(m.row_blk_sizes)) == 1
+            and len(np.unique(m.col_blk_sizes)) == 1)
+
+
+def _cache_get(key):
+    with _lock:
+        hit = _plan_cache.get(key)
+        if hit is not None:
+            _plan_cache.move_to_end(key)
+        return hit
+
+
+def _cache_put(key, plan) -> None:
+    with _lock:
+        _plan_cache[key] = plan
+        while len(_plan_cache) > _PLAN_CACHE_MAX:
+            _plan_cache.popitem(last=False)
+
+
+def reset() -> None:
+    """Drop cached plans and regret history (tests, config flips)."""
+    with _lock:
+        _plan_cache.clear()
+        _regret.clear()
+
+
+def _tuned_row(bm: int, bn: int, bk: int, dtype: str) -> Optional[dict]:
+    """The params-table row for this block cell IF it carries learned
+    format columns (promoted by `tune.store`, adopted from fleet peers,
+    or hand-written).  Falls back to the nearest same-device-kind
+    format-carrying row (`tune.predictor.format_prior`) so one trialed
+    cell informs its shape neighborhood; None otherwise."""
+    try:
+        from dbcsr_tpu.acc import params as params_mod
+
+        row = params_mod.lookup(bm, bn, bk, dtype)
+    except Exception:
+        return None
+    if row and row.get("format") in FORMATS:
+        return row
+    try:
+        from dbcsr_tpu.tune.predictor import format_prior
+
+        row = format_prior(bm, bn, bk, dtype)
+    except Exception:
+        return None
+    if row and row.get("format") in FORMATS:
+        return row
+    return None
+
+
+def choose(a, b, c, *, filter_eps, retain_sparsity, no_limits) -> Plan:
+    """Resolve the product's execution format (see the module funnel).
+    Cheap on repeat: cached by pattern fingerprints + config + params
+    generation + device kind."""
+    from dbcsr_tpu.core.config import effective_platform, get_config
+    from dbcsr_tpu.mm import multiply as _mm
+    from dbcsr_tpu.resilience import faults as _faults
+
+    cfg = get_config()
+    # structural gates shared by every non-stack format: these products
+    # can only run on the stack engine (filtered/limited/symmetric
+    # products, or dense explicitly disabled)
+    from dbcsr_tpu.core.matrix import NO_SYMMETRY
+
+    eligible = (
+        filter_eps is None and not retain_sparsity and no_limits
+        and c.matrix_type == NO_SYMMETRY
+        and cfg.mm_dense is not False and cfg.mm_driver != "pallas"
+    )
+    if not eligible:
+        return Plan("stack", "structural")
+    # fault boundary: an injected plan fault degrades to stack for THIS
+    # product only (never cached — the fault is transient)
+    if _faults.active():
+        try:
+            _faults.maybe_inject("format_plan", name=c.name)
+        except BaseException:
+            return Plan("stack", "fault")
+
+    from dbcsr_tpu.acc import params as params_mod
+
+    key = (
+        a.pattern_fingerprint(), b.pattern_fingerprint(),
+        c.pattern_fingerprint(), str(np.dtype(c.dtype)),
+        (cfg.mm_format, cfg.mm_dense, cfg.mm_driver,
+         cfg.dense_occ_threshold, cfg.dense_flop_ratio,
+         cfg.composite_max_panels, cfg.composite_ksup,
+         effective_platform()),
+        params_mod.generation(),
+    )
+    plan = _cache_get(key)
+    if plan is not None:
+        return plan
+    plan = _choose_uncached(a, b, c, cfg, _mm)
+    _cache_put(key, plan)
+    return plan
+
+
+def _choose_uncached(a, b, c, cfg, _mm) -> Plan:
+    from dbcsr_tpu.core.config import effective_platform
+    from dbcsr_tpu.obs import costmodel as _costmodel
+
+    uniform = _uniform(a) and _uniform(b) and _uniform(c)
+    cell = occ = grid = predicted = None
+    entries = 0
+    panels = None
+    if uniform:
+        bm = int(c.row_blk_sizes[0])
+        bn = int(c.col_blk_sizes[0])
+        bk = int(a.col_blk_sizes[0])
+        nbr, nbc, nbk = a.nblkrows, c.nblkcols, a.nblkcols
+        cell = (bm, bn, bk, str(np.dtype(c.dtype)))
+        grid = (nbr, nbc, nbk)
+        entries = max(
+            int(round(_mm._true_product_flops(a, b) / (2.0 * bm * bn * bk))),
+            0)
+        occ = entries / float(max(nbr * nbc * nbk, 1))
+        panels = _mm.composite_panels(a, b, c)
+        predicted = _costmodel.format_costs(
+            nbr=nbr, nbc=nbc, nbk=nbk, bm=bm, bn=bn, bk=bk,
+            entries=entries,
+            panels=(panels.G, panels.mp, panels.kp) if panels else None,
+            dtype=str(np.dtype(c.dtype)),
+            itemsize=np.dtype(c.dtype).itemsize)
+
+    def _feasible(fmt: str) -> bool:
+        if fmt == "stack":
+            return True
+        if fmt == "composite":
+            return panels is not None
+        return True  # dense: the chunked/general paths carry any shape
+
+    def _plan(fmt, reason):
+        return Plan(fmt, reason, panels=panels, predicted=predicted,
+                    cell=cell, occ=occ, grid=grid)
+
+    # 1. explicit force
+    if cfg.mm_format != "auto":
+        if _feasible(cfg.mm_format):
+            return _plan(cfg.mm_format, "forced")
+        return _plan("stack", "ineligible")
+    # 3. learned per-device crossover (the tune axis)
+    if cell is not None:
+        row = _tuned_row(*cell)
+        if row is not None:
+            fmt = str(row["format"])
+            crossover = float(row.get("format_occ", 0.0))
+            if occ is not None and occ >= crossover and _feasible(fmt):
+                return _plan(fmt, "tuned")
+            return _plan("stack", "tuned")
+    # 4. the legacy dense heuristic, preserved bit-for-bit
+    if _mm._dense_mode_wanted(a, b, c, None, False, True,
+                              allow_chunked=True):
+        return _plan("dense", "heuristic")
+    # 5. MXU cost curves (never densify a structurally sparse C)
+    if (uniform and predicted is not None
+            and effective_platform() == "tpu"
+            and _mm._candidate_fill(a, b) >= 0.5):
+        best, best_s = "stack", predicted["stack"]["seconds"]
+        for fmt in ("dense", "composite"):
+            leg = predicted.get(fmt)
+            if leg is not None and _feasible(fmt) \
+                    and leg["seconds"] < best_s:
+                best, best_s = fmt, leg["seconds"]
+        if best != "stack":
+            return _plan(best, "model")
+    return _plan("stack", "default" if uniform else "structural")
+
+
+# ------------------------------------------------------- observability
+
+def note_decision(plan: Plan) -> None:
+    """Count + annotate one decision (called once per multiply, on the
+    product — cache hits count too: the counter measures traffic, the
+    cache measures planning cost)."""
+    try:
+        from dbcsr_tpu.obs import flight as _flight
+        from dbcsr_tpu.obs import metrics as _metrics
+        from dbcsr_tpu.obs import tracer as _trace
+
+        _metrics.counter(
+            "dbcsr_tpu_format_decision_total",
+            "storage-format planner decisions by chosen format and "
+            "reason (mm.format_planner)",
+        ).inc(format=plan.fmt, reason=plan.reason)
+        _flight.note("format", plan.fmt)
+        _flight.note("format_reason", plan.reason)
+        if plan.occ is not None:
+            _flight.note("format_occ", round(plan.occ, 4))
+        _trace.annotate(format=plan.fmt, format_reason=plan.reason)
+    except Exception:
+        pass
+
+
+def note_outcome(plan: Plan, seconds: float, flops: float) -> None:
+    """Close the loop on one executed product: measured rate vs the
+    model's prediction for the chosen format.  Feeds the regret ring
+    (timeseries collector + `tune.miner.mine_format`)."""
+    if plan.predicted is None or plan.cell is None or seconds <= 0:
+        return
+    leg = plan.predicted.get(plan.fmt)
+    if not leg or not leg.get("gflops"):
+        return
+    measured = flops / seconds / 1e9
+    predicted = float(leg["gflops"])
+    rec = {
+        "format": plan.fmt,
+        "reason": plan.reason,
+        "cell": plan.cell,
+        "grid": plan.grid,
+        "occ": plan.occ,
+        "predicted_gflops": round(predicted, 4),
+        "measured_gflops": round(measured, 4),
+        "ratio": round(measured / predicted, 6) if predicted else 0.0,
+        "predicted_alternatives": {
+            f: round(v["gflops"], 4)
+            for f, v in plan.predicted.items() if v},
+        "t_unix": time.time(),
+    }
+    with _lock:
+        _regret.append(rec)
+
+
+def regret_records(limit: Optional[int] = None) -> list:
+    """Recent outcome records, oldest first (the miner's substrate)."""
+    with _lock:
+        recs = list(_regret)
+    return recs if limit is None else recs[-limit:]
+
+
+def regret_gauges() -> list:
+    """Latest measured/predicted ratio per format — the timeseries
+    collector's points (`dbcsr_tpu_format_regret`); a ratio far below
+    1.0 means the planner's model overpromised for that format."""
+    latest: dict = {}
+    with _lock:
+        for rec in _regret:
+            latest[rec["format"]] = rec["ratio"]
+    return [({"format": f}, r) for f, r in sorted(latest.items())]
+
+
+def mis_crossovers(floor: float = _REGRET_FLOOR) -> list:
+    """Cells whose chosen format underperformed the model by more than
+    ``floor`` on their latest sighting — the doctor hint's evidence and
+    the format miner's candidate source."""
+    latest: dict = {}
+    with _lock:
+        for rec in _regret:
+            latest[(rec["cell"], rec["format"])] = rec
+    return [r for r in latest.values() if r["ratio"] < floor]

@@ -173,6 +173,20 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json({"found": False}, code=404)
                 else:
                     self._send_json(dict(payload, found=True))
+            elif route == "/tune/promotions":
+                # fleet-shared tuning tier: this process's ORIGIN
+                # promotions (never re-exported adoptions), filtered to
+                # the caller's device kind (tune.store.peer_sync's
+                # wire call)
+                from dbcsr_tpu.tune import store as _tstore
+
+                q = parse_qs(url.query)
+                payload = _tstore.export_promotions(
+                    kind=q.get("kind", [None])[0])
+                if not payload.get("rows"):
+                    self._send_json(dict(payload, found=False), code=404)
+                else:
+                    self._send_json(dict(payload, found=True))
             elif route == "/serve/tenants":
                 eng = self._serve_engine()
                 if eng is None:
@@ -200,6 +214,7 @@ class _Handler(BaseHTTPRequestHandler):
                                "/serve/heartbeat",
                                "/serve/checksum?session=&name=",
                                "/serve/cache?digest=",
+                               "/tune/promotions?kind=",
                                "/serve/session/open (POST)",
                                "/serve/matrix (POST)",
                                "/serve/stage (POST)",

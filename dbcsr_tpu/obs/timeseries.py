@@ -496,6 +496,32 @@ def _collect_tune() -> list:
     return pts
 
 
+def _collect_format() -> list:
+    """Storage-format planner plane (mm.format_planner): the
+    decision counter by (format, reason), the fleet-sync counter, and
+    per-format planner REGRET (latest measured/predicted GFLOP/s
+    ratio) — the series `tune.miner.mine_format` and `doctor --trend`
+    line mis-crossovers up against."""
+    import sys
+
+    pts: list = []
+    from dbcsr_tpu.obs import metrics
+
+    for name in ("dbcsr_tpu_format_decision_total",
+                 "dbcsr_tpu_tune_fleet_total"):
+        for labels, v in metrics.counter_items(name):
+            pts.append((name, labels, v, COUNTER))
+    fp = sys.modules.get("dbcsr_tpu.mm.format_planner")
+    if fp is not None:  # an un-imported planner has no regrets
+        try:
+            for fmt, ratio in fp.regret_gauges().items():
+                pts.append(("dbcsr_tpu_format_regret", {"format": fmt},
+                            ratio, GAUGE))
+        except Exception:
+            pass
+    return pts
+
+
 def _collect_attribution() -> list:
     """Tenant cost-attribution plane (obs.attribution): the per-tenant
     device-seconds/flops/bytes/saved meters — sampled into shards so
@@ -537,7 +563,7 @@ def _collect_workload() -> list:
 _COLLECTORS = (_collect_engine, _collect_serve, _collect_breakers,
                _collect_pool, _collect_integrity, _collect_precision,
                _collect_value_reuse, _collect_tune, _collect_health,
-               _collect_attribution, _collect_workload)
+               _collect_format, _collect_attribution, _collect_workload)
 
 
 # ------------------------------------------------------------ sampling

@@ -41,8 +41,17 @@ SITES = {
         "corruptible": False, "chaos": True, "dynamic": False,
     },
     "dense": {
-        "boundary": "the dense paths in `mm.multiply`",
+        "boundary": "the canvas paths in `mm.multiply` (whole-panel "
+                    "dense AND the batched composite panels share this "
+                    "site: one failover, one corruption hook)",
         "corruptible": True, "chaos": True, "dynamic": False,
+    },
+    "format_plan": {
+        "boundary": "the storage-format planner's decision boundary "
+                    "(`mm.format_planner.choose`) — a fault degrades "
+                    "the plan to the stack format for that product "
+                    "only, never cached (labels `name`)",
+        "corruptible": False, "chaos": True, "dynamic": False,
     },
     "multihost_init": {
         "boundary": "`parallel.multihost.init_multihost`",

@@ -250,6 +250,133 @@ def live_promotions(kind: Optional[str] = None) -> List[Dict]:
                   key=lambda r: r.get("generation", 0))
 
 
+# ------------------------------------------------- fleet-shared tier
+#
+# The serve product cache's peer tier (`serve.product_cache`) proved
+# the envelope: same-fleet siblings answer bounded HTTP GETs, a dead
+# peer costs ONE timeout then cools off, a structured miss never cools
+# anything.  This applies the identical discipline to PROMOTIONS: a
+# worker that tuned a cell serves its live ledger rows over
+# ``GET /tune/promotions?kind=…`` (obs/server.py), and same-device-kind
+# peers adopt them without re-trialing — the peer's trial evidence IS
+# the evidence (same silicon, same crossover).
+
+_peer_down: Dict[str, float] = {}
+
+
+def _peers() -> List[str]:
+    raw = os.environ.get("DBCSR_TPU_FLEET_PEERS", "")
+    return [p.strip().rstrip("/") for p in raw.split(",") if p.strip()]
+
+
+def _count_fleet(event: str) -> None:
+    try:
+        from dbcsr_tpu.obs import metrics as _metrics
+
+        _metrics.counter(
+            "dbcsr_tpu_tune_fleet_total",
+            "fleet-shared tuning-promotion sync outcomes "
+            "(dbcsr_tpu.tune.store.peer_sync)",
+        ).inc(event=event)
+    except Exception:
+        pass
+
+
+def export_promotions(kind: Optional[str] = None) -> Dict:
+    """The wire form of this worker's live promotion rows for
+    same-device-kind peers (the ``/tune/promotions`` route's payload).
+    ORIGIN rows only: a row this worker itself adopted from a peer
+    (``adopted_from``) never re-exports, so a promotion cannot echo
+    around the fleet forever."""
+    kind = kind or params_mod.device_kind()
+    rows = []
+    for rec in live_promotions(kind):
+        entry = rec.get("entry") or {}
+        if entry.get("adopted_from"):
+            continue
+        rows.append({"key": rec.get("key"), "entry": entry,
+                     "generation": rec.get("generation"),
+                     "t_unix": rec.get("t_unix")})
+    return {"kind": kind, "rows": rows}
+
+
+def peer_sync(kind: Optional[str] = None, peers=None) -> List[list]:
+    """Adopt sibling workers' promoted params rows (fleet-shared
+    tuning): for each reachable peer, fetch its live promotions and
+    promote locally — through `promote`, so the adoption lands in the
+    ledger, bumps the params generation (retiring cached plans), and
+    stays demotable by the local regression judge.  A row is adopted
+    only when the peer reports the SAME device kind (another chip's
+    crossover does not transfer) and local evidence is not already at
+    least as good.  Bounded: one ``DBCSR_TPU_FLEET_CACHE_TIMEOUT_S``
+    timeout per peer, errors cool the peer off for
+    ``DBCSR_TPU_FLEET_PEER_COOLOFF_S`` (a 404/miss never cools).
+    Returns the adopted keys."""
+    import json as _json
+    import urllib.error as _uerr
+    import urllib.request as _rq
+
+    kind = kind or params_mod.device_kind()
+    peers = _peers() if peers is None else peers
+    if not peers:
+        return []
+    timeout = _env_float("DBCSR_TPU_FLEET_CACHE_TIMEOUT_S", 0.3)
+    cooloff = _env_float("DBCSR_TPU_FLEET_PEER_COOLOFF_S", 30.0)
+    adopted: List[list] = []
+    now = time.monotonic()
+    for peer in peers:
+        with _lock:
+            if _peer_down.get(peer, 0.0) > now:
+                continue
+        try:
+            with _rq.urlopen(f"{peer}/tune/promotions?kind={kind}",
+                             timeout=timeout) as resp:
+                payload = _json.loads(resp.read().decode())
+        except _uerr.HTTPError as exc:
+            if exc.code == 404:
+                # a healthy peer without the route/ledger is a miss,
+                # never a cool-off (the serve cache tier's lesson)
+                _count_fleet("peer_miss")
+                continue
+            with _lock:
+                _peer_down[peer] = time.monotonic() + cooloff
+            _count_fleet("peer_error")
+            continue
+        except Exception:
+            with _lock:
+                _peer_down[peer] = time.monotonic() + cooloff
+            _count_fleet("peer_error")
+            continue
+        if str(payload.get("kind")) != kind:
+            _count_fleet("kind_mismatch")
+            continue
+        for rec in payload.get("rows") or []:
+            entry = rec.get("entry") or {}
+            if not entry or entry.get("adopted_from"):
+                continue
+            try:
+                m = int(entry["m"])
+                n = int(entry["n"])
+                k = int(entry["k"])
+                dtype = str(entry["dtype"])
+                s = int(entry.get("stack_size", 0))
+            except (KeyError, TypeError, ValueError):
+                continue
+            incumbent = _lookup_exact(m, n, k, dtype, s, kind)
+            if incumbent and incumbent.get("tuned_by") and \
+                    float(incumbent.get("gflops") or 0.0) >= \
+                    float(entry.get("gflops") or 0.0) and \
+                    incumbent.get("format") == entry.get("format"):
+                continue  # local evidence already as good: no churn
+            promote(dict(entry, adopted_from=peer),
+                    trial={"adopted_from": peer,
+                           "peer_generation": rec.get("generation")},
+                    kind=kind)
+            adopted.append([m, n, k, dtype, s])
+            _count_fleet("adopted")
+    return adopted
+
+
 def check_regressions(kind: Optional[str] = None,
                       ratio: Optional[float] = None,
                       min_samples: int = 4,
@@ -276,7 +403,11 @@ def check_regressions(kind: Optional[str] = None,
     demoted = []
     for rec in live_promotions(kind):
         frac0 = rec.get("roofline_at_promotion")
-        driver = (rec.get("entry") or {}).get("driver")
+        entry = rec.get("entry") or {}
+        # a format-axis promotion executes under the canvas driver it
+        # promoted (dense/composite), not the row's kernel driver — the
+        # judge must watch the roofline cell that row actually produces
+        driver = entry.get("format_driver") or entry.get("driver")
         if not frac0 or not driver:
             continue
         try:

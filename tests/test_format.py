@@ -1,0 +1,456 @@
+"""The adaptive storage-format planner (`mm.format_planner`) and its
+learning loop.
+
+Pinned here: the occupancy ladder resolves to the expected format
+through each funnel step (forced, learned crossover, heuristic,
+default); every format computes the BITWISE-identical product for
+integer-valued operands; a tuning promotion's generation bump retires
+cached plans and a demotion restores the stack default; chaos
+block-flips under each format are detected and healed bitwise; ABFT
+runs live on the composite panel path; canvas-exceeding wide-N
+products still go dense via n-chunking; and format promotions travel
+the fleet tier (same device kind only).  All tier-1, CPU-only.
+"""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import dbcsr_tpu as dt
+from dbcsr_tpu.acc import params as params_mod
+from dbcsr_tpu.core.config import get_config, set_config
+from dbcsr_tpu.mm import format_planner as fp
+from dbcsr_tpu.mm import multiply as mm_mod
+from dbcsr_tpu.obs import health, metrics
+from dbcsr_tpu.ops.test_methods import to_dense
+from dbcsr_tpu.resilience import breaker, faults
+from dbcsr_tpu.tune import store, trials
+from dbcsr_tpu.tune import service as tune_service
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(tmp_path, monkeypatch):
+    """Hermetic params dir + full planner/fault/metrics reset, so no
+    test's promotion or chaos schedule leaks into the next."""
+    monkeypatch.setenv("DBCSR_TPU_PARAMS_DIR", str(tmp_path))
+    params_mod.invalidate()
+    cfg0 = {f: getattr(get_config(), f)
+            for f in ("abft", "mm_driver", "mm_dense", "mm_format",
+                      "composite_max_panels", "composite_ksup",
+                      "dense_occ_threshold", "dense_flop_ratio",
+                      "incremental")}
+    faults.clear()
+    breaker.reset_board()
+    metrics.reset()
+    health.reset()
+    fp.reset()
+    mm_mod._plan_cache.clear()
+    yield tmp_path
+    tune_service.stop_service()
+    faults.clear()
+    breaker.reset_board()
+    metrics.reset()
+    health.reset()
+    fp.reset()
+    mm_mod._plan_cache.clear()
+    set_config(**cfg0)
+    params_mod.invalidate()
+
+
+def _pair(nblk=8, bsize=4, fill=1.0, band=None, seed=0, dtype=np.float64):
+    """A, B with integer-valued blocks: exact f64 accumulation, so C is
+    bitwise-comparable across every storage format and engine."""
+    rng = np.random.default_rng(seed)
+    bs = [bsize] * nblk
+
+    def _m(name, pattern):
+        m = dt.create(name, bs, bs, dtype=dtype)
+        rows = np.asarray([i for i, j in pattern], dtype=np.int64)
+        cols = np.asarray([j for i, j in pattern], dtype=np.int64)
+        blocks = rng.integers(-4, 5, size=(len(pattern), bsize, bsize)
+                              ).astype(dtype)
+        m.put_blocks(rows, cols, blocks)
+        m.finalize()
+        return m
+
+    if band is not None:
+        pattern = [(i, j) for i in range(nblk) for j in range(nblk)
+                   if abs(i - j) <= band]
+    else:
+        pattern = [(i, j) for i in range(nblk) for j in range(nblk)
+                   if rng.random() < fill]
+        pattern = pattern or [(0, 0)]
+    return _m("fA", pattern), _m("fB", list(pattern)), bs
+
+
+def _run(fmt, a, b, bs, dtype=np.float64):
+    set_config(mm_format=fmt)
+    fp.reset()
+    c = dt.create("fC", bs, bs, dtype=dtype)
+    dt.multiply("N", "N", 1.0, a, b, 0.0, c)
+    return c
+
+
+def _dense_of(c):
+    return np.asarray(to_dense(c))
+
+
+def _choose(a, b, c):
+    return fp.choose(a, b, c, filter_eps=None, retain_sparsity=False,
+                     no_limits=True)
+
+
+def _ctr(name, **labels):
+    total = 0.0
+    for lb, v in metrics.counter_items(name):
+        if all(lb.get(k) == val for k, val in labels.items()):
+            total += v
+    return total
+
+
+# ------------------------------------------------- the format ladder
+
+def test_every_format_bitwise_identical():
+    """Forced stack/dense/composite all compute the same C, bit for
+    bit, and report what they executed — format choice is performance
+    only, never numerics."""
+    a, b, bs = _pair(nblk=8, bsize=4, band=1, seed=3)
+    ref = None
+    executed = {}
+    for fmt in ("stack", "dense", "composite"):
+        c = _run(fmt, a, b, bs)
+        executed[fmt] = c._mm_algorithm
+        d = _dense_of(c)
+        if ref is None:
+            ref = d
+        assert (d == ref).all(), f"{fmt} diverged bitwise"
+    assert executed["stack"] == "stack"
+    assert executed["dense"] == "dense"
+    # banded pattern: the composite pack is feasible and actually runs
+    assert executed["composite"] == "composite"
+
+
+def test_occupancy_ladder_heuristic_and_default():
+    """No learned rows: a near-full product goes dense through the
+    preserved legacy heuristic, a sparse one stays on the stack path,
+    and both land on the decision counter."""
+    set_config(mm_format="auto")
+    full_a, full_b, bs = _pair(nblk=6, bsize=4, fill=1.0, seed=1)
+    plan = _choose(full_a, full_b, dt.create("fC", bs, bs))
+    assert (plan.fmt, plan.reason) == ("dense", "heuristic")
+
+    sp_a, sp_b, bs = _pair(nblk=6, bsize=4, fill=0.3, seed=2)
+    plan = _choose(sp_a, sp_b, dt.create("fC", bs, bs))
+    assert plan.fmt == "stack"
+    assert plan.reason == "default"
+    assert plan.occ is not None and plan.occ < 0.5
+
+    c = _run("auto", full_a, full_b, bs)
+    assert c._mm_algorithm == "dense"
+    assert _ctr("dbcsr_tpu_format_decision_total",
+                format="dense", reason="heuristic") >= 1
+
+
+def test_occupancy_ladder_learned_crossover():
+    """A promoted format row steers the planner by triple-occupancy:
+    above the learned crossover the row's format wins, below it the
+    stack default holds (reason='tuned' both ways)."""
+    params_mod.save_entry({"m": 4, "n": 4, "k": 4, "dtype": "float64",
+                           "stack_size": 0, "format": "dense",
+                           "format_occ": 0.5, "format_gflops": 9.9,
+                           "tuned_by": "test"})
+    set_config(mm_format="auto", dense_occ_threshold=2.0,
+               dense_flop_ratio=0)  # heuristic off: isolate the row
+    lo_a, lo_b, bs = _pair(nblk=6, bsize=4, fill=0.4, seed=4)
+    plan = _choose(lo_a, lo_b, dt.create("fC", bs, bs))
+    assert (plan.fmt, plan.reason) == ("stack", "tuned")
+    assert plan.occ < 0.5
+
+    hi_a, hi_b, bs = _pair(nblk=6, bsize=4, fill=1.0, seed=5)
+    plan = _choose(hi_a, hi_b, dt.create("fC", bs, bs))
+    assert (plan.fmt, plan.reason) == ("dense", "tuned")
+    assert plan.occ >= 0.5
+
+
+def test_forced_infeasible_falls_back_to_stack():
+    """composite forced on a pattern with no panel compression runs
+    stack under reason='ineligible' — never an error."""
+    a, b, bs = _pair(nblk=4, bsize=4, fill=1.0, seed=6)
+    assert mm_mod.composite_panels(a, b, dt.create("fC", bs, bs)) is None
+    set_config(mm_format="composite")
+    plan = _choose(a, b, dt.create("fC", bs, bs))
+    assert (plan.fmt, plan.reason) == ("stack", "ineligible")
+
+
+# --------------------------------------- plan cache vs the generation
+
+def test_promotion_generation_bump_retires_cached_plans():
+    a, b, bs = _pair(nblk=6, bsize=4, fill=1.0, seed=7)
+    set_config(mm_format="auto", dense_occ_threshold=2.0,
+               dense_flop_ratio=0)
+    c = dt.create("fC", bs, bs)
+    p1 = _choose(a, b, c)
+    assert (p1.fmt, p1.reason) == ("stack", "default")
+    assert _choose(a, b, c) is p1  # cached: same plan object
+
+    store.promote({"m": 4, "n": 4, "k": 4, "dtype": "float64",
+                   "stack_size": 0, "format": "dense",
+                   "format_occ": 0.2, "format_gflops": 9.9,
+                   "driver": "dense", "gflops": 9.9})
+    p2 = _choose(a, b, c)
+    assert p2 is not p1  # the generation bump retired the cached plan
+    assert (p2.fmt, p2.reason) == ("dense", "tuned")
+
+
+def test_demotion_on_regression_restores_stack():
+    a, b, bs = _pair(nblk=6, bsize=4, fill=1.0, seed=8)
+    set_config(mm_format="auto", dense_occ_threshold=2.0,
+               dense_flop_ratio=0)
+    c = dt.create("fC", bs, bs)
+    store.promote({"m": 4, "n": 4, "k": 4, "dtype": "float64",
+                   "stack_size": 0, "format": "dense",
+                   "format_occ": 0.2, "format_gflops": 9.9,
+                   "driver": "dense", "gflops": 9.9})
+    assert _choose(a, b, c).fmt == "dense"
+    assert store.demote(4, 4, 4, "float64", 0, reason="regression")
+    plan = _choose(a, b, c)
+    assert (plan.fmt, plan.reason) == ("stack", "default")
+    assert _ctr("dbcsr_tpu_tune_demotions_total", reason="regression") \
+        >= 1
+
+
+# --------------------------------------------------- faults and ABFT
+
+def test_format_plan_fault_degrades_to_stack_once():
+    a, b, bs = _pair(nblk=6, bsize=4, fill=1.0, seed=9)
+    set_config(mm_format="auto")
+    with faults.inject_faults("format_plan:raise,times=1") as sp:
+        c1 = dt.create("fC1", bs, bs)
+        dt.multiply("N", "N", 1.0, a, b, 0.0, c1)
+        c2 = dt.create("fC2", bs, bs)
+        dt.multiply("N", "N", 1.0, a, b, 0.0, c2)
+    assert sp[0].fired == 1
+    assert c1._mm_algorithm == "stack"   # faulted plan: degraded
+    assert c2._mm_algorithm == "dense"   # transient — never cached
+    assert (_dense_of(c1) == _dense_of(c2)).all()
+
+
+@pytest.mark.parametrize("fmt,site", [
+    ("stack", "execute_stack"),
+    ("dense", "dense"),
+    ("composite", "dense"),  # canvas paths share the dense site
+])
+def test_chaos_flip_under_each_format_heals_bitwise(fmt, site):
+    """A seed-deterministic finite block-flip injected under each
+    storage format is DETECTED by the ABFT layer and fully healed:
+    the final C is bitwise-equal to the fault-free run (integer
+    operands make even the cross-engine recompute exact)."""
+    a, b, bs = _pair(nblk=8, bsize=4, band=1, seed=10)
+    clean = _dense_of(_run(fmt, a, b, bs))
+
+    set_config(abft="verify")
+    set_config(mm_format=fmt)
+    fp.reset()
+    c = dt.create("fC", bs, bs)
+    with faults.inject_faults(f"{site}:flip,seed=5,times=1") as sp:
+        dt.multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert sp[0].fired == 1
+    assert (_dense_of(c) == clean).all()
+    assert _ctr("dbcsr_tpu_abft_mismatches_total") >= 1
+    assert _ctr("dbcsr_tpu_abft_recoveries_total") >= 1
+
+
+def test_abft_live_on_composite_clean_run():
+    """ABFT probes the batched composite panels on a healthy run:
+    no mismatch, no fallback, the composite format actually executes."""
+    a, b, bs = _pair(nblk=8, bsize=4, band=1, seed=11)
+    set_config(abft="verify", mm_format="composite")
+    fp.reset()
+    c = dt.create("fC", bs, bs)
+    dt.multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert c._mm_algorithm == "composite"
+    assert _ctr("dbcsr_tpu_abft_mismatches_total") == 0
+
+
+# ------------------------------------------------- wide-N n-chunking
+
+def test_wide_n_product_goes_dense_via_n_chunking(monkeypatch):
+    """A C block-row wider than the canvas cap used to force the stack
+    path; the n-chunked dense carve keeps it dense when profitable."""
+    monkeypatch.setattr(mm_mod, "_DENSE_MAX_CANVAS", 512)
+    fp.reset()
+    # even ONE full-width C block-row (4*64*4 = 1024 els) overflows
+    # this cap: the n axis must chunk or dense is unreachable
+    chunks = mm_mod._dense_chunking(16, 64, 16, 4, 4, 4)
+    assert chunks is not None
+    mrb, kcb, ncb = chunks
+    assert ncb < 64  # the n axis really chunks under this cap
+
+    # a genuinely wide-N product: A 8x8 blocks, B 8x64 — one C
+    # block-row is 4*256 = 1024 els, twice the cap
+    rng = np.random.default_rng(12)
+    rbs, cbs = [4] * 8, [4] * 64
+    a = dt.create("wA", rbs, rbs)
+    b = dt.create("wB", rbs, cbs)
+    for m, (nr, nc) in ((a, (8, 8)), (b, (8, 64))):
+        rows, cols = np.meshgrid(np.arange(nr), np.arange(nc),
+                                 indexing="ij")
+        m.put_blocks(rows.ravel(), cols.ravel(),
+                     rng.integers(-4, 5, size=(nr * nc, 4, 4)
+                                  ).astype(np.float64))
+        m.finalize()
+    set_config(mm_format="auto")
+    fp.reset()
+    c = dt.create("wC", rbs, cbs)
+    dt.multiply("N", "N", 1.0, a, b, 0.0, c)
+    assert c._mm_algorithm == "dense"
+
+    monkeypatch.setattr(mm_mod, "_DENSE_MAX_CANVAS", 2 * 10 ** 8)
+    set_config(mm_format="stack")
+    fp.reset()
+    ref = dt.create("wR", rbs, cbs)
+    dt.multiply("N", "N", 1.0, a, b, 0.0, ref)
+    assert (_dense_of(c) == _dense_of(ref)).all()
+
+
+# ------------------------------------- the trial → promotion closing
+
+def test_format_trial_promotes_learned_crossover(monkeypatch):
+    """The off-hot-path format trial A/Bs the formats on a synthetic
+    grid and the service merge-promotes the winner's format columns —
+    the planner then serves them (reason='tuned')."""
+    monkeypatch.setenv("DBCSR_TPU_TUNE_NREP", "1")
+    cell = {"m": 8, "n": 8, "k": 8, "dtype": "float64",
+            "driver": "format", "stack_size": 0, "format": "stack",
+            "occ": 0.95, "grid": [8, 8, 8],
+            "observed_gflops": 1e-4, "target_gflops": 1.0,
+            "wasted_flop_seconds": 1.0, "source": "test",
+            "reason": "test"}
+    trial = trials.run_format_trial(cell, seed=3, reps=2)
+    assert trial.ok and trial.entry is not None
+    assert trial.entry["format"] in fp.FORMATS
+    cands = {c["format"]: c for c in trial.candidates}
+    assert {"stack", "dense"} <= set(cands)
+    assert all(c["gflops"] > 0 for c in trial.candidates)
+
+    svc = tune_service.TuneService(interval_s=3600)
+    if trial.entry["format"] == "stack":
+        # under suite-wide CPU load the tiny trial grid's timing can
+        # let stack win — the promotion contract is then a HOLD:
+        # re-pinning the regretted format is churn, not progress
+        assert svc._maybe_promote_format(cell, trial) is None
+    # promotion path, decoupled from the timing race: a dense win
+    # carries exactly the format columns the trial emits
+    win = trials.TrialResult(
+        trials.OK, cell,
+        {"m": 8, "n": 8, "k": 8, "dtype": "float64",
+         "format": "dense", "format_occ": 0.95,
+         "format_driver": "dense",
+         "format_gflops": cands["dense"]["gflops"]},
+        trial.candidates, trial.elapsed_s, None, 0)
+    rec = svc._maybe_promote_format(cell, win)
+    assert rec is not None
+    row = params_mod.lookup(8, 8, 8, "float64")
+    assert row["format"] == "dense"
+    assert 0.0 < float(row["format_occ"]) <= 0.95
+    assert float(row["format_gflops"]) > 0
+
+
+# ----------------------------------------------------- fleet sharing
+
+class _PeerState:
+    payload: dict = {}
+
+
+class _PeerHandler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        body = json.dumps(_PeerState.payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # silence
+        pass
+
+
+@pytest.fixture
+def peer_url():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _PeerHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+    srv.server_close()
+
+
+def _peer_row():
+    return {"key": [4, 4, 4, "float64", 0],
+            "entry": {"m": 4, "n": 4, "k": 4, "dtype": "float64",
+                      "stack_size": 0, "driver": "xla", "gflops": 5.0,
+                      "format": "dense", "format_occ": 0.3,
+                      "format_gflops": 5.0, "format_driver": "dense",
+                      "tuned_by": "dbcsr_tpu.tune"},
+            "generation": 3, "t_unix": 0.0}
+
+
+def test_fleet_adopts_same_kind_format_promotion(peer_url):
+    kind = params_mod.device_kind()
+    _PeerState.payload = {"kind": kind, "rows": [_peer_row()]}
+    adopted = store.peer_sync(kind=kind, peers=[peer_url])
+    assert adopted == [[4, 4, 4, "float64", 0]]
+    row = params_mod.lookup(4, 4, 4, "float64")
+    assert row["format"] == "dense"
+    assert row["adopted_from"] == peer_url
+    assert _ctr("dbcsr_tpu_tune_fleet_total", event="adopted") == 1
+    # adopted rows never re-export: no promotion echo around the fleet
+    assert store.export_promotions(kind=kind)["rows"] == []
+    # second sync: local evidence now as good — no churn
+    assert store.peer_sync(kind=kind, peers=[peer_url]) == []
+
+
+def test_fleet_skips_other_device_kind(peer_url):
+    """Another chip's crossover does not transfer: a kind-mismatched
+    payload is counted and dropped without touching the table."""
+    _PeerState.payload = {"kind": "definitely_not_this_kind",
+                          "rows": [_peer_row()]}
+    assert store.peer_sync(peers=[peer_url]) == []
+    assert params_mod.lookup(4, 4, 4, "float64") is None
+    assert _ctr("dbcsr_tpu_tune_fleet_total", event="kind_mismatch") == 1
+
+
+def test_promotions_route_serves_origin_rows():
+    from dbcsr_tpu.obs import server
+
+    store.promote({"m": 4, "n": 4, "k": 4, "dtype": "float64",
+                   "stack_size": 0, "format": "dense",
+                   "format_occ": 0.2, "format_gflops": 9.9,
+                   "driver": "dense", "gflops": 9.9})
+    kind = params_mod.device_kind()
+    server.start(port=0)
+    try:
+        with urllib.request.urlopen(
+                f"{server.url()}/tune/promotions?kind={kind}",
+                timeout=30) as r:
+            payload = json.loads(r.read().decode())
+    finally:
+        server.stop()
+    assert payload["kind"] == kind
+    assert len(payload["rows"]) == 1
+    assert payload["rows"][0]["entry"]["format"] == "dense"
+
+
+# ------------------------------------------------------------- knobs
+
+def test_format_knob_validation():
+    with pytest.raises(ValueError):
+        set_config(mm_format="bogus")
+    with pytest.raises(ValueError):
+        set_config(composite_max_panels=1)
+    set_config(mm_format="dense")
+    assert get_config().mm_format == "dense"

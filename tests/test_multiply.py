@@ -464,7 +464,7 @@ def test_dense_chunked_matches_stack_path(monkeypatch):
     c0 = dt.make_random_matrix("C", rbs, cbs, occupation=0.3,
                                rng=np.random.default_rng(3))
     want = 1.5 * (dt.to_dense(a) @ dt.to_dense(b)) + 0.5 * dt.to_dense(c0)
-    assert mm._dense_chunking(13, 11, 17, 7, 7, 7) == (9, 9)
+    assert mm._dense_chunking(13, 11, 17, 7, 7, 7) == (9, 9, 11)
     set_config(mm_dense=True)
     try:
         dt.multiply("N", "N", 1.5, a, b, 0.5, c0)
@@ -483,8 +483,11 @@ def test_dense_chunked_gate_and_feasibility(monkeypatch):
     from dbcsr_tpu.mm import multiply as mm
 
     monkeypatch.setattr(mm, "_DENSE_MAX_CANVAS", 2000)
-    # a single block row wider than the cap: no k-chunking can fit
-    assert mm._dense_chunking(4, 50, 4, 10, 10, 10) is None
+    # a single block row wider than the cap: the n axis chunks instead
+    # of declining (the format planner's wide-N extension)
+    assert mm._dense_chunking(4, 50, 4, 10, 10, 10) == (1, 1, 20)
+    # a single BLOCK over the cap: genuinely unchunkable, gate closed
+    assert mm._dense_chunking(2, 2, 2, 50, 50, 50) is None
     # feasible uniform geometry chunks
     assert mm._dense_chunking(13, 11, 17, 7, 7, 7) is not None
 

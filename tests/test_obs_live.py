@@ -374,7 +374,8 @@ def test_doctor_runbook_anchors_exist():
             "observability.md": anchors_of("observability.md"),
             "static_analysis.md": anchors_of("static_analysis.md"),
             "autotuning.md": anchors_of("autotuning.md"),
-            "loadtest.md": anchors_of("loadtest.md")}
+            "loadtest.md": anchors_of("loadtest.md"),
+            "performance.md": anchors_of("performance.md")}
     for kind, (_, anchor) in doctor.HINTS.items():
         if anchor.startswith("docs/"):
             doc, frag = anchor[len("docs/"):].split("#", 1)
