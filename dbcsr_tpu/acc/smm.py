@@ -610,6 +610,12 @@ def _prepare_stack_impl(c_data, a_data, b_data, a_idx, b_idx, c_idx,
         # smm per-shape dispatch (dbcsr_config.F:34-38)
         _note_driver("host", "tuned", S, c_data, a_data, b_data, tuned)
         return _host_plan()
+    if prec is not None:
+        # executed-precision span annotation (trace_summary surfaces
+        # it next to the format/algorithm attrs): what this stack will
+        # actually compute in, not what was requested
+        _trace.annotate(
+            precision=f"{prec[0]}{'+comp' if prec[1] else ''}")
     plan = StackPlan()
     plan.nseg = c_data.shape[0]
     # R-tiled grouped layout (see _process_stack_xla_group): the default

@@ -90,10 +90,25 @@ KNOBS = {
         "owner": "bench.py",
         "doc": "chained-workload bench: iteration count.",
     },
+    "DBCSR_TPU_CHANGEPOINT": {
+        "owner": "obs/changepoint.py",
+        "doc": "=0 disables CUSUM change-point detection over the "
+               "telemetry store (default on).",
+    },
     "DBCSR_TPU_CHECK_OUTPUTS": {
         "owner": "acc/smm.py",
         "doc": "=1 forces the per-launch finite-output check (always on "
                "under fault injection).",
+    },
+    "DBCSR_TPU_CP_H": {
+        "owner": "obs/changepoint.py",
+        "doc": "CUSUM decision threshold in baseline sigmas (default 8): "
+               "a series has shifted when the accumulator crosses it.",
+    },
+    "DBCSR_TPU_CP_REF_N": {
+        "owner": "obs/changepoint.py",
+        "doc": "reference-window samples frozen into a change-point "
+               "baseline (default 12).",
     },
     "DBCSR_TPU_DENSE_CARVE": {
         "owner": "mm/multiply.py",
@@ -293,6 +308,17 @@ KNOBS = {
         "owner": "core/mempool.py",
         "doc": "=0/false/no disables the device memory pool (default on).",
     },
+    "DBCSR_TPU_PROFILE": {
+        "owner": "obs/profiler.py",
+        "doc": "continuous profile baseline: =0 disables the fold, a "
+               "path persists sealed epochs as per-process JSONL shards "
+               "(default: on, in-memory ring only).",
+    },
+    "DBCSR_TPU_PROFILE_EPOCH_N": {
+        "owner": "obs/profiler.py",
+        "doc": "multiplies folded per profile-baseline epoch before it "
+               "is sealed and generation-tagged (default 64).",
+    },
     "DBCSR_TPU_POOL_BYTES": {
         "owner": "core/mempool.py",
         "doc": "device memory pool budget in bytes (evicts LRU beyond it).",
@@ -312,6 +338,21 @@ KNOBS = {
     "DBCSR_TPU_PREC_BENCH_REPS": {
         "owner": "tools/precision_bench.py",
         "doc": "precision bench: repetitions per case.",
+    },
+    "DBCSR_TPU_RCA": {
+        "owner": "obs/rca.py",
+        "doc": "=0 disables the change ledger + causal ranking "
+               "(default on).",
+    },
+    "DBCSR_TPU_RCA_LEDGER_N": {
+        "owner": "obs/rca.py",
+        "doc": "change-ledger ring capacity (default 256 entries).",
+    },
+    "DBCSR_TPU_RCA_WINDOW_S": {
+        "owner": "obs/rca.py",
+        "doc": "attribution window in seconds: how far before an "
+               "estimated shift a change is still a candidate cause "
+               "(default 600).",
     },
     "DBCSR_TPU_ROOFLINE": {
         "owner": "obs/costmodel.py",

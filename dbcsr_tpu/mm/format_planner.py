@@ -119,6 +119,7 @@ def reset() -> None:
     with _lock:
         _plan_cache.clear()
         _regret.clear()
+        _last_choice.clear()
 
 
 def _tuned_row(bm: int, bn: int, bk: int, dtype: str) -> Optional[dict]:
@@ -286,8 +287,32 @@ def note_decision(plan: Plan) -> None:
         if plan.occ is not None:
             _flight.note("format_occ", round(plan.occ, 4))
         _trace.annotate(format=plan.fmt, format_reason=plan.reason)
+        _note_choice_change(plan)
     except Exception:
         pass
+
+
+# last (format, reason) chosen per cell: a CHANGED choice is a system
+# change the causal diagnosis plane's ledger must see (obs.rca) — the
+# first sight of a cell is a baseline, not a change, so startup never
+# floods the ledger with one entry per cell
+_last_choice: dict = {}
+
+
+def _note_choice_change(plan: Plan) -> None:
+    key = str(plan.cell) if plan.cell is not None else "uncelled"
+    choice = (plan.fmt, plan.reason)
+    with _lock:
+        prev = _last_choice.get(key)
+        _last_choice[key] = choice
+    if prev is None or prev == choice:
+        return
+    from dbcsr_tpu.obs import events as _events
+
+    _events.publish("format_decision", {
+        "cell": key, "format": plan.fmt, "reason": plan.reason,
+        "prev": f"{prev[0]}:{prev[1]}",
+    })
 
 
 def note_outcome(plan: Plan, seconds: float, flops: float) -> None:

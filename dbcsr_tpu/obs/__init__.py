@@ -34,6 +34,11 @@ NVTX/cachegrind hooks — rebuilt machine-readable:
 * `server` — opt-in stdlib HTTP introspection endpoint
   (``DBCSR_TPU_OBS_PORT``): ``/metrics``, ``/healthz``, ``/flight``,
   ``/events?product_id=…``; `tools/doctor.py` is the CLI reader.
+* `profiler` / `changepoint` / `rca` — the causal diagnosis plane:
+  continuous per-(driver, cell, phase) profile baselines, CUSUM
+  level-shift detection over the telemetry store, and the change
+  ledger + causal ranker that names which system change regressed a
+  series (``/rca``, ``/profile/diff``, ``doctor --diagnose``).
 
 Existing call sites need no churn: `core.timings.timed()` and
 `core.stats.record_*` feed the tracer automatically, and the multiply
@@ -51,6 +56,9 @@ from dbcsr_tpu.obs import metrics
 from dbcsr_tpu.obs import timeseries
 from dbcsr_tpu.obs import slo
 from dbcsr_tpu.obs import health
+from dbcsr_tpu.obs import profiler
+from dbcsr_tpu.obs import changepoint
+from dbcsr_tpu.obs import rca
 from dbcsr_tpu.obs import server
 
 from dbcsr_tpu.obs.tracer import (  # noqa: F401
@@ -63,16 +71,20 @@ from dbcsr_tpu.obs.tracer import (  # noqa: F401
 
 # version stamp for machine-readable obs artifacts (bench capture JSON,
 # trace shards, perf-gate reports): bump when the schema of any of
-# them changes incompatibly.  v6 = workload trace capture + capacity
+# them changes incompatibly.  v7 = the causal diagnosis plane
+# (change-point events, ranked RCA reports + the `doctor --diagnose
+# --json` report shape, profile-baseline epochs, the /rca +
+# /profile/diff routes, RCA_CERT.json — this PR); v6 = workload trace
+# capture + capacity
 # certification (workload_request shards, WORKLOAD_TRACE.jsonl,
-# CAPACITY_CERT.json — this PR); v5 = tenant cost attribution (tenant
+# CAPACITY_CERT.json); v5 = tenant cost attribution (tenant
 # usage meters, the /usage route, incident bundles, the usage rollup
 # artifact); v4 = telemetry time-series shards + SLO burn
 # gauges + the `slo` health component; v3 = event bus JSONL +
 # product_id correlation + health verdicts (PR 5); v2 = trace sharding
 # + roofline/costmodel fields (PR 2); v1 = the original obs subsystem
 # (PR 1).
-OBS_SCHEMA_VERSION = 6
+OBS_SCHEMA_VERSION = 7
 
 
 def enable_trace(path: str | None = None) -> "tracer.Tracer":
@@ -107,6 +119,7 @@ def obs_active() -> bool:
 __all__ = [
     "tracer", "flight", "metrics", "costmodel", "events", "health",
     "server", "timeseries", "slo", "windows", "shard",
+    "profiler", "changepoint", "rca",
     "enable_trace", "disable_trace", "trace_enabled", "get_tracer",
     "annotate", "trace_add", "instant", "shard_path",
     "write_chrome_trace", "OBS_SCHEMA_VERSION", "obs_active",

@@ -90,6 +90,25 @@ _sink_base: str | None = None
 _sink_path: str | None = None
 _sink_pid_final = False
 
+# in-process subscribers (obs.rca's change ledger): called with the
+# bus record AFTER it is ringed, outside _lock, each guarded — a
+# subscriber can publish further events without deadlocking the bus
+_subscribers: list = []
+
+
+def subscribe(fn) -> None:
+    """Register ``fn(record)`` to observe every bus record (after the
+    ring append, outside the bus lock).  Idempotent per function."""
+    if fn not in _subscribers:
+        _subscribers.append(fn)
+
+
+def unsubscribe(fn) -> None:
+    try:
+        _subscribers.remove(fn)
+    except ValueError:
+        pass
+
 
 def enabled() -> bool:
     """True when the bus records (ring + sink + health sampling); when
@@ -255,6 +274,11 @@ def publish(kind: str, args: dict | None = None, *, instant: bool = True,
                 _sink.write(json.dumps(rec, default=str) + "\n")
             except Exception:
                 pass  # a full disk must not fail the multiply
+    for fn in list(_subscribers):
+        try:
+            fn(rec)
+        except Exception:
+            pass  # a broken subscriber must not fail the publisher
     return rec
 
 

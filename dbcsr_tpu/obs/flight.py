@@ -91,12 +91,19 @@ def note_event(event: str, **fields) -> None:
     """Append one structured event (fault injected, breaker transition,
     driver failover) to the innermost open record's bounded ``events``
     list — the resilience layer's black-box entries.  No-op outside a
-    record."""
+    record.
+
+    Overflow drops the OLDEST entry: in a black box the events nearest
+    the crash are the diagnostic ones.  ``events_total`` preserves the
+    true count, so a truncated list is detectable (``events_total >
+    len(events)``)."""
     if not _current:
         return
-    events = _current[-1].setdefault("events", [])
+    rec = _current[-1]
+    events = rec.setdefault("events", [])
+    rec["events_total"] = rec.get("events_total", 0) + 1
     if len(events) >= _MAX_EVENTS_PER_RECORD:
-        return
+        del events[0]
     events.append(dict(fields, event=event))
 
 
@@ -117,6 +124,12 @@ def commit(error: str | None = None) -> dict | None:
     except Exception:
         pass
     _ring.append(rec)
+    try:
+        from dbcsr_tpu.obs import profiler
+
+        profiler.observe(rec)
+    except Exception:
+        pass  # profile folding must never fail a multiply
     return rec
 
 

@@ -167,6 +167,14 @@ def _assemble(reason: str, args: dict, sample_rec) -> dict:
         bundle["usage"] = _attr.usage()
     except Exception:
         pass
+    try:
+        from dbcsr_tpu.obs import rca as _rca
+
+        reps = _rca.reports(limit=1)
+        if reps:
+            bundle["rca"] = reps[-1]
+    except Exception:
+        pass
     return bundle
 
 
@@ -185,7 +193,7 @@ def _persist(bundle: dict, reason: str, seq: int) -> "str | None":
         with open(path, "w") as fh:
             fh.write(json.dumps(dict(bundle["meta"], rec="meta"),
                                 default=str) + "\n")
-            for key in ("health", "sample", "usage"):
+            for key in ("health", "sample", "usage", "rca"):
                 if bundle.get(key) is not None:
                     fh.write(json.dumps({"rec": key, key: bundle[key]},
                                         default=str) + "\n")

@@ -230,6 +230,15 @@ def reset(include_stats: bool = True) -> None:
             _inc = _sys.modules.get("dbcsr_tpu.obs.incidents")
             if _inc is not None:
                 _inc.reset()
+            # the causal diagnosis plane joins the same contract: a
+            # full reset drops profile epochs, detector baselines and
+            # the change ledger; a metric re-window keeps them
+            for name in ("dbcsr_tpu.obs.profiler",
+                         "dbcsr_tpu.obs.changepoint",
+                         "dbcsr_tpu.obs.rca"):
+                mod = _sys.modules.get(name)
+                if mod is not None:
+                    mod.reset()
         except Exception:
             pass
 

@@ -134,6 +134,10 @@ class _Handler(BaseHTTPRequestHandler):
                     kind=q.get("kind", [None])[0], limit=limit))
             elif route == "/timeseries":
                 self._timeseries(parse_qs(url.query))
+            elif route == "/rca":
+                self._rca(parse_qs(url.query))
+            elif route == "/profile/diff":
+                self._profile_diff(parse_qs(url.query))
             elif route == "/slo":
                 from dbcsr_tpu.obs import slo
 
@@ -206,6 +210,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "routes": ["/metrics", "/healthz", "/flight",
                                "/events?product_id=&kind=&limit=",
                                "/timeseries?metric=&since=&agg=&tier=",
+                               "/rca?limit=&ledger=",
+                               "/profile/diff?a=&b=&top=",
                                "/slo",
                                "/cluster?format=prom|json&ports=&n=",
                                "/serve/submit (POST)",
@@ -266,6 +272,63 @@ class _Handler(BaseHTTPRequestHandler):
             metric, labels=labels or None, since=num("since"),
             until=num("until"), agg=q.get("agg", [None])[0] or None,
             tier=tier))
+
+    # --------------------------------------------- causal diagnosis plane
+
+    def _rca(self, q: dict) -> None:
+        """``/rca``: ranked causal reports + the change ledger + fired
+        change-points, versioned by the obs schema (fleet merges key
+        on it)."""
+        from dbcsr_tpu import obs
+        from dbcsr_tpu.obs import changepoint, rca
+
+        limit = None
+        try:
+            raw = q.get("limit", [None])[0]
+            limit = int(raw) if raw else None
+        except ValueError:
+            pass
+        try:
+            ledger_n = int(q.get("ledger", ["32"])[0])
+        except ValueError:
+            ledger_n = 32
+        self._send_json({
+            "schema": obs.OBS_SCHEMA_VERSION,
+            "reports": rca.reports(limit=limit),
+            "changepoints": changepoint.changepoints(limit=limit),
+            "ledger": rca.ledger(limit=ledger_n),
+        })
+
+    def _profile_diff(self, q: dict) -> None:
+        """``/profile/diff``: differential profile between two baseline
+        snapshots.  ``a``/``b`` accept an epoch number, a negative ring
+        index, or ``current``; defaults compare the previous sealed
+        epoch against the newest profile state."""
+        from dbcsr_tpu.obs import profiler
+
+        def ref(name, default):
+            raw = q.get(name, [None])[0]
+            if raw in (None, ""):
+                return default
+            if raw == "current":
+                return "current"
+            try:
+                return int(raw)
+            except ValueError:
+                return default
+
+        try:
+            top = int(q.get("top", ["8"])[0])
+        except ValueError:
+            top = 8
+        a = ref("a", -2)
+        b = ref("b", "current")
+        d = profiler.diff(a, b, top=top)
+        if b == "current" and not d.get("ok"):
+            # a young process may have sealed nothing yet; fall back to
+            # newest-sealed vs current before giving up
+            d = profiler.diff(-1, "current", top=top)
+        self._send_json(d)
 
     # --------------------------------------------------- fleet federation
 

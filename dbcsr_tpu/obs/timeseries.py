@@ -341,12 +341,20 @@ def _collect_serve() -> list:
 def _collect_breakers() -> list:
     import sys
 
+    pts = []
+    # fallback/failure counters ride this collector so the change-point
+    # detector's fallback_rate series replays from the shard alone
+    from dbcsr_tpu.obs import metrics
+
+    for name in ("dbcsr_tpu_driver_fallback_total",
+                 "dbcsr_tpu_driver_failures_total"):
+        for labels, v in metrics.counter_items(name):
+            pts.append((name, labels, v, COUNTER))
     br = sys.modules.get("dbcsr_tpu.resilience.breaker")
     board = getattr(br, "_board", None) if br is not None else None
     if board is None:
-        return []  # never CREATE a board just to sample it
+        return pts  # never CREATE a board just to sample it
     code = {"closed": 0, "half_open": 1, "open": 2}
-    pts = []
     for key, ent in board.snapshot().items():
         driver, _, shape = key.partition("|")
         pts.append(("dbcsr_tpu_breaker_state",
@@ -447,6 +455,7 @@ def _collect_value_reuse() -> list:
                  "dbcsr_tpu_incremental_saved_flops_total",
                  "dbcsr_tpu_incremental_saved_bytes_total",
                  "dbcsr_tpu_incremental_degrade_total",
+                 "dbcsr_tpu_plan_cache_total",
                  "dbcsr_tpu_product_cache_total",
                  "dbcsr_tpu_product_cache_saved_flops_total"):
         for labels, v in metrics.counter_items(name):
@@ -514,8 +523,9 @@ def _collect_format() -> list:
     fp = sys.modules.get("dbcsr_tpu.mm.format_planner")
     if fp is not None:  # an un-imported planner has no regrets
         try:
-            for fmt, ratio in fp.regret_gauges().items():
-                pts.append(("dbcsr_tpu_format_regret", {"format": fmt},
+            # regret_gauges() yields (labels_dict, ratio) rows
+            for labels, ratio in fp.regret_gauges():
+                pts.append(("dbcsr_tpu_format_regret", dict(labels),
                             ratio, GAUGE))
         except Exception:
             pass
@@ -560,10 +570,31 @@ def _collect_workload() -> list:
     return pts
 
 
+def _collect_profiler() -> list:
+    """Continuous-profile plane (obs.profiler): the monotonic
+    multiply-wall counter pair the latency change-point series derives
+    from (dispatch_seconds only moves when a plan is BUILT, so cached
+    steady-state multiplies would otherwise read as zero latency) plus
+    the sealed-epoch cursor."""
+    import sys
+
+    pts: list = []
+    prof = sys.modules.get("dbcsr_tpu.obs.profiler")
+    if prof is None:  # never import the profiler just to sample it
+        return pts
+    tot = prof.totals()
+    pts.append(("dbcsr_tpu_multiply_seconds_total", {},
+                tot["ms"] / 1e3, COUNTER))
+    pts.append(("dbcsr_tpu_profiled_multiplies_total", {},
+                float(tot["n"]), COUNTER))
+    return pts
+
+
 _COLLECTORS = (_collect_engine, _collect_serve, _collect_breakers,
                _collect_pool, _collect_integrity, _collect_precision,
                _collect_value_reuse, _collect_tune, _collect_health,
-               _collect_format, _collect_attribution, _collect_workload)
+               _collect_format, _collect_attribution, _collect_workload,
+               _collect_profiler)
 
 
 # ------------------------------------------------------------ sampling
@@ -680,6 +711,20 @@ def sample(now: float | None = None, reason: str = "manual") -> dict | None:
             _inc.on_sample(rec)
     except Exception:
         pass  # capture must never fail the boundary that hosts it
+    # the causal-diagnosis boundary (same contract): the RCA knob poll
+    # runs BEFORE the change-point scan so a mid-run knob flip is on
+    # the change ledger when a shift it caused fires on this sample
+    try:
+        import sys as _sys
+
+        _rca = _sys.modules.get("dbcsr_tpu.obs.rca")
+        if _rca is not None:
+            _rca.on_sample(rec)
+        _cpm = _sys.modules.get("dbcsr_tpu.obs.changepoint")
+        if _cpm is not None:
+            _cpm.on_sample(rec)
+    except Exception:
+        pass  # diagnosis must never fail the boundary that hosts it
     return rec
 
 

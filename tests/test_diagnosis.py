@@ -1,0 +1,434 @@
+"""Causal diagnosis plane tests: continuous profile baselines
+(`obs.profiler`), CUSUM change-point detection (`obs.changepoint`),
+root-cause attribution (`obs.rca`), the flight recorder's bounded
+event list, the rolling-window/health edge behavior both build on,
+the `doctor --diagnose` report schema, and the lint-checked
+diagnosis registries.
+
+All runnable under JAX_PLATFORMS=cpu (conftest forces it); the
+detector tests drive `observe()` directly with synthetic samples so
+they are deterministic and clock-free where possible."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dbcsr_tpu.obs import (changepoint, events, flight, health, metrics,
+                           profiler, rca, windows)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+import doctor  # noqa: E402
+import trace_summary  # noqa: E402
+
+
+def setup_function(_):
+    metrics.reset()
+    health.reset()
+    events.clear()
+    events.set_enabled(True)
+    changepoint.reset()
+    changepoint.set_enabled(True)
+    rca.reset()
+    rca.set_enabled(True)
+    profiler.reset()
+    profiler.set_enabled(True)
+    flight.clear()
+
+
+def _counter_values(name):
+    c = metrics._counters.get(name)
+    return dict(c.values) if c is not None else {}
+
+
+# ------------------------------------------------------- change points
+
+def test_changepoint_warmup_then_clean_step_fires(monkeypatch):
+    """The first ref_n samples freeze the baseline (no fire possible);
+    a clean step then fires with the step's time as the shift estimate
+    and the level delta as the magnitude."""
+    monkeypatch.setenv("DBCSR_TPU_CP_REF_N", "4")
+    changepoint.reset()
+    for i in range(4):
+        assert changepoint.observe(
+            "multiply_latency_ms", {}, float(i), 1.0) is None
+    st = changepoint.state()["multiply_latency_ms|{}"]
+    assert st["warmed"] and st["baseline"] == 1.0
+    cp = changepoint.observe("multiply_latency_ms", {}, 10.0, 2.0)
+    assert cp is not None
+    assert cp["direction"] == "up"
+    assert cp["regression"] is True        # latency regresses upward
+    assert cp["t_shift"] == 10.0           # excursion start, not t
+    assert cp["baseline"] == 1.0
+    assert cp["magnitude"] == pytest.approx(1.0)
+    assert _counter_values("dbcsr_tpu_changepoints_total") == {
+        (("series", "multiply_latency_ms"),): 1}
+
+
+def test_changepoint_rebaseline_no_refire_then_recovery_fires(monkeypatch):
+    """After a fire the detector re-baselines onto the new level — the
+    persisting condition cannot re-fire — and re-arms: the eventual
+    recovery is a fresh change-point in the improving direction, which
+    is recorded but NOT handed to the causal ranker."""
+    monkeypatch.setenv("DBCSR_TPU_CP_REF_N", "4")
+    changepoint.reset()
+    t = iter(range(100))
+    for _ in range(4):
+        changepoint.observe("multiply_latency_ms", {}, next(t), 1.0)
+    assert changepoint.observe(
+        "multiply_latency_ms", {}, next(t), 2.0) is not None
+    assert len(rca.reports()) == 1         # regression -> ranked report
+    # the shifted level persists: re-warm + steady, no second fire
+    for _ in range(10):
+        assert changepoint.observe(
+            "multiply_latency_ms", {}, next(t), 2.0) is None
+    assert len(changepoint.changepoints()) == 1
+    # recovery: improving shift fires, but opens no causal report
+    down = None
+    for _ in range(10):
+        down = changepoint.observe("multiply_latency_ms", {}, next(t), 1.0)
+        if down:
+            break
+    assert down is not None and down["direction"] == "down"
+    assert down["regression"] is False
+    assert len(rca.reports()) == 1
+    assert changepoint.changepoints(regressions_only=True) != \
+        changepoint.changepoints()
+
+
+def test_changepoint_disabled_and_unregistered_are_noops():
+    changepoint.set_enabled(False)
+    assert changepoint.observe("multiply_latency_ms", {}, 0.0, 1.0) is None
+    changepoint.set_enabled(True)
+    assert changepoint.observe("no_such_series", {}, 0.0, 1.0) is None
+    assert changepoint.state() == {}
+
+
+# ---------------------------------------------------------------- rca
+
+def test_ledger_admits_registered_kinds_only():
+    events.publish("tune_promotion",
+                   {"driver": "xla_group", "generation": 3, "junk": "x"})
+    events.publish("serve_drain", {"queued": 1})   # not a change kind
+    led = rca.ledger()
+    assert len(led) == 1
+    ent = led[0]
+    assert ent["kind"] == "tune_promotion"
+    assert ent["driver"] == "xla_group" and ent["generation"] == 3
+    assert "junk" not in ent               # payload whitelist
+
+
+def test_knob_poll_synthesizes_knob_change(monkeypatch):
+    monkeypatch.setenv("DBCSR_TPU_MM_FORMAT", "stack")
+    rca.reset()
+    rca.poll_knobs()                       # seeds last-seen state
+    assert rca.ledger(kind="knob_change") == []
+    monkeypatch.setenv("DBCSR_TPU_MM_FORMAT", "dense")
+    rca.poll_knobs()
+    led = rca.ledger(kind="knob_change")
+    assert len(led) == 1
+    assert led[0]["knob"] == "DBCSR_TPU_MM_FORMAT"
+    assert led[0]["value"] == "dense" and led[0]["prev"] == "stack"
+
+
+def test_ranking_prefers_label_overlap_and_weights():
+    """A change whose payload matches the regressed series' labels
+    outranks an unrelated change of similar age."""
+    events.publish("worker_up", {"worker": "w9"})
+    events.publish("tune_promotion",
+                   {"driver": "xla_group", "generation": 7})
+    now = time.time()
+    report = rca.on_changepoint({
+        "series": "achieved_gflops", "labels": {"driver": "xla_group"},
+        "t": now, "t_shift": now, "direction": "down",
+        "baseline": 40.0, "level": 20.0, "magnitude": -20.0,
+        "sigma": 2.0, "regression": True, "n": 30,
+    })
+    assert report["top_cause"] == "tune_promotion"
+    causes = report["causes"]
+    assert [c["rank"] for c in causes] == list(range(1, len(causes) + 1))
+    assert causes[0]["score"] > causes[1]["score"]
+    assert causes[0]["generation"] == 7
+    assert rca.reports()[-1]["top_cause"] == "tune_promotion"
+    assert _counter_values("dbcsr_tpu_rca_reports_total") == {
+        (("cause", "tune_promotion"),): 1}
+
+
+def test_rca_report_attaches_profile_diff(monkeypatch):
+    monkeypatch.setenv("DBCSR_TPU_PROFILE_EPOCH_N", "2")
+    profiler.reset()
+    for ms in (1.0, 1.0):
+        profiler.observe({"drivers": {"host": {"entries": 4}},
+                          "mnk": (16, 16, 16), "dur_ms": ms,
+                          "phases_ms": {"multiply_stacks": ms}})
+    t_mid = time.time()
+    time.sleep(0.01)
+    for ms in (8.0, 8.0):
+        profiler.observe({"drivers": {"host": {"entries": 4}},
+                          "mnk": (16, 16, 16), "dur_ms": ms,
+                          "phases_ms": {"multiply_stacks": ms}})
+    report = rca.on_changepoint({
+        "series": "multiply_latency_ms", "labels": {}, "t": time.time(),
+        "t_shift": t_mid, "direction": "up", "baseline": 1.0,
+        "level": 8.0, "magnitude": 7.0, "sigma": 0.05,
+        "regression": True, "n": 10,
+    })
+    d = report["profile_diff"]
+    assert d and d["ok"]
+    assert d["top"]["phase"] == "multiply_stacks"
+    assert d["top"]["mean_ms_b"] > d["top"]["mean_ms_a"]
+
+
+# ----------------------------------------------------------- profiler
+
+def _rec(driver="host", phase="multiply_stacks", ms=1.0, occ=0.5):
+    return {"drivers": {driver: {"entries": 4}}, "mnk": (16, 16, 16),
+            "dur_ms": 2 * ms, "occ_c": occ, "phases_ms": {phase: ms}}
+
+
+def test_profiler_folds_seals_and_totals(monkeypatch):
+    monkeypatch.setenv("DBCSR_TPU_PROFILE_EPOCH_N", "3")
+    profiler.reset()
+    for _ in range(3):
+        profiler.observe(_rec(ms=1.0))
+    eps = profiler.epochs()
+    assert len(eps) == 1 and eps[0]["n"] == 3
+    assert eps[0]["epoch"] == 1
+    assert isinstance(eps[0]["generation"], int)
+    row = eps[0]["cells"]["host|16x16x16|multiply_stacks"]
+    assert row[0] == 3 and row[1] == pytest.approx(3.0)
+    assert eps[0]["occ"]["host|16x16x16"] == [3, pytest.approx(1.5)]
+    # monotonic totals span epochs and track dur_ms, not phase ms
+    assert profiler.totals() == {"n": 3, "ms": pytest.approx(6.0)}
+    profiler.observe(_rec(ms=1.0))
+    assert profiler.totals()["n"] == 4
+    # disabled: BOTH halves of the counter pair freeze together
+    profiler.set_enabled(False)
+    profiler.observe(_rec(ms=1.0))
+    assert profiler.totals() == {"n": 4, "ms": pytest.approx(8.0)}
+
+
+def test_profiler_diff_localizes_phase_and_marks_new_cells(monkeypatch):
+    monkeypatch.setenv("DBCSR_TPU_PROFILE_EPOCH_N", "8")
+    profiler.reset()
+    for _ in range(2):
+        profiler.observe(_rec(ms=1.0))
+    a = profiler.seal()
+    for _ in range(2):
+        profiler.observe(_rec(ms=4.0))
+    profiler.observe(_rec(driver="dense", phase="dense_dot", ms=2.0))
+    b = profiler.seal()
+    d = profiler.diff(a["epoch"], b["epoch"], top=8)
+    assert d["ok"]
+    assert d["top"]["phase"] == "multiply_stacks"
+    assert d["top"]["ratio"] == pytest.approx(4.0)
+    new = [r for r in d["phases"] if r["phase"] == "dense_dot"][0]
+    assert new["count_a"] == 0 and new["ratio"] is None
+    assert d["by_phase"]["multiply_stacks"] == pytest.approx(3.0)
+
+
+def test_profiler_diff_around_splits_epochs_at_shift_time(monkeypatch):
+    monkeypatch.setenv("DBCSR_TPU_PROFILE_EPOCH_N", "2")
+    profiler.reset()
+    for _ in range(2):
+        profiler.observe(_rec(ms=1.0))
+    time.sleep(0.01)
+    t_shift = time.time()
+    time.sleep(0.01)
+    for _ in range(3):                     # one sealed + one live
+        profiler.observe(_rec(ms=6.0))
+    d = profiler.diff_around(t_shift)
+    assert d["ok"]
+    assert d["a"]["n"] == 2 and d["b"]["n"] == 3   # live fold counted
+    assert d["top"]["phase"] == "multiply_stacks"
+    assert d["top"]["mean_ms_a"] == pytest.approx(1.0)
+    assert d["top"]["mean_ms_b"] == pytest.approx(6.0)
+
+
+def test_profiler_merge_sums_histograms():
+    a = {"n": 2, "t0": 1.0, "t1": 2.0, "generation": 1,
+         "cells": {"host|16x16x16|multiply_stacks": [2, 2.0, 1.0] + [0] * 18},
+         "occ": {"host|16x16x16": [2, 1.0]}}
+    b = {"n": 1, "t0": 3.0, "t1": 4.0, "generation": 2,
+         "cells": {"host|16x16x16|multiply_stacks": [1, 4.0, 4.0] + [0] * 18},
+         "occ": {}}
+    m = profiler.merge([a, b, None, {"n": 0}])
+    assert m["n"] == 3 and m["generation"] == 2
+    assert m["t0"] == 1.0 and m["t1"] == 4.0
+    row = m["cells"]["host|16x16x16|multiply_stacks"]
+    assert row[0] == 3 and row[1] == pytest.approx(6.0) and row[2] == 4.0
+
+
+# ----------------------------------------------- flight recorder edges
+
+def test_flight_event_list_drops_oldest_and_keeps_true_count():
+    flight.begin(op="multiply", name="M", mnk=(4, 4, 4))
+    for i in range(70):
+        flight.note_event("fault", i=i)
+    rec = flight.commit()
+    assert rec["events_total"] == 70
+    assert len(rec["events"]) == flight._MAX_EVENTS_PER_RECORD == 64
+    # oldest dropped, newest (nearest the crash) kept
+    assert rec["events"][0]["i"] == 6
+    assert rec["events"][-1]["i"] == 69
+    assert rec["events_total"] > len(rec["events"])   # truncation visible
+
+
+def test_flight_nested_records_and_snapshot_determinism():
+    flight.begin(op="multiply", name="outer", mnk=(8, 8, 8))
+    flight.note_event("outer_ev")
+    flight.begin(op="multiply", name="inner", mnk=(4, 4, 4))
+    flight.note_event("inner_ev")
+    inner = flight.commit()
+    outer = flight.commit()
+    assert inner["name"] == "inner" and outer["name"] == "outer"
+    # nested events never leak across the record stack
+    assert [e["event"] for e in inner["events"]] == ["inner_ev"]
+    assert [e["event"] for e in outer["events"]] == ["outer_ev"]
+    recs = flight.records()
+    assert [r["name"] for r in recs] == ["inner", "outer"]
+    # seq stamps begin order; the ring holds commit order, so the
+    # nested record (begun later, committed first) carries the later seq
+    assert outer["seq"] < inner["seq"]
+    # reads are pure snapshots: identical and JSON-stable
+    assert flight.to_json() == flight.to_json()
+    assert flight.records() == recs
+
+
+# -------------------------------------- rolling-window / health edges
+
+def test_window_first_sample_and_eviction_exactness():
+    w = windows.Window(4)
+    assert len(w) == 0 and w.mean() == 0.0 and w.sum == 0.0
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0, 6.0):
+        w.append(v)
+    assert len(w) == 4
+    assert w.sum == pytest.approx(3 + 4 + 5 + 6)   # evicted exactly
+    assert w.mean() == pytest.approx(4.5)
+    w.clear()
+    assert len(w) == 0 and w.sum == 0.0
+
+
+def test_quantile_conventions_pinned():
+    assert windows.median([1.0, 2.0]) == 1.5       # interpolated
+    assert windows.mad([1.0, 1.0, 10.0]) == 0.0    # median of |x - med|
+    assert windows.rank_quantile([], 0.5) == 0.0
+    assert windows.rank_quantile([7.0], 1.0) == 7.0    # clamped to n-1
+    assert windows.p50_p95([3.0, 1.0, 2.0]) == (2.0, 3.0)  # upper median
+
+
+def test_health_latency_detector_warmup_and_rearm():
+    """No fire before _MIN_SAMPLES (first-sample warmup); one count
+    per rising edge while the spike persists; a recovery re-arms the
+    detector for the next spike."""
+    for _ in range(health._MIN_SAMPLES):
+        health.observe_multiply(dur_ms=1.0)
+    assert "dispatch_latency_spike" not in health.active_anomalies()
+    health.observe_multiply(dur_ms=100.0)
+    assert "dispatch_latency_spike" in health.active_anomalies()
+    counts = _counter_values("dbcsr_tpu_anomalies_total")
+    assert counts[(("kind", "dispatch_latency_spike"),)] == 1
+    health.observe_multiply(dur_ms=101.0)          # still raised: no re-count
+    counts = _counter_values("dbcsr_tpu_anomalies_total")
+    assert counts[(("kind", "dispatch_latency_spike"),)] == 1
+    health.observe_multiply(dur_ms=1.0)            # recovery re-arms
+    assert "dispatch_latency_spike" not in health.active_anomalies()
+    health.observe_multiply(dur_ms=100.0)
+    counts = _counter_values("dbcsr_tpu_anomalies_total")
+    assert counts[(("kind", "dispatch_latency_spike"),)] == 2
+
+
+# ------------------------------------------------ trace summary tables
+
+def test_trace_summary_annotations_and_resilience(tmp_path, capsys):
+    p = tmp_path / "t.p0.jsonl"
+    lines = [
+        {"ev": "span", "name": "multiply_dense", "dur_us": 2000,
+         "attrs": {"format": "dense", "format_reason": "forced"}},
+        {"ev": "span", "name": "multiply_stacks", "dur_us": 1000,
+         "attrs": {"format": "stack", "precision": "bfloat16+comp"}},
+        {"ev": "span", "name": "multiply_stacks", "dur_us": 500},
+        {"ev": "instant", "name": "driver_failover",
+         "args": {"driver": "xla"}},
+        {"ev": "instant", "name": "breaker_transition", "args": {}},
+        {"ev": "instant", "name": "driver_failover", "args": {}},
+    ]
+    p.write_text("\n".join(json.dumps(ln) for ln in lines) + "\n")
+    s = trace_summary.summarize(str(p))
+    assert s["annotations"]["format"]["dense"] == {
+        "spans": 1, "total_ms": 2.0}
+    assert s["annotations"]["precision"]["bfloat16+comp"]["spans"] == 1
+    assert s["resilience"] == {"driver_failover": 2,
+                               "breaker_transition": 1}
+    # multi-shard aggregation merges, not clobbers
+    many = trace_summary.summarize_many([str(p), str(p)])
+    assert many["annotations"]["format"]["dense"]["spans"] == 2
+    assert many["resilience"]["driver_failover"] == 4
+    trace_summary.print_summary(s)
+    out = capsys.readouterr().out
+    assert "SPAN ANNOTATION" in out and "format=dense" in out
+    assert "RESILIENCE INSTANT" in out and "driver_failover" in out
+
+
+# --------------------------------------------- doctor --diagnose schema
+
+def test_diag_schema_literal_mirrors_obs_schema_version():
+    from dbcsr_tpu import obs
+
+    assert doctor._DIAG_SCHEMA == obs.OBS_SCHEMA_VERSION == 7
+
+
+def test_doctor_diagnose_report_schema_from_committed_cert():
+    report = doctor.diagnose_from_cert(os.path.join(_REPO, "RCA_CERT.json"))
+    assert report is not None
+    assert set(report) == {"schema", "source", "reports",
+                           "changepoints", "ledger"}
+    assert report["schema"] == doctor._DIAG_SCHEMA
+    assert report["reports"], "committed cert must carry causal reports"
+    for r in report["reports"]:
+        assert {"changepoint", "causes", "top_cause",
+                "profile_diff"} <= set(r)
+        cp = r["changepoint"]
+        assert {"series", "direction", "baseline", "level",
+                "magnitude", "t_shift"} <= set(cp)
+        for i, c in enumerate(r["causes"]):
+            assert c["rank"] == i + 1 and "score" in c and "kind" in c
+    lines = []
+    doctor.render_diagnose(report, out=lines.append)
+    text = "\n".join(lines)
+    assert "change-point:" in text and "sigma" in text
+
+
+def test_doctor_diagnose_cli_json():
+    res = subprocess.run(
+        [sys.executable, "tools/doctor.py", "--diagnose", "--json"],
+        cwd=_REPO, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    doc = json.loads(res.stdout)
+    assert doc["schema"] == 7 and doc["reports"]
+
+
+# ------------------------------------------- lint-checked registries
+
+def test_lint_registries_match_runtime_and_fire_on_drift():
+    from tools.lint import engine
+    from tools.lint import rules_diag
+
+    findings, repo = engine.run_analysis()
+    assert [f for f in findings if f.rule.startswith("diag-")] == []
+    # the AST view of both registries equals the runtime view
+    assert rules_diag._ledger_kinds(repo) == rca.LEDGER_KINDS
+    assert rules_diag._series(repo) == changepoint.SERIES
+    # drift detection: an undocumented kind/series is a finding
+    repo._diag_doc_text = ""
+    kinds = {f.rule for f in rules_diag._check_ledger_registry(repo)}
+    series = {f.rule for f in rules_diag._check_series_registry(repo)}
+    assert "diag-ledger-docs" in kinds
+    assert "diag-series-docs" in series
+    # every registered kind has a publish site outside the registry
+    emitted = rules_diag._emitted_strings(repo)
+    assert all(k in emitted for k in rca.LEDGER_KINDS)

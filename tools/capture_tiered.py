@@ -129,7 +129,7 @@ def probe(timeout_s: int = 120) -> bool:
 # an import: importing dbcsr_tpu.obs in THIS process would env-activate
 # a trace session when DBCSR_TPU_TRACE is set (obs/tracer.py), and the
 # loop driver must never open shards meant for its bench subprocesses
-_OBS_SCHEMA_VERSION = 6
+_OBS_SCHEMA_VERSION = 7
 
 
 def _append(path: str, obj: dict) -> None:

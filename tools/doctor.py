@@ -27,6 +27,16 @@ committed ``TELEMETRY_ROLLUP.jsonl`` works too):
     python tools/doctor.py --port 9100 --trend
     python tools/doctor.py --trend --timeseries TELEMETRY_ROLLUP.jsonl
 
+Diagnose mode (``--diagnose``): the causal diagnosis plane's ranked
+root-cause reports — change-point, ranked candidate causes off the
+change ledger, and the profile-baseline diff that localizes the
+regressed phase.  Reads a live endpoint's ``/rca`` route, an incident
+bundle's ``rca`` record (``--bundle``), or the committed
+``RCA_CERT.json`` (``--rca-cert``):
+
+    python tools/doctor.py --port 9100 --diagnose
+    python tools/doctor.py --diagnose --rca-cert RCA_CERT.json
+
 With no arguments the doctor looks for the default artifact names in
 the current directory.  ``--json`` emits the report machine-readable;
 ``--selftest`` runs the full pipeline offline against synthetic events
@@ -252,7 +262,8 @@ def expand_shards(base: str) -> list:
 
 
 def fetch_live(url: str, timeout: float = 10.0) -> dict:
-    """Pull /healthz /metrics /events /flight off a live endpoint."""
+    """Pull /healthz /metrics /events /flight (+ /usage /rca when the
+    endpoint is new enough) off a live endpoint."""
     import urllib.error
     import urllib.request
 
@@ -270,11 +281,18 @@ def fetch_live(url: str, timeout: float = 10.0) -> dict:
         "events": json.loads(get("/events")),
         "flight": json.loads(get("/flight")),
         "usage": None,
+        "rca": None,
     }
     try:  # pre-v5 endpoints have no /usage route
         usage = json.loads(get("/usage"))
         if isinstance(usage, dict) and "tenants" in usage:
             live["usage"] = usage
+    except ValueError:
+        pass
+    try:  # pre-v7 endpoints have no /rca route
+        rca = json.loads(get("/rca?limit=8"))
+        if isinstance(rca, dict) and "reports" in rca:
+            live["rca"] = rca
     except ValueError:
         pass
     return live
@@ -284,12 +302,12 @@ def read_bundle(path: str) -> dict:
     """Parse an incident bundle (`dbcsr_tpu.obs.incidents`, typed JSONL
     with a ``rec`` discriminator) back into analyze()'s inputs."""
     out: dict = {"meta": {}, "health": None, "sample": None,
-                 "usage": None, "events": [], "flight": []}
+                 "usage": None, "rca": None, "events": [], "flight": []}
     for rec in _read_jsonl(path):
         kind = rec.get("rec")
         if kind == "meta":
             out["meta"] = rec
-        elif kind in ("health", "sample", "usage"):
+        elif kind in ("health", "sample", "usage", "rca"):
             out[kind] = rec.get(kind)
         elif kind == "event":
             out["events"].append(rec)
@@ -1157,6 +1175,118 @@ def render_trend(report: dict, out=print) -> None:
         out(" slo burn summary: (no slo series found)")
 
 
+# ---------------------------------------------------------- diagnose
+
+# Mirror of dbcsr_tpu.obs.OBS_SCHEMA_VERSION — a literal on purpose:
+# the doctor must diagnose artifacts copied off another machine with
+# no dbcsr_tpu import.  Bump together with the obs package.
+_DIAG_SCHEMA = 7
+
+
+def fetch_diagnose_live(url: str, timeout: float = 10.0) -> dict:
+    """Pull the ``/rca`` route off a live endpoint into the
+    ``--diagnose`` report shape."""
+    import urllib.request
+
+    with urllib.request.urlopen(url.rstrip("/") + "/rca?limit=8",
+                                timeout=timeout) as r:
+        doc = json.loads(r.read().decode())
+    return {"schema": doc.get("schema", _DIAG_SCHEMA), "source": url,
+            "reports": doc.get("reports") or [],
+            "changepoints": doc.get("changepoints") or [],
+            "ledger": doc.get("ledger") or []}
+
+
+def diagnose_from_bundle(bundle: dict, path: str) -> dict:
+    """An incident bundle's ``rca`` record (the freshest causal report
+    at capture time) re-shaped into the ``--diagnose`` report."""
+    rep = bundle.get("rca")
+    meta = bundle.get("meta") or {}
+    return {"schema": meta.get("schema", _DIAG_SCHEMA), "source": path,
+            "reports": [rep] if rep else [],
+            "changepoints": [rep["changepoint"]]
+            if rep and rep.get("changepoint") else [],
+            "ledger": []}
+
+
+def diagnose_from_cert(path: str) -> dict | None:
+    """The committed RCA_CERT.json (tools/rca_bench.py) re-shaped into
+    the ``--diagnose`` report: each injection's full causal report."""
+    try:
+        with open(path) as fh:
+            cert = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    reports = [inj["report"] for inj in cert.get("injections") or []
+               if inj.get("report")]
+    if not reports:
+        return None
+    return {"schema": cert.get("schema", _DIAG_SCHEMA), "source": path,
+            "reports": reports,
+            "changepoints": [r["changepoint"] for r in reports
+                             if r.get("changepoint")],
+            "ledger": []}
+
+
+def _cause_detail(ent: dict) -> str:
+    """One-line identity for a ranked cause: the payload fields that
+    name WHAT changed (row identity, knob name, generation), minus the
+    bookkeeping the table already shows."""
+    skip = {"kind", "event", "t", "rank", "score", "seq", "pid"}
+    parts = [f"{k}={v}" for k, v in sorted(ent.items())
+             if k not in skip and v is not None]
+    return " ".join(parts) or "-"
+
+
+def render_diagnose(report: dict, out=print) -> None:
+    out(f" dbcsr_tpu doctor --diagnose  (source: {report['source']}, "
+        f"schema v{report.get('schema', '?')})")
+    reports = report.get("reports") or []
+    if not reports:
+        out(" no causal reports: no regression change-point has fired"
+            " (steady state, or the diagnosis plane is disabled)")
+        return
+    out(f" {len(reports)} causal report(s), newest first:")
+    for rep in reversed(reports):
+        cp = rep.get("changepoint") or {}
+        sig = cp.get("sigma") or 0.0
+        z = abs(cp.get("magnitude", 0.0)) / sig if sig else 0.0
+        out(f"   change-point: {cp.get('series', '?')} "
+            f"{cp.get('direction', '?')} "
+            f"{cp.get('baseline', 0):.4g} -> {cp.get('level', 0):.4g} "
+            f"(shift {cp.get('magnitude', 0):+.4g} = {z:.0f} sigma) "
+            f"at t={cp.get('t_shift')}")
+        causes = rep.get("causes") or []
+        if causes:
+            out("   ranked causes:")
+            for ent in causes:
+                out(f"     {ent.get('rank', '?')}. "
+                    f"{ent.get('kind', '?'):<24} "
+                    f"score={ent.get('score', 0):<9.3g} "
+                    f"{_cause_detail(ent)}")
+        else:
+            out("   ranked causes: (change ledger empty in window)")
+        diff = rep.get("profile_diff") or {}
+        rows = (diff.get("phases") or []) if diff.get("ok") else []
+        if rows:
+            out("   profile diff (top phase deltas, baseline -> after):")
+            for row in rows[:5]:
+                ratio = row.get("ratio")
+                # a phase absent on one side has no ratio: the driver
+                # swap itself (new phase appears, old disappears)
+                xr = f"x{ratio:.2f}" if isinstance(ratio, (int, float)) \
+                    else "new" if not row.get("count_a") else "gone"
+                key = f"{row['driver']}|{row['cell']}|{row['phase']}"
+                out(f"     {key:<44} "
+                    f"{row['mean_ms_a'] or 0:.4g}ms -> "
+                    f"{row['mean_ms_b'] or 0:.4g}ms "
+                    f"({xr}, n={row['count_a']}->{row['count_b']})")
+        elif diff:
+            out(f"   profile diff: unavailable "
+                f"({diff.get('reason', 'no epochs straddle the shift')})")
+        out("")
+
+
 # ----------------------------------------------------------- selftest
 
 def _selftest(repo_root: str) -> int:
@@ -1436,6 +1566,15 @@ def main(argv=None) -> int:
                     help="telemetry time-series shard base or file "
                          "(--trend artifact mode; the committed "
                          "TELEMETRY_ROLLUP.jsonl works too)")
+    ap.add_argument("--rca-cert", default="RCA_CERT.json",
+                    help="committed causal-diagnosis certificate "
+                         "(tools/rca_bench.py) for --diagnose in "
+                         "artifact mode")
+    ap.add_argument("--diagnose", action="store_true",
+                    help="ranked root-cause reports: change-point + "
+                         "candidate causes + profile diff, from /rca "
+                         "(live), an incident bundle's rca record "
+                         "(--bundle), or --rca-cert")
     ap.add_argument("--trend", action="store_true",
                     help="sparkline history tables per telemetry cell "
                          "+ SLO burn summary, from /timeseries + /slo "
@@ -1452,6 +1591,36 @@ def main(argv=None) -> int:
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if args.selftest:
         return _selftest(repo_root)
+
+    if args.diagnose:
+        if args.url or args.port:
+            url = args.url or f"http://127.0.0.1:{args.port}"
+            try:
+                report = fetch_diagnose_live(url)
+            except Exception as exc:
+                print(f"doctor: cannot reach {url}: "
+                      f"{type(exc).__name__}: {exc}", file=sys.stderr)
+                return 2
+        elif args.bundle:
+            bundle = read_bundle(args.bundle)
+            if not bundle["meta"] and bundle["rca"] is None:
+                print(f"doctor: no bundle records in {args.bundle!r}",
+                      file=sys.stderr)
+                return 2
+            report = diagnose_from_bundle(bundle, args.bundle)
+        else:
+            maybe = diagnose_from_cert(args.rca_cert)
+            if maybe is None:
+                print(f"doctor: no causal reports at {args.rca_cert!r} "
+                      f"(run tools/rca_bench.py, or point --url/--port "
+                      f"at a live endpoint)", file=sys.stderr)
+                return 2
+            report = maybe
+        if args.as_json:
+            print(json.dumps(report, default=str))
+        else:
+            render_diagnose(report)
+        return 0
 
     if args.trend:
         if args.url or args.port:

@@ -216,6 +216,7 @@ def fleet_report(peers: list) -> dict:
     delegates here)."""
     healths = fetch_all(peers, "/healthz")
     slos = fetch_all(peers, "/slo")
+    rcas = fetch_all(peers, "/rca?limit=1")
     procs: dict = {}
     for proc, url in peers:
         ent: dict = {"endpoint": url, "up": False}
@@ -236,6 +237,24 @@ def fleet_report(peers: list) -> dict:
                     n: {"status": r.get("status"), "burn": r.get("burn")}
                     for n, r in (json.loads(slo_body)
                                  .get("objectives") or {}).items()}
+            except ValueError:
+                pass
+        rca_body = rcas.get(proc)
+        if rca_body:
+            # causal diagnosis rollup (pre-v7 peers have no /rca —
+            # their fleet row simply carries no rca block)
+            try:
+                r = json.loads(rca_body)
+                reports = r.get("reports") or []
+                last = reports[-1] if reports else None
+                ent["rca"] = {
+                    "schema": r.get("schema"),
+                    "changepoints": len(r.get("changepoints") or ()),
+                    "reports": len(reports),
+                    "top_cause": (last or {}).get("top_cause"),
+                    "series": ((last or {}).get("changepoint")
+                               or {}).get("series"),
+                }
             except ValueError:
                 pass
         procs[str(proc)] = ent

@@ -203,11 +203,12 @@ def changed_paths(root: str) -> list:
 # ------------------------------------------------------------ running
 
 def _all_rules():
-    from tools.lint import (rules_conformance, rules_donation, rules_hotpath,
-                            rules_knobs, rules_locks, rules_mutation)
+    from tools.lint import (rules_conformance, rules_diag, rules_donation,
+                            rules_hotpath, rules_knobs, rules_locks,
+                            rules_mutation)
 
     mods = (rules_mutation, rules_donation, rules_locks, rules_knobs,
-            rules_conformance, rules_hotpath)
+            rules_conformance, rules_hotpath, rules_diag)
     file_rules, repo_rules = [], []
     for m in mods:
         file_rules.extend(getattr(m, "FILE_RULES", ()))

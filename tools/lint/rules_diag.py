@@ -1,0 +1,265 @@
+"""Checked registries for the causal diagnosis plane.
+
+The diagnosis plane reasons over two name spaces that MUST stay in
+sync with the rest of the tree or attribution silently degrades:
+
+* ``LEDGER_KINDS`` (dbcsr_tpu/obs/rca.py) — the change-event kinds
+  the ledger admits.  A registered kind with no emission site is dead
+  weight in the ranking prior; an undocumented kind makes
+  ``doctor --diagnose`` output unexplainable.
+* ``SERIES`` (dbcsr_tpu/obs/changepoint.py) — the derived series the
+  CUSUM detectors scan.  Every series (and the metric families it is
+  derived from) must be documented in docs/observability.md, and each
+  entry must be structurally complete for its ``form``.
+
+Both registries are pure literals by design; this module loads them
+by AST (`registry._module_dict`) so the checks work even when the
+package itself cannot import.  Drift fails tier-1 via
+tests/test_lint.py, like every other lint rule.
+
+Rules:
+
+* ``diag-ledger-site``   — registered kind never emitted anywhere.
+* ``diag-ledger-docs``   — registered kind missing from
+  docs/observability.md.
+* ``diag-ledger-shape``  — registry entry malformed (weight/doc).
+* ``diag-series-docs``   — series name or a metric it derives from
+  missing from docs/observability.md.
+* ``diag-series-shape``  — series entry malformed for its form.
+* ``diag-unregistered-kind`` — `rca.record("<kind>")` call with a
+  kind the ledger will drop on the floor.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.lint import registry
+from tools.lint.engine import Finding
+
+RCA_MODULE = "dbcsr_tpu/obs/rca.py"
+CHANGEPOINT_MODULE = "dbcsr_tpu/obs/changepoint.py"
+DIAG_DOC = "docs/observability.md"
+
+_SERIES_FORMS = {
+    # form -> keys required beyond the common ones
+    "gauge": ("metric",),
+    "ratio": ("num", "den", "scale"),
+}
+_SERIES_COMMON = ("form", "regress", "doc")
+
+
+def _ledger_kinds(repo) -> dict:
+    cached = getattr(repo, "_diag_ledger_kinds", None)
+    if cached is None:
+        cached = registry._module_dict(repo.root, RCA_MODULE,
+                                       "LEDGER_KINDS")
+        repo._diag_ledger_kinds = cached
+    return cached
+
+
+def _series(repo) -> dict:
+    cached = getattr(repo, "_diag_series", None)
+    if cached is None:
+        cached = registry._module_dict(repo.root, CHANGEPOINT_MODULE,
+                                       "SERIES")
+        repo._diag_series = cached
+    return cached
+
+
+def _diag_doc_text(repo) -> str:
+    cached = getattr(repo, "_diag_doc_text", None)
+    if cached is None:
+        cached = repo.read(DIAG_DOC)
+        repo._diag_doc_text = cached
+    return cached
+
+
+def _registry_span(ctx, name: str) -> tuple:
+    """(lineno, end_lineno) of the module-level ``name = {...}``
+    assignment, so its own keys don't count as emission sites."""
+    for node in ctx.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name):
+            return (node.lineno, node.end_lineno or node.lineno)
+    return (0, 0)
+
+
+def _emitted_strings(repo) -> set:
+    """Every string constant in the scanned tree, minus the
+    LEDGER_KINDS literal itself.  Kind emissions go through wrapper
+    shapes (`events.publish`, `self._publish`, `store._observe`,
+    `rca.record`), so matching one call form would under-collect; a
+    registered kind that appears nowhere as a literal is certainly
+    never emitted."""
+    cached = getattr(repo, "_diag_emitted_strings", None)
+    if cached is not None:
+        return cached
+    out: set = set()
+    for ctx in repo.files:
+        if not ctx.path.startswith("dbcsr_tpu/"):
+            continue
+        skip = (_registry_span(ctx, "LEDGER_KINDS")
+                if ctx.path == RCA_MODULE else (0, 0))
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            if skip[0] <= getattr(node, "lineno", 0) <= skip[1]:
+                continue
+            out.add(node.value)
+    repo._diag_emitted_strings = out
+    return out
+
+
+def _registry_key_lines(repo, relpath: str, name: str) -> dict:
+    """key -> lineno inside the registry literal, for anchored
+    findings."""
+    for ctx in repo.files:
+        if ctx.path != relpath:
+            continue
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == name
+                    and isinstance(node.value, ast.Dict)):
+                return {k.value: k.lineno for k in node.value.keys
+                        if isinstance(k, ast.Constant)}
+    return {}
+
+
+def _check_ledger_registry(repo):
+    if not os.path.exists(os.path.join(repo.root, RCA_MODULE)):
+        return []
+    try:
+        kinds = _ledger_kinds(repo)
+    except (OSError, KeyError, ValueError) as exc:
+        return [Finding(rule="diag-ledger-shape", path=RCA_MODULE,
+                        line=1, message=f"LEDGER_KINDS unloadable: {exc}")]
+    doc = _diag_doc_text(repo)
+    emitted = _emitted_strings(repo)
+    lines = _registry_key_lines(repo, RCA_MODULE, "LEDGER_KINDS")
+    out = []
+    for kind, spec in kinds.items():
+        line = lines.get(kind, 1)
+        if not (isinstance(spec, dict)
+                and isinstance(spec.get("weight"), (int, float))
+                and spec.get("weight", 0) > 0
+                and isinstance(spec.get("doc"), str) and spec["doc"]):
+            out.append(Finding(
+                rule="diag-ledger-shape", path=RCA_MODULE, line=line,
+                message=f"LEDGER_KINDS[{kind!r}] needs a positive "
+                        "numeric `weight` and a non-empty `doc`"))
+            continue
+        if kind not in emitted:
+            out.append(Finding(
+                rule="diag-ledger-site", path=RCA_MODULE, line=line,
+                message=f"ledger kind {kind!r} is registered but never "
+                        "emitted (no publish site in dbcsr_tpu/)"))
+        if kind not in doc:
+            out.append(Finding(
+                rule="diag-ledger-docs", path=RCA_MODULE, line=line,
+                message=f"ledger kind {kind!r} is not documented in "
+                        f"{DIAG_DOC}"))
+    return out
+
+
+def _check_series_registry(repo):
+    if not os.path.exists(os.path.join(repo.root, CHANGEPOINT_MODULE)):
+        return []
+    try:
+        series = _series(repo)
+    except (OSError, KeyError, ValueError) as exc:
+        return [Finding(rule="diag-series-shape", path=CHANGEPOINT_MODULE,
+                        line=1, message=f"SERIES unloadable: {exc}")]
+    doc = _diag_doc_text(repo)
+    lines = _registry_key_lines(repo, CHANGEPOINT_MODULE, "SERIES")
+    out = []
+    for name, spec in series.items():
+        line = lines.get(name, 1)
+        form = spec.get("form") if isinstance(spec, dict) else None
+        required = _SERIES_FORMS.get(form)
+        if (required is None
+                or any(k not in spec for k in _SERIES_COMMON)
+                or any(k not in spec for k in required)
+                or spec.get("regress") not in ("up", "down")):
+            out.append(Finding(
+                rule="diag-series-shape", path=CHANGEPOINT_MODULE,
+                line=line,
+                message=f"SERIES[{name!r}] must have form in "
+                        f"{sorted(_SERIES_FORMS)}, regress up|down, a "
+                        "doc string, and the form's metric keys"))
+            continue
+        if name not in doc:
+            out.append(Finding(
+                rule="diag-series-docs", path=CHANGEPOINT_MODULE,
+                line=line,
+                message=f"change-point series {name!r} is not "
+                        f"documented in {DIAG_DOC}"))
+        for key in ("metric", "num", "den"):
+            metric = spec.get(key)
+            if isinstance(metric, str) and metric not in doc:
+                out.append(Finding(
+                    rule="diag-series-docs", path=CHANGEPOINT_MODULE,
+                    line=line,
+                    message=f"series {name!r} derives from {metric} "
+                            f"which is not documented in {DIAG_DOC}"))
+    return out
+
+
+def _check_record_kinds(ctx, repo):
+    """`rca.record("<kind>")` with an unregistered kind publishes a
+    bus event the ledger's `_on_event` drops — the caller believes
+    the change is attributable when it is not."""
+    if not ctx.path.startswith("dbcsr_tpu/"):
+        return []
+    try:
+        kinds = _ledger_kinds(repo)
+    except (OSError, KeyError, ValueError):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("rca", "_rca")
+                and node.args):
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue
+        if arg.value in kinds:
+            continue
+        f = ctx.finding(
+            "diag-unregistered-kind", node,
+            f"rca.record({arg.value!r}): kind is not in LEDGER_KINDS "
+            "— the ledger will drop it (register it in "
+            f"{RCA_MODULE} and document it in {DIAG_DOC})")
+        if f:
+            out.append(f)
+    # rca.py's own module-internal `record("knob_change", ...)` call
+    if ctx.path == RCA_MODULE:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "record" and node.args):
+                continue
+            arg = node.args[0]
+            if (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value not in kinds):
+                f = ctx.finding(
+                    "diag-unregistered-kind", node,
+                    f"record({arg.value!r}): kind is not in "
+                    "LEDGER_KINDS — the ledger will drop it")
+                if f:
+                    out.append(f)
+    return out
+
+
+FILE_RULES = [_check_record_kinds]
+REPO_RULES = [_check_ledger_registry, _check_series_registry]

@@ -7,6 +7,12 @@ Reads the event stream a traced run left behind
 * **per-phase totals** — every span name with call count, total /
   mean / max milliseconds, sorted by total (the table a bench capture
   can embed next to its GFLOP/s line);
+* **span annotations** — the decision attributes spans carry (storage
+  ``format`` + reason, executed ``precision``, chosen ``algorithm``,
+  ``cannon_mode``), each value with its span count and total ms, so a
+  trace shows WHAT the engine decided next to what it cost; plus a
+  resilience-instant rollup (driver failures/failovers, breaker
+  transitions, precision schedule changes);
 * **top recompile offenders** — jitted hot functions ranked by how
   many distinct XLA specializations they triggered during the run
   (``jit_compile`` instants, emitted by `obs.metrics.record_jit`);
@@ -60,12 +66,27 @@ def expand_paths(args: list) -> list:
     return [p for p in paths if not (p in seen or seen.add(p))]
 
 
+# span attrs surfaced in the annotation table: the engine's per-product
+# DECISIONS (format_planner / smm dispatch / precision / cannon), not
+# identity fields like name/m/n/k
+_ANNOTATION_KEYS = ("format", "format_reason", "precision", "algorithm",
+                    "cannon_mode")
+
+# resilience instants rolled up next to the annotations: what went
+# wrong (or got rerouted) during the trace
+_RESILIENCE_INSTANTS = ("driver_failure", "driver_failover",
+                        "breaker_transition", "precision_schedule",
+                        "precision_promote")
+
+
 def summarize(path: str) -> dict:
     """Aggregate one trace JSONL into the summary dict."""
     phases: dict = {}
     compiles: dict = {}
     stacks: dict = {}
     comm: dict = {}
+    annotations: dict = {}
+    resilience: dict = {}
     events = 0
     bad_lines = 0
     with open(path) as f:
@@ -87,6 +108,14 @@ def summarize(path: str) -> dict:
                 p["calls"] += 1
                 p["total_ms"] += dur_ms
                 p["max_ms"] = max(p["max_ms"], dur_ms)
+                attrs = rec.get("attrs") or {}
+                for key in _ANNOTATION_KEYS:
+                    if key not in attrs:
+                        continue
+                    a = annotations.setdefault(key, {}).setdefault(
+                        str(attrs[key]), {"spans": 0, "total_ms": 0.0})
+                    a["spans"] += 1
+                    a["total_ms"] += dur_ms
             elif ev == "instant":
                 name = rec.get("name", "")
                 args = rec.get("args") or {}
@@ -103,15 +132,22 @@ def summarize(path: str) -> dict:
                     c = comm.setdefault(kind, {"messages": 0, "bytes": 0})
                     c["messages"] += args.get("messages", 0)
                     c["bytes"] += args.get("bytes", 0)
+                elif name in _RESILIENCE_INSTANTS:
+                    resilience[name] = resilience.get(name, 0) + 1
     for p in phases.values():
         p["total_ms"] = round(p["total_ms"], 3)
         p["max_ms"] = round(p["max_ms"], 3)
         p["mean_ms"] = round(p["total_ms"] / max(p["calls"], 1), 3)
+    for by_value in annotations.values():
+        for a in by_value.values():
+            a["total_ms"] = round(a["total_ms"], 3)
     return {
         "path": path,
         "events": events,
         "bad_lines": bad_lines,
         "phases": phases,
+        "annotations": annotations,
+        "resilience": resilience,
         "jit_compiles": compiles,
         "stacks_by_driver": stacks,
         "comm": comm,
@@ -131,6 +167,8 @@ def summarize_many(paths: list) -> dict:
         "events": 0,
         "bad_lines": 0,
         "phases": {},
+        "annotations": {},
+        "resilience": {},
         "jit_compiles": {},
         "stacks_by_driver": {},
         "comm": {},
@@ -146,6 +184,14 @@ def summarize_many(paths: list) -> dict:
             ap["calls"] += p["calls"]
             ap["total_ms"] = round(ap["total_ms"] + p["total_ms"], 3)
             ap["max_ms"] = max(ap["max_ms"], p["max_ms"])
+        for key, by_value in s.get("annotations", {}).items():
+            for value, a in by_value.items():
+                aa = agg["annotations"].setdefault(key, {}).setdefault(
+                    value, {"spans": 0, "total_ms": 0.0})
+                aa["spans"] += a["spans"]
+                aa["total_ms"] = round(aa["total_ms"] + a["total_ms"], 3)
+        for name, n in s.get("resilience", {}).items():
+            agg["resilience"][name] = agg["resilience"].get(name, 0) + n
         for fn, n in s["jit_compiles"].items():
             agg["jit_compiles"][fn] = agg["jit_compiles"].get(fn, 0) + n
         for d, v in s["stacks_by_driver"].items():
@@ -188,6 +234,23 @@ def print_summary(s: dict, out=print, top: int = 20) -> None:
     for name, p in rows[:top]:
         out(f" {name:<32} {p['calls']:>7} {p['total_ms']:>11.3f} "
             f"{p['mean_ms']:>9.3f} {p['max_ms']:>9.3f}")
+    if s.get("annotations"):
+        out(" " + "-" * 72)
+        out(f" {'SPAN ANNOTATION':<40} {'SPANS':>9} {'TOTAL ms':>11}")
+        for key in _ANNOTATION_KEYS:
+            by_value = s["annotations"].get(key)
+            if not by_value:
+                continue
+            for value, a in sorted(by_value.items(),
+                                   key=lambda kv: -kv[1]["total_ms"]):
+                out(f" {f'{key}={value}':<40} {a['spans']:>9} "
+                    f"{a['total_ms']:>11.3f}")
+    if s.get("resilience"):
+        out(" " + "-" * 72)
+        out(f" {'RESILIENCE INSTANT':<40} {'COUNT':>9}")
+        for name, n in sorted(s["resilience"].items(),
+                              key=lambda kv: -kv[1]):
+            out(f" {name:<40} {n:>9}")
     if s["jit_compiles"]:
         out(" " + "-" * 72)
         out(f" {'RECOMPILE OFFENDERS':<48} {'COMPILES':>9}")
